@@ -1,0 +1,383 @@
+"""Typed configuration system.
+
+TPU-native analogue of the reference config stack
+(/root/reference/deepspeed/runtime/config.py:706 ``DeepSpeedConfig`` and the
+pydantic ``DeepSpeedConfigModel`` pattern in runtime/config_utils.py). Keeps
+the same user contract: one JSON file / dict with per-feature sections,
+``"auto"`` values, batch-term reconciliation (micro × GAS × DP =
+train_batch_size), and unknown-key errors — implemented with plain
+dataclasses so the framework stays dependency-light.
+
+GPU-only knobs from the reference (CUDA graphs, NCCL buckets, pin_memory…)
+are accepted where harmless and ignored with a log line, so existing
+DeepSpeed JSON configs port over.
+"""
+from __future__ import annotations
+
+import dataclasses
+import json
+from dataclasses import dataclass, field
+from typing import Any
+
+from .parallel.topology import MeshConfig
+from .utils.logging import logger
+
+AUTO = "auto"
+
+
+def _take(d: dict, cls, section: str):
+    """Build dataclass ``cls`` from dict ``d``, erroring on unknown keys."""
+    d = dict(d or {})
+    known = {f.name for f in dataclasses.fields(cls)}
+    ignored = getattr(cls, "_IGNORED_KEYS", ())
+    for k in list(d):
+        if k in ignored:
+            logger.info(f"config: ignoring GPU-specific key '{section}.{k}' on TPU")
+            d.pop(k)
+    unknown = set(d) - known
+    if unknown:
+        raise ValueError(f"unknown keys in '{section}' config: {sorted(unknown)}")
+    return cls(**d)
+
+
+# --------------------------------------------------------------------------
+# Sections
+# --------------------------------------------------------------------------
+
+@dataclass
+class OptimizerConfig:
+    """Reference: ``optimizer`` section (runtime/config.py get_optimizer_params)."""
+    type: str = "AdamW"
+    params: dict[str, Any] = field(default_factory=dict)
+
+    _IGNORED_KEYS = ("legacy_fusion",)
+
+
+@dataclass
+class SchedulerConfig:
+    """Reference: ``scheduler`` section → runtime/lr_schedules.py."""
+    type: str = "WarmupLR"
+    params: dict[str, Any] = field(default_factory=dict)
+
+
+@dataclass
+class BF16Config:
+    enabled: bool = True  # TPU default: bf16 on (reference bf16_optimizer role)
+
+    _IGNORED_KEYS = ("immediate_grad_update",)
+
+
+@dataclass
+class FP16Config:
+    """Reference: ``fp16`` section → fp16/loss_scaler.py:91 dynamic scaling."""
+    enabled: bool = False
+    loss_scale: float = 0.0  # 0 → dynamic
+    initial_scale_power: int = 16
+    loss_scale_window: int = 1000
+    hysteresis: int = 2
+    min_loss_scale: float = 1.0
+
+    _IGNORED_KEYS = ("fp16_master_weights_and_grads", "auto_cast", "consecutive_hysteresis")
+
+
+@dataclass
+class OffloadConfig:
+    """Reference: ``offload_optimizer``/``offload_param`` (zero/config.py).
+
+    ``device``: ``none`` | ``cpu`` (host RAM) | ``nvme`` (disk via the host
+    async-IO runtime)."""
+    device: str = "none"
+    nvme_path: str | None = None
+    buffer_count: int = 4
+    pin_memory: bool = False  # accepted; host staging is always pinned by PJRT
+
+    _IGNORED_KEYS = ("buffer_size", "max_in_cpu", "fast_init", "ratio")
+
+
+@dataclass
+class ZeroConfig:
+    """Reference: ``zero_optimization`` (runtime/zero/config.py).
+
+    Stage semantics on TPU (see runtime/zero/planner.py):
+      0 — DDP: replicated params/opt state, grads pmean over DP axes.
+      1 — optimizer state sharded over ``fsdp``.
+      2 — + gradients reduce-scattered to the shard owner.
+      3 — + parameters sharded over ``fsdp``; XLA inserts the gathers.
+    """
+    stage: int = 0
+    offload_optimizer: OffloadConfig = field(default_factory=OffloadConfig)
+    offload_param: OffloadConfig = field(default_factory=OffloadConfig)
+    # ZeRO++ analogues:
+    zero_quantized_weights: bool = False    # qwZ: int8 param all-gather
+    zero_quantized_gradients: bool = False  # qgZ: int8 grad reduce
+    zero_hpz_partition_size: int = 1        # hpZ: secondary shard within ICI domain
+    mics_shard_size: int = -1               # MiCS: shard over submesh, replicate across
+    # Accepted-but-advisory on TPU (XLA owns scheduling/bucketing):
+    overlap_comm: bool = True
+    contiguous_gradients: bool = True
+    reduce_bucket_size: int = 500_000_000
+    allgather_bucket_size: int = 500_000_000
+    stage3_max_live_parameters: int = 1_000_000_000
+    stage3_max_reuse_distance: int = 1_000_000_000
+    stage3_prefetch_bucket_size: int = 50_000_000
+    stage3_param_persistence_threshold: int = 100_000
+    sub_group_size: int = 1_000_000_000
+    round_robin_gradients: bool = False
+    zero_allow_untested_optimizer: bool = True
+
+    _IGNORED_KEYS = ("allgather_partitions", "reduce_scatter", "cpu_offload",
+                     "elastic_checkpoint", "ignore_unused_parameters",
+                     "legacy_stage1", "stage3_gather_16bit_weights_on_model_save",
+                     "zero_quantized_nontrainable_weights", "memory_efficient_linear")
+
+    def __post_init__(self):
+        if isinstance(self.offload_optimizer, dict):
+            self.offload_optimizer = _take(self.offload_optimizer, OffloadConfig,
+                                           "zero_optimization.offload_optimizer")
+        if isinstance(self.offload_param, dict):
+            self.offload_param = _take(self.offload_param, OffloadConfig,
+                                       "zero_optimization.offload_param")
+        if not 0 <= self.stage <= 3:
+            raise ValueError(f"zero stage must be 0-3, got {self.stage}")
+
+
+@dataclass
+class ActivationCheckpointingConfig:
+    """Reference: runtime/activation_checkpointing/checkpointing.py. On TPU
+    this maps to ``jax.checkpoint`` with a rematerialization policy."""
+    partition_activations: bool = False  # maps to activation sharding over 'seq'
+    number_checkpoints: int | None = None
+    # TPU extension: jax.checkpoint policy name
+    policy: str = "none"  # none|full|dots_saveable|nothing_saveable|dots_with_no_batch_dims_saveable
+
+    _IGNORED_KEYS = ("cpu_checkpointing", "contiguous_memory_optimization",
+                     "synchronize_checkpoint_boundary", "profile")
+
+
+@dataclass
+class FlopsProfilerConfig:
+    """Reference: profiling/flops_profiler (profiler.py:28)."""
+    enabled: bool = False
+    profile_step: int = 1
+    module_depth: int = -1
+    top_modules: int = 1
+    detailed: bool = True
+    output_file: str | None = None
+
+
+@dataclass
+class CommsLoggerConfig:
+    """Reference: comms_logger section (utils/comms_logging.py:67)."""
+    enabled: bool = False
+    verbose: bool = False
+    debug: bool = False
+    prof_all: bool = True
+    prof_ops: list[str] = field(default_factory=list)
+
+
+@dataclass
+class MonitorBackendConfig:
+    enabled: bool = False
+    output_path: str = ""
+    job_name: str = "DeepSpeedTPUJob"
+    # wandb extras
+    team: str | None = None
+    group: str | None = None
+    project: str | None = None
+
+
+@dataclass
+class TensorParallelConfig:
+    """TPU extension mirroring the mpu/AutoTP role (module_inject/auto_tp.py:189):
+    degree comes from mesh.tensor; this section holds behavior knobs."""
+    gather_output: bool = False
+
+
+@dataclass
+class PipelineConfig:
+    """Reference: runtime/pipe (PipelineModule module.py:86). Stage count
+    comes from mesh.pipe."""
+    num_micro_batches: int | None = None  # default: gradient_accumulation_steps
+    schedule: str = "1f1b"  # 1f1b | gpipe (interleaved later)
+    partition_method: str = "uniform"
+
+    _IGNORED_KEYS = ("activation_checkpoint_interval", "pipe_partitioned", "grad_partitioned")
+
+
+@dataclass
+class DataTypesConfig:
+    grad_accum_dtype: str | None = None  # fp32|bf16|None→param dtype
+
+
+@dataclass
+class CheckpointConfig:
+    """Reference: engine save/load + checkpoint_engine. Orbax-backed; every
+    checkpoint is 'universal' (reshard-on-load)."""
+    use_node_local_storage: bool = False
+    load_universal: bool = True   # kept for config-compat; always true on TPU
+    async_save: bool = False
+    keep_n: int | None = None
+
+    _IGNORED_KEYS = ("tag_validation", "parallel_write", "writer")
+
+
+# --------------------------------------------------------------------------
+# Top-level config
+# --------------------------------------------------------------------------
+
+_TOP_LEVEL_IGNORED = (
+    # GPU-only / not-applicable sections accepted for config compat:
+    "amp", "apex", "cuda_graphs", "communication_data_type", "disable_allgather",
+    "sparse_gradients", "prescale_gradients", "gradient_predivide_factor",
+    "dump_state", "elasticity", "nebula", "hybrid_engine", "compression_training",
+    "curriculum_learning", "data_efficiency", "aio", "autotuning",
+    "zero_force_ds_cpu_optimizer", "checkpoint_parallel_write_pipeline",
+    "memory_breakdown", "use_data_before_expert_parallel_",
+)
+
+
+@dataclass
+class Config:
+    """The one config object (reference ``DeepSpeedConfig`` runtime/config.py:706)."""
+
+    # batch terms (reconciled below; reference config.py batch assertions)
+    train_batch_size: int | None = None
+    train_micro_batch_size_per_gpu: int | None = None
+    gradient_accumulation_steps: int | None = None
+
+    steps_per_print: int = 10
+    gradient_clipping: float = 0.0
+    seed: int = 42
+    wall_clock_breakdown: bool = False
+
+    optimizer: OptimizerConfig = field(default_factory=OptimizerConfig)
+    scheduler: SchedulerConfig | None = None
+    bf16: BF16Config = field(default_factory=BF16Config)
+    fp16: FP16Config = field(default_factory=FP16Config)
+    zero_optimization: ZeroConfig = field(default_factory=ZeroConfig)
+    mesh: MeshConfig = field(default_factory=MeshConfig)
+    tensor_parallel: TensorParallelConfig = field(default_factory=TensorParallelConfig)
+    pipeline: PipelineConfig = field(default_factory=PipelineConfig)
+    activation_checkpointing: ActivationCheckpointingConfig = field(
+        default_factory=ActivationCheckpointingConfig)
+    flops_profiler: FlopsProfilerConfig = field(default_factory=FlopsProfilerConfig)
+    comms_logger: CommsLoggerConfig = field(default_factory=CommsLoggerConfig)
+    tensorboard: MonitorBackendConfig = field(default_factory=MonitorBackendConfig)
+    csv_monitor: MonitorBackendConfig = field(default_factory=MonitorBackendConfig)
+    wandb: MonitorBackendConfig = field(default_factory=MonitorBackendConfig)
+    data_types: DataTypesConfig = field(default_factory=DataTypesConfig)
+    checkpoint: CheckpointConfig = field(default_factory=CheckpointConfig)
+
+    # ------------------------------------------------------------------
+    @classmethod
+    def from_dict(cls, d: dict[str, Any]) -> "Config":
+        d = dict(d or {})
+        for k in list(d):
+            if k in _TOP_LEVEL_IGNORED:
+                logger.info(f"config: ignoring section '{k}' (not applicable on TPU)")
+                d.pop(k)
+        sections = {
+            "optimizer": OptimizerConfig,
+            "scheduler": SchedulerConfig,
+            "bf16": BF16Config,
+            "fp16": FP16Config,
+            "zero_optimization": ZeroConfig,
+            "tensor_parallel": TensorParallelConfig,
+            "pipeline": PipelineConfig,
+            "activation_checkpointing": ActivationCheckpointingConfig,
+            "flops_profiler": FlopsProfilerConfig,
+            "comms_logger": CommsLoggerConfig,
+            "tensorboard": MonitorBackendConfig,
+            "csv_monitor": MonitorBackendConfig,
+            "wandb": MonitorBackendConfig,
+            "data_types": DataTypesConfig,
+            "checkpoint": CheckpointConfig,
+        }
+        kwargs: dict[str, Any] = {}
+        for key, sub_cls in sections.items():
+            if key in d:
+                kwargs[key] = _take(d.pop(key), sub_cls, key)
+        if "mesh" in d:
+            kwargs["mesh"] = MeshConfig.from_dict(d.pop("mesh"))
+        # 'bfloat16' alias used by some configs
+        if "bfloat16" in d:
+            kwargs["bf16"] = _take(d.pop("bfloat16"), BF16Config, "bfloat16")
+        known = {f.name for f in dataclasses.fields(cls)}
+        unknown = set(d) - known
+        if unknown:
+            raise ValueError(f"unknown top-level config keys: {sorted(unknown)}")
+        kwargs.update(d)
+        return cls(**kwargs)
+
+    @classmethod
+    def from_json(cls, path: str) -> "Config":
+        with open(path) as f:
+            return cls.from_dict(json.load(f))
+
+    @classmethod
+    def load(cls, config: "str | dict | Config | None") -> "Config":
+        if config is None:
+            return cls()
+        if isinstance(config, Config):
+            return config
+        if isinstance(config, str):
+            return cls.from_json(config)
+        return cls.from_dict(config)
+
+    # ------------------------------------------------------------------
+    def resolve_batch_terms(self, dp_world_size: int) -> None:
+        """Reconcile train/micro/GAS (reference runtime/config.py
+        ``_configure_train_batch_size``): any two determine the third;
+        all three must satisfy train = micro × GAS × dp_world."""
+        train, micro, gas = (self.train_batch_size, self.train_micro_batch_size_per_gpu,
+                             self.gradient_accumulation_steps)
+        if train is not None and micro is not None and gas is not None:
+            pass
+        elif train is not None and micro is not None:
+            if train % (micro * dp_world_size) != 0:
+                raise ValueError(
+                    f"train_batch_size {train} not divisible by micro_batch "
+                    f"{micro} * dp_world {dp_world_size}")
+            gas = train // (micro * dp_world_size)
+        elif train is not None and gas is not None:
+            if train % (gas * dp_world_size) != 0:
+                raise ValueError(
+                    f"train_batch_size {train} not divisible by GAS {gas} * "
+                    f"dp_world {dp_world_size}")
+            micro = train // (gas * dp_world_size)
+        elif micro is not None:
+            gas = gas or 1
+            train = micro * gas * dp_world_size
+        elif train is not None:
+            gas = 1
+            if train % dp_world_size != 0:
+                raise ValueError(
+                    f"train_batch_size {train} not divisible by dp_world {dp_world_size}")
+            micro = train // dp_world_size
+        else:
+            micro, gas = 1, 1
+            train = dp_world_size
+        if train != micro * gas * dp_world_size:
+            raise ValueError(
+                f"inconsistent batch terms: train_batch_size={train} != "
+                f"micro({micro}) * gas({gas}) * dp_world({dp_world_size})")
+        self.train_batch_size = train
+        self.train_micro_batch_size_per_gpu = micro
+        self.gradient_accumulation_steps = gas
+
+    @property
+    def compute_dtype(self):
+        import jax.numpy as jnp
+
+        if self.fp16.enabled:
+            return jnp.float16
+        if self.bf16.enabled:
+            return jnp.bfloat16
+        return jnp.float32
+
+    def to_dict(self) -> dict[str, Any]:
+        return dataclasses.asdict(self)
+
+
+# Backwards-friendly aliases matching the reference naming
+DeepSpeedConfig = Config

@@ -1,0 +1,84 @@
+"""Model zoo: presets matching the reference's supported model families
+(inference/v2/model_implementations + module_inject containers: llama,
+mistral, mixtral, opt/gpt…) expressed as configs of one TPU-native
+TransformerLM."""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from .loss import cross_entropy_lm, lm_loss_fn  # noqa: F401
+from .transformer import (  # noqa: F401
+    ModelConfig,
+    MoEConfig,
+    TransformerLM,
+    default_activation_rules,
+)
+
+PRESETS: dict[str, ModelConfig] = {
+    # --- GPT-2 family (BASELINE.json config 1) ---------------------------
+    "gpt2-125m": ModelConfig(vocab_size=50257, hidden_size=768, num_layers=12,
+                             num_heads=12, max_seq_len=1024,
+                             position_embedding="learned", norm="layernorm",
+                             activation="gelu", tie_embeddings=True),
+    "gpt2-350m": ModelConfig(vocab_size=50257, hidden_size=1024, num_layers=24,
+                             num_heads=16, max_seq_len=1024,
+                             position_embedding="learned", activation="gelu"),
+    "gpt2-1.3b": ModelConfig(vocab_size=50257, hidden_size=2048, num_layers=24,
+                             num_heads=32, max_seq_len=1024,
+                             position_embedding="learned", activation="gelu"),
+    # --- LLaMA-2 family (BASELINE.json configs 2/4) ----------------------
+    "llama2-7b": ModelConfig(vocab_size=32000, hidden_size=4096, num_layers=32,
+                             num_heads=32, num_kv_heads=32, intermediate_size=11008,
+                             max_seq_len=4096, position_embedding="rope",
+                             norm="rmsnorm", activation="silu_glu",
+                             tie_embeddings=False),
+    "llama2-13b": ModelConfig(vocab_size=32000, hidden_size=5120, num_layers=40,
+                              num_heads=40, num_kv_heads=40, intermediate_size=13824,
+                              max_seq_len=4096, position_embedding="rope",
+                              norm="rmsnorm", activation="silu_glu",
+                              tie_embeddings=False),
+    "llama2-70b": ModelConfig(vocab_size=32000, hidden_size=8192, num_layers=80,
+                              num_heads=64, num_kv_heads=8, intermediate_size=28672,
+                              max_seq_len=4096, position_embedding="rope",
+                              norm="rmsnorm", activation="silu_glu",
+                              tie_embeddings=False),
+    # --- Mistral / Mixtral (BASELINE.json config 3) ----------------------
+    "mistral-7b": ModelConfig(vocab_size=32000, hidden_size=4096, num_layers=32,
+                              num_heads=32, num_kv_heads=8, intermediate_size=14336,
+                              max_seq_len=8192, position_embedding="rope",
+                              norm="rmsnorm", activation="silu_glu",
+                              tie_embeddings=False),
+    "mixtral-8x7b": ModelConfig(vocab_size=32000, hidden_size=4096, num_layers=32,
+                                num_heads=32, num_kv_heads=8, intermediate_size=14336,
+                                max_seq_len=8192, position_embedding="rope",
+                                norm="rmsnorm", activation="silu_glu",
+                                tie_embeddings=False,
+                                moe=MoEConfig(num_experts=8, top_k=2)),
+    # --- tiny variants for tests/debug (reference tests/unit/simple_model.py) --
+    "tiny-gpt2": ModelConfig(vocab_size=256, hidden_size=64, num_layers=2,
+                             num_heads=4, max_seq_len=128,
+                             position_embedding="learned", activation="gelu"),
+    "tiny-llama": ModelConfig(vocab_size=256, hidden_size=64, num_layers=2,
+                              num_heads=4, num_kv_heads=2, max_seq_len=128,
+                              position_embedding="rope", norm="rmsnorm",
+                              activation="silu_glu", tie_embeddings=False),
+    "tiny-mixtral": ModelConfig(vocab_size=256, hidden_size=64, num_layers=2,
+                                num_heads=4, num_kv_heads=2, max_seq_len=128,
+                                position_embedding="rope", norm="rmsnorm",
+                                activation="silu_glu", tie_embeddings=False,
+                                moe=MoEConfig(num_experts=4, top_k=2,
+                                              min_capacity=4)),
+}
+
+
+def get_model_config(name: str, **overrides) -> ModelConfig:
+    import dataclasses
+
+    if name not in PRESETS:
+        raise ValueError(f"unknown model preset '{name}'; known: {sorted(PRESETS)}")
+    cfg = PRESETS[name]
+    return dataclasses.replace(cfg, **overrides) if overrides else cfg
+
+
+def build_model(name: str, **overrides) -> TransformerLM:
+    return TransformerLM(get_model_config(name, **overrides))

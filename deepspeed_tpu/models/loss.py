@@ -1,0 +1,48 @@
+"""Loss functions for the model zoo.
+
+Includes the vocab-parallel-safe LM cross-entropy (role of reference
+deepspeed/sequence/cross_entropy.py — there vocab-parallel logits require a
+custom all-reduce softmax; under GSPMD the same einsum/softmax shards
+correctly from the logits' sharding, so one implementation serves both).
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+IGNORE_INDEX = -100
+
+
+def cross_entropy_lm(logits: jax.Array, labels: jax.Array,
+                     ignore_index: int = IGNORE_INDEX,
+                     z_loss_weight: float = 0.0) -> jax.Array:
+    """Mean next-token cross entropy. ``logits`` [B,S,V], ``labels`` [B,S]
+    already shifted by the caller (labels[t] is the target for logits[t])."""
+    logits = logits.astype(jnp.float32)
+    mask = (labels != ignore_index)
+    safe_labels = jnp.where(mask, labels, 0)
+    logz = jax.nn.logsumexp(logits, axis=-1)
+    true_logit = jnp.take_along_axis(logits, safe_labels[..., None], axis=-1)[..., 0]
+    nll = (logz - true_logit) * mask
+    denom = jnp.maximum(jnp.sum(mask), 1)
+    loss = jnp.sum(nll) / denom
+    if z_loss_weight:
+        loss = loss + z_loss_weight * jnp.sum(jnp.square(logz) * mask) / denom
+    return loss
+
+
+def lm_loss_fn(model, params, batch, deterministic: bool = True):
+    """Default engine loss: causal LM on {'input_ids', 'labels'} batches.
+    Adds any aux losses the model sowed (MoE balance/z losses)."""
+    input_ids = batch["input_ids"]
+    labels = batch.get("labels")
+    if labels is None:
+        labels = jnp.concatenate(
+            [input_ids[:, 1:], jnp.full_like(input_ids[:, :1], IGNORE_INDEX)], axis=1)
+    out, variables = model.apply({"params": params}, input_ids,
+                                 deterministic=deterministic, mutable=["losses"])
+    logits = out
+    loss = cross_entropy_lm(logits, labels)
+    for leaf in jax.tree.leaves(variables.get("losses", {})):
+        loss = loss + jnp.sum(leaf)
+    return loss

@@ -1,0 +1,429 @@
+"""Decoder-only transformer family (GPT-2 / LLaMA / Mixtral-MoE).
+
+This is the flagship model zoo of the framework — the role the reference
+plays through HF-model injection (module_inject/containers: llama, gptj,
+bloom, opt… and inference/v2/model_implementations/{llama_v2,mistral,
+mixtral,…}). Rather than patching torch modules, models here are built
+TPU-first in flax.linen:
+
+- every parameter carries *logical* axis names via ``nn.with_partitioning``;
+  the ZeRO planner (runtime/zero/planner.py) maps them onto the device mesh
+  (tensor/expert axes) and adds ZeRO fsdp sharding,
+- activations carry logical constraints; the engine installs rules that make
+  XLA materialize the parallelism algebra:
+    * tensor parallelism — heads/mlp dims → ``tensor`` (Megatron slicing, the
+      role of module_inject/auto_tp.py:189),
+    * Ulysses sequence parallelism — sequence dim sharded over ``seq``
+      outside attention; head dim constrained to ``seq`` *inside* attention,
+      so XLA inserts the seq↔head all-to-all pair around local attention —
+      exactly reference deepspeed/sequence/layer.py:90 ``_SeqAllToAll``,
+    * expert parallelism — expert dim → ``expert``; the dispatch/combine
+      einsums lower to the MoE all-to-all (reference moe/sharded_moe.py:96).
+
+Attention runs through ops/attention.py which picks the Pallas flash kernel
+on TPU and a reference XLA path elsewhere.
+"""
+from __future__ import annotations
+
+import dataclasses
+from dataclasses import dataclass, field
+from functools import partial
+from typing import Any, Callable
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+from ..ops.attention import dot_product_attention
+
+# Logical activation axis names (mapped to mesh axes by engine rules):
+BATCH = "act_batch"
+SEQ = "act_seq"
+EMBED = "act_embed"
+HEADS = "act_heads"
+MLP = "act_mlp"
+EXPERT = "act_expert"
+
+
+def constrain(x: jax.Array, *names: str | None) -> jax.Array:
+    return nn.with_logical_constraint(x, tuple(names))
+
+
+def default_activation_rules(topology) -> list[tuple[str, Any]]:
+    """Logical→mesh rules installed by the engine around apply()."""
+    return [
+        (BATCH, ("data", "expert", "fsdp")),
+        (SEQ, "seq"),
+        (EMBED, None),
+        # inside attention: heads sharded over tensor AND seq (Ulysses)
+        (HEADS, ("tensor", "seq")),
+        (MLP, "tensor"),
+        (EXPERT, "expert"),
+    ]
+
+
+@dataclass(frozen=True)
+class MoEConfig:
+    """Mixtral/GShard-style MoE (reference deepspeed/moe/layer.py:17)."""
+    num_experts: int = 8
+    top_k: int = 2
+    capacity_factor: float = 1.25
+    eval_capacity_factor: float = 2.0
+    min_capacity: int = 4
+    aux_loss_weight: float = 0.01
+    router_z_loss_weight: float = 0.001
+    # layers where MoE replaces dense FFN; every Nth layer (1 = all)
+    moe_layer_freq: int = 1
+
+
+@dataclass(frozen=True)
+class ModelConfig:
+    vocab_size: int = 50257
+    hidden_size: int = 768
+    num_layers: int = 12
+    num_heads: int = 12
+    num_kv_heads: int | None = None          # GQA; None → num_heads
+    intermediate_size: int | None = None     # None → 4*hidden (gpt) / 8/3*hidden (glu)
+    max_seq_len: int = 1024
+    position_embedding: str = "learned"      # learned | rope
+    rope_theta: float = 10000.0
+    norm: str = "layernorm"                  # layernorm | rmsnorm
+    norm_eps: float = 1e-5
+    activation: str = "gelu"                 # gelu | silu_glu (SwiGLU)
+    tie_embeddings: bool = True
+    moe: MoEConfig | None = None
+    dtype: Any = jnp.bfloat16                # compute dtype
+    remat: bool = False                      # rematerialize each block
+    attn_impl: str = "auto"                  # auto | pallas | xla
+
+    @property
+    def kv_heads(self) -> int:
+        return self.num_kv_heads or self.num_heads
+
+    @property
+    def head_dim(self) -> int:
+        return self.hidden_size // self.num_heads
+
+    @property
+    def ffn_size(self) -> int:
+        if self.intermediate_size:
+            return self.intermediate_size
+        if self.activation == "silu_glu":
+            return int(8 * self.hidden_size / 3 // 128 + 1) * 128
+        return 4 * self.hidden_size
+
+    def num_params(self) -> int:
+        """Analytic parameter count (used by the flops profiler and bench)."""
+        h, v, L = self.hidden_size, self.vocab_size, self.num_layers
+        f = self.ffn_size
+        attn = h * self.num_heads * self.head_dim + 2 * h * self.kv_heads * self.head_dim \
+            + self.num_heads * self.head_dim * h
+        if self.activation == "silu_glu":
+            ffn_dense = 3 * h * f
+        else:
+            ffn_dense = 2 * h * f + f + h  # + biases
+        if self.moe:
+            ffn = self.moe.num_experts * 3 * h * f + h * self.moe.num_experts
+        else:
+            ffn = ffn_dense
+        per_norm = h if self.norm == "rmsnorm" else 2 * h
+        norms = (2 * L + 1) * per_norm
+        emb = v * h + (0 if self.tie_embeddings else v * h)
+        pos = self.max_seq_len * h if self.position_embedding == "learned" else 0
+        return emb + pos + L * (attn + ffn) + norms
+
+
+def _dense_init(scale: float = 1.0):
+    return nn.initializers.variance_scaling(scale, "fan_in", "normal")
+
+
+class Norm(nn.Module):
+    config: ModelConfig
+
+    @nn.compact
+    def __call__(self, x):
+        cfg = self.config
+        dtype = x.dtype
+        x = x.astype(jnp.float32)
+        if cfg.norm == "rmsnorm":
+            scale = self.param("scale", nn.with_partitioning(nn.initializers.ones, ("embed",)),
+                               (cfg.hidden_size,), jnp.float32)
+            var = jnp.mean(jnp.square(x), axis=-1, keepdims=True)
+            out = x * jax.lax.rsqrt(var + cfg.norm_eps) * scale
+        else:
+            scale = self.param("scale", nn.with_partitioning(nn.initializers.ones, ("embed",)),
+                               (cfg.hidden_size,), jnp.float32)
+            bias = self.param("bias", nn.with_partitioning(nn.initializers.zeros, ("embed",)),
+                              (cfg.hidden_size,), jnp.float32)
+            mean = jnp.mean(x, axis=-1, keepdims=True)
+            var = jnp.mean(jnp.square(x - mean), axis=-1, keepdims=True)
+            out = (x - mean) * jax.lax.rsqrt(var + cfg.norm_eps) * scale + bias
+        return out.astype(dtype)
+
+
+def rope(q: jax.Array, k: jax.Array, positions: jax.Array, theta: float) -> tuple[jax.Array, jax.Array]:
+    """Rotary position embedding on [B, S, H, D] q/k."""
+    d = q.shape[-1]
+    freqs = 1.0 / (theta ** (jnp.arange(0, d, 2, dtype=jnp.float32) / d))
+    angles = positions[..., None].astype(jnp.float32) * freqs  # [B, S, D/2]
+    cos, sin = jnp.cos(angles)[:, :, None, :], jnp.sin(angles)[:, :, None, :]
+
+    def rot(x):
+        x1, x2 = x[..., ::2], x[..., 1::2]
+        xr1 = x1 * cos - x2 * sin
+        xr2 = x2 * cos + x1 * sin
+        return jnp.stack([xr1, xr2], axis=-1).reshape(x.shape)
+
+    return rot(q.astype(jnp.float32)).astype(q.dtype), rot(k.astype(jnp.float32)).astype(k.dtype)
+
+
+class Attention(nn.Module):
+    """Causal self-attention with GQA + optional RoPE + KV cache.
+
+    TP: heads dim → 'tensor'; Ulysses: q/k/v constrained head-sharded over
+    'seq' for the attention itself (all-to-all inserted by XLA).
+    """
+    config: ModelConfig
+
+    @nn.compact
+    def __call__(self, x, positions, kv_cache=None, attn_mask=None):
+        cfg = self.config
+        B, S, _ = x.shape
+        H, KV, D = cfg.num_heads, cfg.kv_heads, cfg.head_dim
+
+        wq = self.param("wq", nn.with_partitioning(_dense_init(), ("embed", "heads", "head_dim")),
+                        (cfg.hidden_size, H, D), jnp.float32)
+        wk = self.param("wk", nn.with_partitioning(_dense_init(), ("embed", "kv_heads", "head_dim")),
+                        (cfg.hidden_size, KV, D), jnp.float32)
+        wv = self.param("wv", nn.with_partitioning(_dense_init(), ("embed", "kv_heads", "head_dim")),
+                        (cfg.hidden_size, KV, D), jnp.float32)
+        wo = self.param("wo", nn.with_partitioning(_dense_init(), ("heads", "head_dim", "embed")),
+                        (H, D, cfg.hidden_size), jnp.float32)
+
+        q = jnp.einsum("bse,ehd->bshd", x, wq.astype(cfg.dtype))
+        k = jnp.einsum("bse,ehd->bshd", x, wk.astype(cfg.dtype))
+        v = jnp.einsum("bse,ehd->bshd", x, wv.astype(cfg.dtype))
+
+        if cfg.position_embedding == "rope":
+            q, k = rope(q, k, positions, cfg.rope_theta)
+
+        new_cache = None
+        if kv_cache is not None:
+            # decode path: append at cache_len
+            ck, cv, cache_len = kv_cache
+            ck = jax.lax.dynamic_update_slice(ck, k.astype(ck.dtype), (0, cache_len, 0, 0))
+            cv = jax.lax.dynamic_update_slice(cv, v.astype(cv.dtype), (0, cache_len, 0, 0))
+            k, v = ck, cv
+            new_cache = (ck, cv, cache_len + S)
+
+        # Ulysses resharding: seq→full, heads→sharded over ('tensor','seq')
+        q = constrain(q, BATCH, None, HEADS, None)
+        k = constrain(k, BATCH, None, HEADS if KV == H else None, None)
+        v = constrain(v, BATCH, None, HEADS if KV == H else None, None)
+
+        out = dot_product_attention(
+            q, k, v,
+            causal=True,
+            positions=positions if kv_cache is not None else None,
+            kv_len=(kv_cache[2] + S) if kv_cache is not None else None,
+            mask=attn_mask,
+            impl=cfg.attn_impl,
+        )
+        # back to seq-sharded, heads full
+        out = constrain(out, BATCH, SEQ, None, None)
+        out = jnp.einsum("bshd,hde->bse", out, wo.astype(cfg.dtype))
+        out = constrain(out, BATCH, SEQ, EMBED)
+        if new_cache is not None:
+            return out, new_cache
+        return out
+
+
+class DenseFFN(nn.Module):
+    config: ModelConfig
+
+    @nn.compact
+    def __call__(self, x):
+        cfg = self.config
+        F = cfg.ffn_size
+        if cfg.activation == "silu_glu":
+            wg = self.param("w_gate", nn.with_partitioning(_dense_init(), ("embed", "mlp")),
+                            (cfg.hidden_size, F), jnp.float32)
+            wu = self.param("w_up", nn.with_partitioning(_dense_init(), ("embed", "mlp")),
+                            (cfg.hidden_size, F), jnp.float32)
+            wd = self.param("w_down", nn.with_partitioning(_dense_init(), ("mlp", "embed")),
+                            (F, cfg.hidden_size), jnp.float32)
+            h = jax.nn.silu(x @ wg.astype(cfg.dtype)) * (x @ wu.astype(cfg.dtype))
+        else:
+            wu = self.param("w_up", nn.with_partitioning(_dense_init(), ("embed", "mlp")),
+                            (cfg.hidden_size, F), jnp.float32)
+            wd = self.param("w_down", nn.with_partitioning(_dense_init(), ("mlp", "embed")),
+                            (F, cfg.hidden_size), jnp.float32)
+            bu = self.param("b_up", nn.with_partitioning(nn.initializers.zeros, ("mlp",)),
+                            (F,), jnp.float32)
+            bd = self.param("b_down", nn.with_partitioning(nn.initializers.zeros, ("embed",)),
+                            (cfg.hidden_size,), jnp.float32)
+            h = jax.nn.gelu(x @ wu.astype(cfg.dtype) + bu.astype(cfg.dtype))
+        h = constrain(h, BATCH, SEQ, MLP)
+        out = h @ wd.astype(cfg.dtype)
+        if cfg.activation != "silu_glu":
+            out = out + bd.astype(cfg.dtype)
+        return constrain(out, BATCH, SEQ, EMBED)
+
+
+class MoEFFN(nn.Module):
+    """Top-k routed expert FFN with capacity (GShard dense dispatch).
+
+    TPU-native version of reference moe/sharded_moe.py (``TopKGate`` :449,
+    ``MOELayer`` :533, ``_AllToAll`` :96): the dispatch/combine einsums below
+    become the expert all-to-all under GSPMD because tokens are sharded over
+    the batch axes while expert tensors are sharded over 'expert'.
+    """
+    config: ModelConfig
+
+    @nn.compact
+    def __call__(self, x, deterministic: bool = True):
+        cfg = self.config
+        moe = cfg.moe
+        B, S, E = x.shape
+        n_exp, k = moe.num_experts, moe.top_k
+        tokens = B * S
+        cap_factor = moe.eval_capacity_factor if deterministic else moe.capacity_factor
+        capacity = max(int(k * tokens / n_exp * cap_factor / max(B, 1)), moe.min_capacity)
+        # capacity is per batch-group: route within each batch row group for
+        # a static shape that shards over the batch axes.
+        x2 = x.reshape(B, S, E)
+
+        wr = self.param("w_router", nn.with_partitioning(_dense_init(), ("embed", "expert")),
+                        (E, n_exp), jnp.float32)
+        logits = jnp.einsum("bse,en->bsn", x2.astype(jnp.float32), wr)  # router in fp32
+        probs = jax.nn.softmax(logits, axis=-1)
+
+        # --- top-k gating with capacity (reference top2gating :290) -------
+        gate_vals, expert_idx = jax.lax.top_k(probs, k)            # [B,S,k]
+        # position of each token within its expert's queue
+        onehot = jax.nn.one_hot(expert_idx, n_exp, dtype=jnp.float32)  # [B,S,k,n]
+        # priority: earlier tokens + higher k-rank first
+        flat = onehot.reshape(B, S * k, n_exp)
+        pos_in_expert = jnp.cumsum(flat, axis=1) * flat - 1.0          # [B,S*k,n]
+        pos_in_expert = pos_in_expert.reshape(B, S, k, n_exp)
+        keep = (pos_in_expert < capacity) & (onehot > 0)
+        pos = jnp.clip(jnp.sum(pos_in_expert * onehot, axis=-1), 0, capacity - 1)  # [B,S,k]
+        kept_gate = gate_vals * jnp.sum(keep, axis=-1)                  # zero dropped
+
+        # renormalize top-k gates (mixtral style)
+        denom = jnp.sum(kept_gate, axis=-1, keepdims=True)
+        kept_gate = kept_gate / jnp.maximum(denom, 1e-9)
+
+        # aux load-balance loss (reference sharded_moe.py top1gating :183)
+        me = jnp.mean(probs, axis=(0, 1))                  # [n]
+        ce = jnp.mean(jnp.sum(onehot, axis=2), axis=(0, 1))  # fraction routed
+        aux_loss = jnp.sum(me * ce) * n_exp * moe.aux_loss_weight
+        z_loss = jnp.mean(jnp.square(jax.nn.logsumexp(logits, axis=-1))) * \
+            moe.router_z_loss_weight
+        self.sow("losses", "moe_aux_loss", aux_loss + z_loss)
+
+        # --- dispatch: [B,S,E] → [B,n,cap,E] --------------------------------
+        # combine[b,s,k_,n,c] = kept_gate * onehot(pos)
+        pos_oh = jax.nn.one_hot(pos, capacity, dtype=jnp.float32)       # [B,S,k,cap]
+        dispatch = jnp.einsum("bskn,bskc->bsnc",
+                              keep.astype(jnp.float32) * onehot, pos_oh)  # [B,S,n,cap]
+        combine = jnp.einsum("bsk,bskn,bskc->bsnc", kept_gate,
+                             keep.astype(jnp.float32) * onehot, pos_oh)
+
+        expert_in = jnp.einsum("bsnc,bse->nbce", dispatch.astype(cfg.dtype), x2)
+        expert_in = constrain(expert_in, EXPERT, BATCH, None, EMBED)
+
+        # --- expert FFN (grouped GEMM over the expert dim) ----------------
+        F = cfg.ffn_size
+        wg = self.param("w_gate", nn.with_partitioning(_dense_init(), ("expert", "embed", "expert_mlp")),
+                        (n_exp, E, F), jnp.float32)
+        wu = self.param("w_up", nn.with_partitioning(_dense_init(), ("expert", "embed", "expert_mlp")),
+                        (n_exp, E, F), jnp.float32)
+        wd = self.param("w_down", nn.with_partitioning(_dense_init(), ("expert", "expert_mlp", "embed")),
+                        (n_exp, F, E), jnp.float32)
+        h = jax.nn.silu(jnp.einsum("nbce,nef->nbcf", expert_in, wg.astype(cfg.dtype))) * \
+            jnp.einsum("nbce,nef->nbcf", expert_in, wu.astype(cfg.dtype))
+        expert_out = jnp.einsum("nbcf,nfe->nbce", h, wd.astype(cfg.dtype))
+        expert_out = constrain(expert_out, EXPERT, BATCH, None, EMBED)
+
+        out = jnp.einsum("bsnc,nbce->bse", combine.astype(cfg.dtype), expert_out)
+        return constrain(out, BATCH, SEQ, EMBED)
+
+
+class Block(nn.Module):
+    config: ModelConfig
+    use_moe: bool = False
+
+    @nn.compact
+    def __call__(self, x, positions, kv_cache=None, attn_mask=None, deterministic=True):
+        cfg = self.config
+        attn_out = Attention(cfg, name="attn")(Norm(cfg, name="ln_attn")(x), positions,
+                                               kv_cache=kv_cache, attn_mask=attn_mask)
+        if kv_cache is not None:
+            attn_out, new_cache = attn_out
+        else:
+            new_cache = None
+        x = x + attn_out
+        h = Norm(cfg, name="ln_ffn")(x)
+        if self.use_moe:
+            ffn_out = MoEFFN(cfg, name="moe")(h, deterministic=deterministic)
+        else:
+            ffn_out = DenseFFN(cfg, name="ffn")(h)
+        x = x + ffn_out
+        if kv_cache is not None:
+            return x, new_cache
+        return x
+
+
+class TransformerLM(nn.Module):
+    """The flagship causal LM."""
+    config: ModelConfig
+
+    @nn.compact
+    def __call__(self, input_ids, positions=None, kv_caches=None, attn_mask=None,
+                 deterministic: bool = True):
+        cfg = self.config
+        B, S = input_ids.shape
+        if positions is None:
+            positions = jnp.broadcast_to(jnp.arange(S, dtype=jnp.int32), (B, S))
+
+        embed = self.param("embed", nn.with_partitioning(
+            nn.initializers.normal(0.02), ("vocab", "embed")),
+            (cfg.vocab_size, cfg.hidden_size), jnp.float32)
+        x = embed.astype(cfg.dtype)[input_ids]
+        if cfg.position_embedding == "learned":
+            pos_emb = self.param("pos_embed", nn.with_partitioning(
+                nn.initializers.normal(0.02), (None, "embed")),
+                (cfg.max_seq_len, cfg.hidden_size), jnp.float32)
+            x = x + pos_emb.astype(cfg.dtype)[positions]
+        x = constrain(x, BATCH, SEQ, EMBED)
+
+        block_cls = Block
+        if cfg.remat:
+            block_cls = nn.remat(Block, static_argnums=(4,),
+                                 policy=jax.checkpoint_policies.nothing_saveable)
+
+        new_caches = [] if kv_caches is not None else None
+        for i in range(cfg.num_layers):
+            use_moe = bool(cfg.moe) and (i % (cfg.moe.moe_layer_freq or 1) == 0)
+            cache = kv_caches[i] if kv_caches is not None else None
+            out = block_cls(cfg, use_moe=use_moe, name=f"layer_{i}")(
+                x, positions, cache, attn_mask, deterministic)
+            if kv_caches is not None:
+                x, c = out
+                new_caches.append(c)
+            else:
+                x = out
+
+        x = Norm(cfg, name="ln_final")(x)
+        if cfg.tie_embeddings:
+            logits = jnp.einsum("bse,ve->bsv", x, embed.astype(cfg.dtype))
+        else:
+            unembed = self.param("unembed", nn.with_partitioning(
+                nn.initializers.normal(0.02), ("embed", "vocab")),
+                (cfg.hidden_size, cfg.vocab_size), jnp.float32)
+            logits = jnp.einsum("bse,ev->bsv", x, unembed.astype(cfg.dtype))
+        logits = constrain(logits, BATCH, SEQ, None)
+        if kv_caches is not None:
+            return logits, new_caches
+        return logits

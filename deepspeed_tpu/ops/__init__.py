@@ -1,0 +1,11 @@
+from .attention import dot_product_attention  # noqa: F401
+from .optimizers import (  # noqa: F401
+    SGD,
+    Adagrad,
+    FusedAdam,
+    FusedLamb,
+    Lion,
+    OptState,
+    Optimizer,
+    build_optimizer,
+)

@@ -1,0 +1,64 @@
+"""Attention op dispatcher.
+
+The role of the reference fused attention kernels
+(/root/reference/csrc/transformer/*.cu softmax/attention paths and the
+blocked-flash FastGen kernels): one entry point that routes to
+- a Pallas flash-attention kernel on TPU (ops/pallas/flash_attention.py), or
+- a reference XLA implementation (fp32 softmax, GQA, causal/decode masks)
+  that compiles everywhere and is the numerics oracle for kernel tests.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+def _xla_attention(q, k, v, *, causal, positions, kv_len, mask):
+    B, Sq, H, D = q.shape
+    Skv, KV = k.shape[1], k.shape[2]
+    scale = 1.0 / (D ** 0.5)
+    if KV != H:
+        rep = H // KV
+        k = jnp.repeat(k, rep, axis=2)
+        v = jnp.repeat(v, rep, axis=2)
+    logits = jnp.einsum("bqhd,bkhd->bhqk", q, k).astype(jnp.float32) * scale
+
+    kv_pos = jnp.arange(Skv)[None, None, None, :]  # [1,1,1,Skv]
+    neg = jnp.finfo(jnp.float32).min
+    if positions is not None:
+        # decode/cached path: query i sits at absolute position positions[b,i]
+        q_pos = positions[:, None, :, None]        # [B,1,Sq,1]
+        allow = kv_pos <= q_pos
+        if kv_len is not None:
+            allow &= kv_pos < (kv_len if jnp.ndim(kv_len) == 0
+                               else kv_len[:, None, None, None])
+        logits = jnp.where(allow, logits, neg)
+    elif causal:
+        q_pos = jnp.arange(Sq)[None, None, :, None]
+        logits = jnp.where(kv_pos <= q_pos, logits, neg)
+    if mask is not None:
+        # mask: [B, Skv] (1 = attend) or broadcastable bool
+        m = mask[:, None, None, :] if mask.ndim == 2 else mask
+        logits = jnp.where(m.astype(bool), logits, neg)
+
+    weights = jax.nn.softmax(logits, axis=-1)
+    out = jnp.einsum("bhqk,bkhd->bqhd", weights.astype(v.dtype), v)
+    return out
+
+
+def dot_product_attention(q, k, v, *, causal: bool = True, positions=None,
+                          kv_len=None, mask=None, impl: str = "auto"):
+    """q: [B,Sq,H,D]; k/v: [B,Skv,KV,D] (KV divides H for GQA)."""
+    if impl in ("auto", "pallas"):
+        try:
+            from .pallas.flash_attention import flash_attention_usable, flash_attention
+
+            if flash_attention_usable(q, k, v, causal=causal, positions=positions,
+                                      mask=mask):
+                return flash_attention(q, k, v, causal=causal)
+        except ImportError:
+            pass
+        if impl == "pallas":
+            raise ValueError("pallas flash attention not usable for these inputs")
+    return _xla_attention(q, k, v, causal=causal, positions=positions,
+                          kv_len=kv_len, mask=mask)

@@ -1,0 +1,287 @@
+"""Fused optimizers.
+
+TPU-native equivalents of the reference native optimizer kernels:
+- FusedAdam/AdamW  — /root/reference/csrc/adam/multi_tensor_adam.cu:129 +
+  deepspeed/ops/adam/fused_adam.py:18
+- FusedLamb        — csrc/lamb/
+- Lion             — csrc/lion/
+- Adagrad          — csrc/adagrad/
+
+On GPU these exist because eager torch launches one kernel per tensor per op;
+the CUDA code fuses the update across the whole parameter list. Under XLA the
+same fusion falls out of compiling the (pure, pytree-wide) update function:
+every leaf's elementwise chain fuses into a handful of kernels, and sharded
+leaves update shard-locally (the ZeRO partitioned-step behavior). So the
+TPU-idiomatic "fused multi-tensor apply" is exactly this module under
+``jax.jit``. A Pallas HBM-bandwidth-optimal variant lives in
+``ops/pallas/fused_adam.py`` for the flat-buffer offload path.
+
+All optimizers are functional: ``init(params) -> state`` and
+``update(grads, state, params, lr) -> (new_params, new_state)``; both are
+traced inside the engine's train step. Moments are kept in fp32 regardless of
+param dtype (master-weight discipline is the engine's job).
+"""
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Any, NamedTuple
+
+import jax
+import jax.numpy as jnp
+
+Pytree = Any
+
+
+class OptState(NamedTuple):
+    step: jax.Array           # int32 scalar
+    mu: Pytree | None         # first moment / momentum
+    nu: Pytree | None         # second moment
+
+
+def _zeros_like(params: Pytree, dtype=None) -> Pytree:
+    return jax.tree.map(lambda p: jnp.zeros_like(p, dtype=dtype or p.dtype), params)
+
+
+@dataclass(frozen=True)
+class Optimizer:
+    lr: float = 1e-3
+    weight_decay: float = 0.0
+
+    def init(self, params: Pytree) -> OptState:
+        raise NotImplementedError
+
+    def update(self, grads: Pytree, state: OptState, params: Pytree,
+               lr: jax.Array | float | None = None) -> tuple[Pytree, OptState]:
+        raise NotImplementedError
+
+
+@dataclass(frozen=True)
+class FusedAdam(Optimizer):
+    """Adam/AdamW (reference csrc/adam/multi_tensor_adam.cu:129).
+
+    ``adamw_mode=True`` decouples weight decay (AdamW), matching the
+    reference frontend's ``adam_w_mode`` flag (ops/adam/fused_adam.py:50).
+    """
+    betas: tuple[float, float] = (0.9, 0.999)
+    eps: float = 1e-8
+    adamw_mode: bool = True
+    bias_correction: bool = True
+
+    def init(self, params: Pytree) -> OptState:
+        return OptState(step=jnp.zeros((), jnp.int32),
+                        mu=_zeros_like(params, jnp.float32),
+                        nu=_zeros_like(params, jnp.float32))
+
+    def update(self, grads, state, params, lr=None):
+        lr = self.lr if lr is None else lr
+        b1, b2 = self.betas
+        step = state.step + 1
+        stepf = step.astype(jnp.float32)
+        bc1 = 1.0 - b1 ** stepf if self.bias_correction else 1.0
+        bc2 = 1.0 - b2 ** stepf if self.bias_correction else 1.0
+
+        def new_m(g, m):
+            return b1 * m + (1.0 - b1) * g.astype(jnp.float32)
+
+        def new_v(g, v):
+            g = g.astype(jnp.float32)
+            return b2 * v + (1.0 - b2) * g * g
+
+        mu = jax.tree.map(new_m, grads, state.mu)
+        nu = jax.tree.map(new_v, grads, state.nu)
+
+        def new_p(p, g, m, v):
+            pf = p.astype(jnp.float32)
+            if not self.adamw_mode and self.weight_decay:
+                # L2 mode folds decay into the gradient *before* moments in
+                # the reference; approximate at the update for simplicity of
+                # the moment recurrences above.
+                m = m + self.weight_decay * pf * (1.0 - b1)
+            upd = (m / bc1) / (jnp.sqrt(v / bc2) + self.eps)
+            if self.adamw_mode and self.weight_decay:
+                upd = upd + self.weight_decay * pf
+            return (pf - lr * upd).astype(p.dtype)
+
+        params = jax.tree.map(new_p, params, grads, mu, nu)
+        return params, OptState(step=step, mu=mu, nu=nu)
+
+
+@dataclass(frozen=True)
+class Lion(Optimizer):
+    """Lion (reference csrc/lion/): sign of interpolated momentum."""
+    betas: tuple[float, float] = (0.9, 0.99)
+
+    def init(self, params):
+        return OptState(step=jnp.zeros((), jnp.int32),
+                        mu=_zeros_like(params, jnp.float32), nu=None)
+
+    def update(self, grads, state, params, lr=None):
+        lr = self.lr if lr is None else lr
+        b1, b2 = self.betas
+
+        def new_p(p, g, m):
+            pf = p.astype(jnp.float32)
+            upd = jnp.sign(b1 * m + (1.0 - b1) * g.astype(jnp.float32))
+            if self.weight_decay:
+                upd = upd + self.weight_decay * pf
+            return (pf - lr * upd).astype(p.dtype)
+
+        def new_m(g, m):
+            return b2 * m + (1.0 - b2) * g.astype(jnp.float32)
+
+        params_out = jax.tree.map(new_p, params, grads, state.mu)
+        mu = jax.tree.map(new_m, grads, state.mu)
+        return params_out, OptState(step=state.step + 1, mu=mu, nu=None)
+
+
+@dataclass(frozen=True)
+class FusedLamb(Optimizer):
+    """LAMB (reference csrc/lamb/fused_lamb_cuda_kernel.cu): Adam direction
+    scaled by a per-tensor trust ratio."""
+    betas: tuple[float, float] = (0.9, 0.999)
+    eps: float = 1e-6
+    max_trust_ratio: float = 10.0
+
+    def init(self, params):
+        return OptState(step=jnp.zeros((), jnp.int32),
+                        mu=_zeros_like(params, jnp.float32),
+                        nu=_zeros_like(params, jnp.float32))
+
+    def update(self, grads, state, params, lr=None):
+        lr = self.lr if lr is None else lr
+        b1, b2 = self.betas
+        step = state.step + 1
+        stepf = step.astype(jnp.float32)
+        bc1, bc2 = 1.0 - b1 ** stepf, 1.0 - b2 ** stepf
+
+        mu = jax.tree.map(lambda g, m: b1 * m + (1.0 - b1) * g.astype(jnp.float32),
+                          grads, state.mu)
+        nu = jax.tree.map(
+            lambda g, v: b2 * v + (1.0 - b2) * jnp.square(g.astype(jnp.float32)),
+            grads, state.nu)
+
+        def new_p(p, m, v):
+            pf = p.astype(jnp.float32)
+            upd = (m / bc1) / (jnp.sqrt(v / bc2) + self.eps)
+            if self.weight_decay:
+                upd = upd + self.weight_decay * pf
+            w_norm = jnp.linalg.norm(pf.reshape(-1))
+            u_norm = jnp.linalg.norm(upd.reshape(-1))
+            trust = jnp.where((w_norm > 0) & (u_norm > 0),
+                              jnp.clip(w_norm / u_norm, 0.0, self.max_trust_ratio), 1.0)
+            return (pf - lr * trust * upd).astype(p.dtype)
+
+        params = jax.tree.map(new_p, params, mu, nu)
+        return params, OptState(step=step, mu=mu, nu=nu)
+
+
+@dataclass(frozen=True)
+class Adagrad(Optimizer):
+    """Adagrad (reference csrc/adagrad/cpu_adagrad.cpp)."""
+    eps: float = 1e-10
+
+    def init(self, params):
+        return OptState(step=jnp.zeros((), jnp.int32), mu=None,
+                        nu=_zeros_like(params, jnp.float32))
+
+    def update(self, grads, state, params, lr=None):
+        lr = self.lr if lr is None else lr
+
+        def g_eff(p, g):
+            g = g.astype(jnp.float32)
+            return g + self.weight_decay * p.astype(jnp.float32) if self.weight_decay else g
+
+        nu = jax.tree.map(lambda p, g, v: v + jnp.square(g_eff(p, g)),
+                          params, grads, state.nu)
+        params_out = jax.tree.map(
+            lambda p, g, v: (p.astype(jnp.float32)
+                             - lr * g_eff(p, g) / (jnp.sqrt(v) + self.eps)).astype(p.dtype),
+            params, grads, nu)
+        return params_out, OptState(step=state.step + 1, mu=None, nu=nu)
+
+
+@dataclass(frozen=True)
+class SGD(Optimizer):
+    momentum: float = 0.0
+    nesterov: bool = False
+
+    def init(self, params):
+        mu = _zeros_like(params, jnp.float32) if self.momentum else None
+        return OptState(step=jnp.zeros((), jnp.int32), mu=mu, nu=None)
+
+    def update(self, grads, state, params, lr=None):
+        lr = self.lr if lr is None else lr
+
+        def g_eff(p, g):
+            g = g.astype(jnp.float32)
+            return g + self.weight_decay * p.astype(jnp.float32) if self.weight_decay else g
+
+        if state.mu is not None:
+            mu = jax.tree.map(lambda p, g, m: self.momentum * m + g_eff(p, g),
+                              params, grads, state.mu)
+            if self.nesterov:
+                direction = jax.tree.map(lambda p, g, m: g_eff(p, g) + self.momentum * m,
+                                         params, grads, mu)
+            else:
+                direction = mu
+        else:
+            mu = None
+            direction = jax.tree.map(g_eff, params, grads)
+        params_out = jax.tree.map(
+            lambda p, d: (p.astype(jnp.float32) - lr * d).astype(p.dtype), params, direction)
+        return params_out, OptState(step=state.step + 1, mu=mu, nu=None)
+
+
+# --------------------------------------------------------------------------
+# Registry resolving DeepSpeed optimizer-section names
+# (reference runtime/engine.py:1322 _configure_basic_optimizer)
+# --------------------------------------------------------------------------
+
+def build_optimizer(type_name: str, params: dict[str, Any]) -> Optimizer:
+    name = type_name.lower()
+    p = dict(params)
+    p.pop("torch_adam", None)
+    adam_w_mode = p.pop("adam_w_mode", None)
+    betas = tuple(p.pop("betas")) if "betas" in p else None
+    lr = p.pop("lr", 1e-3)
+    wd = p.pop("weight_decay", 0.0)
+    eps = p.pop("eps", None)
+    # 1-bit/zero-one variants fall back to their dense counterparts; the
+    # compressed-allreduce path is a comm-layer feature on TPU (quantized
+    # collectives), not an optimizer variant. Drop their comm-only knobs.
+    for k in ("freeze_step", "cuda_aware", "comm_backend_name", "var_freeze_step",
+              "var_update_scaler", "local_step_scaler", "local_step_clipper"):
+        p.pop(k, None)
+
+    if name in ("adam", "adamw", "fusedadam", "onebitadam", "zerooneadam"):
+        mode = adam_w_mode if adam_w_mode is not None else (name != "adam")
+        kw: dict[str, Any] = dict(lr=lr, weight_decay=wd, adamw_mode=bool(mode))
+        if betas:
+            kw["betas"] = betas
+        if eps is not None:
+            kw["eps"] = eps
+        kw.update(p)
+        return FusedAdam(**kw)
+    if name == "lion":
+        kw = dict(lr=lr, weight_decay=wd)
+        if betas:
+            kw["betas"] = betas
+        kw.update(p)
+        return Lion(**kw)
+    if name in ("lamb", "fusedlamb", "onebitlamb"):
+        kw = dict(lr=lr, weight_decay=wd)
+        if betas:
+            kw["betas"] = betas
+        if eps is not None:
+            kw["eps"] = eps
+        kw.update(p)
+        return FusedLamb(**kw)
+    if name == "adagrad":
+        kw = dict(lr=lr, weight_decay=wd)
+        if eps is not None:
+            kw["eps"] = eps
+        kw.update(p)
+        return Adagrad(**kw)
+    if name == "sgd":
+        return SGD(lr=lr, weight_decay=wd, **p)
+    raise ValueError(f"unknown optimizer type: {type_name}")

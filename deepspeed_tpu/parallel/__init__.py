@@ -1,0 +1,8 @@
+from .topology import (  # noqa: F401
+    AXIS_ORDER,
+    BATCH_AXES,
+    GRAD_REDUCE_AXES,
+    MeshConfig,
+    MeshTopology,
+    single_device_topology,
+)

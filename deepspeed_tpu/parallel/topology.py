@@ -1,0 +1,169 @@
+"""Device mesh topology — the parallelism substrate.
+
+TPU-native replacement for the reference's process-group zoo
+(/root/reference/deepspeed/utils/groups.py, runtime/pipe/topology.py:12,244).
+The reference composes parallelism by carving torch.distributed process
+groups out of the world (expert groups :117, ZeRO param groups :529, sequence
+groups :472, 3D ``PipeModelDataParallelTopology`` topology.py:244). On TPU
+the same algebra is a single ``jax.sharding.Mesh`` with named axes; every
+"group" is a mesh axis and every grouped collective is an axis-named
+collective.
+
+Axes (any may be size 1):
+
+- ``pipe``   — pipeline stages (outermost: stages may cross DCN).
+- ``data``   — pure data-parallel replicas.
+- ``expert`` — expert parallelism; carved from the DP world like the
+  reference's expert-parallel groups, so the batch is also sharded over it.
+- ``fsdp``   — ZeRO parameter/optimizer sharding axis (also data-parallel
+  over the batch).
+- ``seq``    — Ulysses-style sequence parallelism.
+- ``tensor`` — tensor (model) parallelism, innermost so TP collectives ride
+  adjacent-chip ICI links.
+
+The data-parallel world of the reference (= ZeRO partition world) maps to
+``data × expert × fsdp``; batch dims shard over those three axes, sequence
+dims over ``seq``.
+"""
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Any, Sequence
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from ..utils.logging import logger
+
+AXIS_ORDER = ("pipe", "data", "expert", "fsdp", "seq", "tensor")
+BATCH_AXES = ("data", "expert", "fsdp")  # reference DP world
+GRAD_REDUCE_AXES = ("data", "expert", "fsdp", "seq")
+
+
+@dataclass
+class MeshConfig:
+    """Sizes per axis; ``-1``/``"auto"`` on at most one axis absorbs the
+    remaining devices."""
+    pipe: int = 1
+    data: int | str = "auto"
+    expert: int = 1
+    fsdp: int = 1
+    seq: int = 1
+    tensor: int = 1
+
+    @classmethod
+    def from_dict(cls, d: dict[str, Any] | None) -> "MeshConfig":
+        d = dict(d or {})
+        known = {f for f in cls.__dataclass_fields__}
+        unknown = set(d) - known
+        if unknown:
+            raise ValueError(f"unknown mesh axes: {sorted(unknown)} (known: {sorted(known)})")
+        return cls(**d)
+
+    def resolve(self, num_devices: int) -> dict[str, int]:
+        sizes: dict[str, int] = {}
+        auto_axes = []
+        for name in AXIS_ORDER:
+            v = getattr(self, name)
+            if v in ("auto", -1, None):
+                auto_axes.append(name)
+            else:
+                v = int(v)
+                if v < 1:
+                    raise ValueError(f"mesh axis {name} must be >= 1, got {v}")
+                sizes[name] = v
+        fixed = int(np.prod(list(sizes.values()))) if sizes else 1
+        if len(auto_axes) > 1:
+            raise ValueError(f"only one mesh axis may be 'auto', got {auto_axes}")
+        if auto_axes:
+            if num_devices % fixed != 0:
+                raise ValueError(
+                    f"device count {num_devices} not divisible by fixed mesh product {fixed}")
+            sizes[auto_axes[0]] = num_devices // fixed
+        else:
+            if fixed != num_devices:
+                raise ValueError(
+                    f"mesh product {fixed} != device count {num_devices}; "
+                    f"set one axis to 'auto' or fix the sizes")
+        return {name: sizes[name] for name in AXIS_ORDER}
+
+
+class MeshTopology:
+    """One named mesh + the sharding vocabulary built on it."""
+
+    def __init__(self, config: MeshConfig | dict | None = None,
+                 devices: Sequence[Any] | None = None):
+        if isinstance(config, dict) or config is None:
+            config = MeshConfig.from_dict(config)
+        self.config = config
+        devices = list(devices if devices is not None else jax.devices())
+        self.axis_sizes = config.resolve(len(devices))
+        shape = tuple(self.axis_sizes[a] for a in AXIS_ORDER)
+        dev_array = np.asarray(devices).reshape(shape)
+        self.mesh = Mesh(dev_array, AXIS_ORDER)
+        logger.info("mesh: " + " ".join(f"{a}={s}" for a, s in self.axis_sizes.items()
+                                        if s > 1) or "mesh: single device")
+
+    # -- sizes ------------------------------------------------------------
+    def size(self, axis: str) -> int:
+        return self.axis_sizes[axis]
+
+    @property
+    def num_devices(self) -> int:
+        return int(np.prod(list(self.axis_sizes.values())))
+
+    @property
+    def dp_world_size(self) -> int:
+        """Reference data-parallel world (= ZeRO partition count)."""
+        return self.size("data") * self.size("expert") * self.size("fsdp")
+
+    @property
+    def tp_world_size(self) -> int:
+        return self.size("tensor")
+
+    @property
+    def sp_world_size(self) -> int:
+        return self.size("seq")
+
+    @property
+    def ep_world_size(self) -> int:
+        return self.size("expert")
+
+    @property
+    def pp_world_size(self) -> int:
+        return self.size("pipe")
+
+    # -- shardings --------------------------------------------------------
+    def sharding(self, *spec) -> NamedSharding:
+        return NamedSharding(self.mesh, P(*spec))
+
+    def replicated(self) -> NamedSharding:
+        return NamedSharding(self.mesh, P())
+
+    def batch_spec(self, ndim: int = 2, seq_dim: int | None = 1) -> P:
+        """Spec for an input batch: dim 0 over the DP world, ``seq_dim``
+        over ``seq``."""
+        entries: list[Any] = [None] * ndim
+        entries[0] = BATCH_AXES
+        if seq_dim is not None and self.size("seq") > 1 and ndim > seq_dim:
+            entries[seq_dim] = "seq"
+        return P(*entries)
+
+    def batch_sharding(self, ndim: int = 2, seq_dim: int | None = 1) -> NamedSharding:
+        return NamedSharding(self.mesh, self.batch_spec(ndim, seq_dim))
+
+    def __enter__(self):
+        self._ctx = self.mesh
+        self._ctx.__enter__()
+        return self
+
+    def __exit__(self, *exc):
+        return self._ctx.__exit__(*exc)
+
+    def __repr__(self) -> str:
+        return f"MeshTopology({self.axis_sizes})"
+
+
+def single_device_topology() -> MeshTopology:
+    return MeshTopology(MeshConfig(data=1), devices=jax.devices()[:1])

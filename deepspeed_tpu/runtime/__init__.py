@@ -1,0 +1,1 @@
+from .engine import DeepSpeedEngine, TrainState, initialize  # noqa: F401

@@ -1,0 +1,65 @@
+"""Dynamic loss scaling for fp16 training.
+
+Functional re-design of /root/reference/deepspeed/runtime/fp16/loss_scaler.py
+(``DynamicLossScaler`` :91): the scaler is a small pytree carried in the
+train state and every decision (overflow check, scale up/down, skip step) is
+traced arithmetic, so the whole thing lives inside the jitted train step —
+no host sync per step, unlike the reference's ``.item()`` overflow checks.
+
+bf16 training (the TPU default) needs none of this; the engine only wires it
+when ``fp16.enabled`` is set.
+"""
+from __future__ import annotations
+
+from typing import Any, NamedTuple
+
+import jax
+import jax.numpy as jnp
+
+from ..config import FP16Config
+
+
+class ScalerState(NamedTuple):
+    scale: jax.Array        # f32 scalar
+    good_steps: jax.Array   # i32 consecutive non-overflow steps
+    hysteresis: jax.Array   # i32 remaining tolerated overflows before shrink
+
+
+def init_scaler(cfg: FP16Config) -> ScalerState:
+    scale = cfg.loss_scale if cfg.loss_scale else float(2 ** cfg.initial_scale_power)
+    return ScalerState(scale=jnp.asarray(scale, jnp.float32),
+                       good_steps=jnp.zeros((), jnp.int32),
+                       hysteresis=jnp.asarray(cfg.hysteresis, jnp.int32))
+
+
+def grads_finite(grads: Any) -> jax.Array:
+    leaves = jax.tree.leaves(grads)
+    finite = jnp.asarray(True)
+    for leaf in leaves:
+        finite &= jnp.all(jnp.isfinite(leaf))
+    return finite
+
+
+def update_scaler(state: ScalerState, finite: jax.Array, cfg: FP16Config) -> ScalerState:
+    """Reference loss_scaler.py ``update_scale``: shrink ×0.5 on overflow
+    (after hysteresis), grow ×2 every ``loss_scale_window`` clean steps."""
+    if cfg.loss_scale:  # static loss scale
+        return state
+
+    def on_overflow(s: ScalerState) -> ScalerState:
+        hyst = s.hysteresis - 1
+        new_scale = jnp.where(hyst <= 0,
+                              jnp.maximum(s.scale / 2.0, cfg.min_loss_scale),
+                              s.scale)
+        new_hyst = jnp.where(hyst <= 0, jnp.asarray(cfg.hysteresis, jnp.int32), hyst)
+        return ScalerState(scale=new_scale, good_steps=jnp.zeros((), jnp.int32),
+                           hysteresis=new_hyst)
+
+    def on_clean(s: ScalerState) -> ScalerState:
+        grow = (s.good_steps + 1) >= cfg.loss_scale_window
+        return ScalerState(
+            scale=jnp.where(grow, s.scale * 2.0, s.scale),
+            good_steps=jnp.where(grow, 0, s.good_steps + 1).astype(jnp.int32),
+            hysteresis=jnp.asarray(cfg.hysteresis, jnp.int32))
+
+    return jax.lax.cond(finite, on_clean, on_overflow, state)

@@ -1,0 +1,128 @@
+"""Learning-rate schedules.
+
+TPU-native analogue of /root/reference/deepspeed/runtime/lr_schedules.py
+(WarmupLR, WarmupDecayLR, WarmupCosineLR, OneCycle, LRRangeTest). Schedules
+are pure ``step -> lr`` functions of a traced int32 step so they can live
+inside the jitted train step; ``build_scheduler`` resolves the DeepSpeed
+``scheduler`` config section by name.
+"""
+from __future__ import annotations
+
+import math
+from typing import Any, Callable
+
+import jax.numpy as jnp
+
+Schedule = Callable[[Any], Any]  # step (int array) -> lr (float array)
+
+
+def constant_lr(lr: float) -> Schedule:
+    return lambda step: jnp.asarray(lr, jnp.float32)
+
+
+def warmup_lr(warmup_min_lr: float = 0.0, warmup_max_lr: float = 1e-3,
+              warmup_num_steps: int = 1000, warmup_type: str = "log") -> Schedule:
+    """Reference ``WarmupLR`` (lr_schedules.py:736): warm up then hold."""
+    warmup_num_steps = max(warmup_num_steps, 1)
+
+    def fn(step):
+        s = jnp.minimum(step.astype(jnp.float32) + 1.0, float(warmup_num_steps))
+        if warmup_type == "log":
+            frac = jnp.log(s) / math.log(warmup_num_steps) if warmup_num_steps > 1 else 1.0
+        else:  # linear
+            frac = s / warmup_num_steps
+        return warmup_min_lr + (warmup_max_lr - warmup_min_lr) * jnp.minimum(frac, 1.0)
+
+    return fn
+
+
+def warmup_decay_lr(total_num_steps: int, warmup_min_lr: float = 0.0,
+                    warmup_max_lr: float = 1e-3, warmup_num_steps: int = 1000,
+                    warmup_type: str = "log") -> Schedule:
+    """Reference ``WarmupDecayLR`` (lr_schedules.py:816): warmup then linear
+    decay to zero at ``total_num_steps``."""
+    warm = warmup_lr(warmup_min_lr, warmup_max_lr, warmup_num_steps, warmup_type)
+
+    def fn(step):
+        stepf = step.astype(jnp.float32)
+        decay = jnp.clip((total_num_steps - stepf) /
+                         max(total_num_steps - warmup_num_steps, 1), 0.0, 1.0)
+        return jnp.where(stepf < warmup_num_steps, warm(step), warmup_max_lr * decay)
+
+    return fn
+
+
+def warmup_cosine_lr(total_num_steps: int, warmup_min_ratio: float = 0.0,
+                     warmup_num_steps: int = 1000, cos_min_ratio: float = 0.0001,
+                     warmup_type: str = "linear", lr: float = 1e-3) -> Schedule:
+    """Reference ``WarmupCosineLR`` (lr_schedules.py:856)."""
+
+    def fn(step):
+        stepf = step.astype(jnp.float32)
+        warm_frac = jnp.clip(stepf / max(warmup_num_steps, 1), 0.0, 1.0)
+        warm_ratio = warmup_min_ratio + (1.0 - warmup_min_ratio) * warm_frac
+        progress = jnp.clip((stepf - warmup_num_steps) /
+                            max(total_num_steps - warmup_num_steps, 1), 0.0, 1.0)
+        cos_ratio = cos_min_ratio + (1.0 - cos_min_ratio) * 0.5 * (
+            1.0 + jnp.cos(math.pi * progress))
+        return lr * jnp.where(stepf < warmup_num_steps, warm_ratio, cos_ratio)
+
+    return fn
+
+
+def one_cycle(cycle_min_lr: float, cycle_max_lr: float, cycle_first_step_size: int = 2000,
+              cycle_second_step_size: int | None = None, decay_step_size: int = 0,
+              decay_lr_rate: float = 0.0, **_ignored) -> Schedule:
+    """Reference ``OneCycle`` (lr_schedules.py:433), LR triangle + optional decay.
+    Momentum cycling is not modeled (optimizer betas are static under jit)."""
+    second = cycle_second_step_size if cycle_second_step_size is not None else cycle_first_step_size
+    cycle_len = cycle_first_step_size + second
+
+    def fn(step):
+        stepf = step.astype(jnp.float32)
+        up = jnp.clip(stepf / cycle_first_step_size, 0.0, 1.0)
+        down = jnp.clip((stepf - cycle_first_step_size) / max(second, 1), 0.0, 1.0)
+        in_cycle = cycle_min_lr + (cycle_max_lr - cycle_min_lr) * jnp.where(
+            stepf < cycle_first_step_size, up, 1.0 - down)
+        post = stepf - cycle_len
+        decayed = cycle_min_lr / (1.0 + decay_lr_rate * jnp.maximum(post, 0.0) /
+                                  max(decay_step_size, 1)) if decay_step_size else cycle_min_lr
+        return jnp.where(stepf < cycle_len, in_cycle, decayed)
+
+    return fn
+
+
+def lr_range_test(lr_range_test_min_lr: float = 1e-3, lr_range_test_step_size: int = 2000,
+                  lr_range_test_step_rate: float = 1.0,
+                  lr_range_test_staircase: bool = False) -> Schedule:
+    """Reference ``LRRangeTest`` (lr_schedules.py:335)."""
+
+    def fn(step):
+        stepf = step.astype(jnp.float32)
+        interval = (jnp.floor(stepf / lr_range_test_step_size) if lr_range_test_staircase
+                    else stepf / lr_range_test_step_size)
+        return lr_range_test_min_lr * (1.0 + interval * lr_range_test_step_rate)
+
+    return fn
+
+
+SCHEDULES = {
+    "warmuplr": warmup_lr,
+    "warmupdecaylr": warmup_decay_lr,
+    "warmupcosinelr": warmup_cosine_lr,
+    "onecycle": one_cycle,
+    "lrrangetest": lr_range_test,
+}
+
+
+def build_scheduler(type_name: str, params: dict[str, Any],
+                    base_lr: float | None = None) -> Schedule:
+    """Resolve the DeepSpeed ``scheduler`` section (reference
+    runtime/engine.py:954 _configure_lr_scheduler)."""
+    name = type_name.lower()
+    if name not in SCHEDULES:
+        raise ValueError(f"unknown scheduler type: {type_name}; known: {sorted(SCHEDULES)}")
+    params = dict(params)
+    if name == "warmupcosinelr" and base_lr is not None and "lr" not in params:
+        params["lr"] = base_lr
+    return SCHEDULES[name](**params)

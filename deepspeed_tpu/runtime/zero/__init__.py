@@ -1,0 +1,1 @@
+from .planner import ZeroPlan, build_plan, unbox_params  # noqa: F401

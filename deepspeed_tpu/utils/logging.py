@@ -1,0 +1,71 @@
+"""Logging utilities.
+
+TPU-native analogue of the reference logging layer
+(/root/reference/deepspeed/utils/logging.py): a package logger plus
+``log_dist`` which restricts emission to chosen process indices. In a JAX
+SPMD program there is one Python process per host (often exactly one), so
+"rank" here is ``jax.process_index()``.
+"""
+from __future__ import annotations
+
+import functools
+import logging
+import os
+import sys
+
+LOG_LEVELS = {
+    "debug": logging.DEBUG,
+    "info": logging.INFO,
+    "warning": logging.WARNING,
+    "error": logging.ERROR,
+    "critical": logging.CRITICAL,
+}
+
+
+@functools.lru_cache(None)
+def _create_logger(name: str = "deepspeed_tpu", level: int | None = None) -> logging.Logger:
+    if level is None:
+        level = LOG_LEVELS.get(os.environ.get("DS_TPU_LOG_LEVEL", "info").lower(), logging.INFO)
+    logger_ = logging.getLogger(name)
+    logger_.setLevel(level)
+    logger_.propagate = False
+    if not logger_.handlers:
+        handler = logging.StreamHandler(stream=sys.stdout)
+        handler.setLevel(level)
+        formatter = logging.Formatter(
+            "[%(asctime)s] [%(levelname)s] [%(name)s] %(message)s", datefmt="%Y-%m-%d %H:%M:%S")
+        handler.setFormatter(formatter)
+        logger_.addHandler(handler)
+    return logger_
+
+
+logger = _create_logger()
+
+
+def _process_index() -> int:
+    try:
+        import jax
+
+        return jax.process_index()
+    except Exception:
+        return 0
+
+
+def log_dist(message: str, ranks: list[int] | None = None, level: int = logging.INFO) -> None:
+    """Log ``message`` only on the given process indices (default: process 0).
+
+    ``ranks=[-1]`` logs on every process.
+    """
+    my_rank = _process_index()
+    ranks = ranks or [0]
+    if -1 in ranks or my_rank in ranks:
+        logger.log(level, f"[rank {my_rank}] {message}")
+
+
+def warning_once(message: str) -> None:
+    _warn_once(message)
+
+
+@functools.lru_cache(None)
+def _warn_once(message: str) -> None:
+    logger.warning(message)

@@ -1,0 +1,75 @@
+"""Checkpoint tests: save/resume + universal reshard-on-load
+(contract of reference tests/unit/checkpoint/ suite)."""
+import numpy as np
+import pytest
+
+import deepspeed_tpu as ds
+from deepspeed_tpu.models import build_model
+
+
+def cfg(stage=2, mesh=None):
+    return {
+        "train_micro_batch_size_per_gpu": 2,
+        "optimizer": {"type": "AdamW", "params": {"lr": 1e-2}},
+        "zero_optimization": {"stage": stage},
+        "mesh": mesh or {"fsdp": 8},
+        "steps_per_print": 10_000,
+    }
+
+
+def make_engine(config):
+    return ds.initialize(model=build_model("tiny-gpt2"), config=config)[0]
+
+
+def batch(B, seed=0):
+    rng = np.random.default_rng(seed)
+    return {"input_ids": rng.integers(0, 256, (B, 32)).astype(np.int32)}
+
+
+def test_save_load_roundtrip(tmp_path):
+    engine = make_engine(cfg())
+    b = batch(engine.config.train_batch_size)
+    for _ in range(2):
+        engine.train_batch(b)
+    engine.save_checkpoint(str(tmp_path), tag="ckpt1",
+                           client_state={"epoch": 3})
+    loss_before = float(engine.eval_batch(batch(16, seed=5)))
+
+    engine2 = make_engine(cfg())
+    client = engine2.load_checkpoint(str(tmp_path), tag="ckpt1")
+    assert client == {"epoch": 3}
+    assert engine2.global_steps == engine.global_steps
+    loss_after = float(engine2.eval_batch(batch(16, seed=5)))
+    assert loss_after == pytest.approx(loss_before, rel=1e-5)
+
+    # training continues identically
+    la = float(engine.train_batch(b))
+    lb = float(engine2.train_batch(b))
+    assert la == pytest.approx(lb, rel=1e-3)
+
+
+def test_latest_tag(tmp_path):
+    engine = make_engine(cfg())
+    engine.train_batch(batch(engine.config.train_batch_size))
+    engine.save_checkpoint(str(tmp_path))  # auto tag
+    engine2 = make_engine(cfg())
+    engine2.load_checkpoint(str(tmp_path))  # via 'latest'
+    assert engine2.global_steps == engine.global_steps
+
+
+def test_universal_resume_different_topology(tmp_path):
+    """Save under stage 2 / fsdp8, resume under stage 3 / fsdp2×data4 —
+    the reference needs ds_to_universal for this; here it's the default."""
+    engine = make_engine(cfg(stage=2, mesh={"fsdp": 8}))
+    b = batch(engine.config.train_batch_size)
+    engine.train_batch(b)
+    engine.save_checkpoint(str(tmp_path), tag="u")
+    ref_loss = float(engine.eval_batch(batch(16, seed=7)))
+
+    engine2 = make_engine(cfg(stage=3, mesh={"fsdp": 2, "data": 4}))
+    engine2.load_checkpoint(str(tmp_path), tag="u")
+    new_loss = float(engine2.eval_batch(batch(16, seed=7)))
+    assert new_loss == pytest.approx(ref_loss, rel=1e-3)
+    # and it keeps training
+    l = float(engine2.train_batch(b))
+    assert np.isfinite(l)

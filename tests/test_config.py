@@ -1,0 +1,80 @@
+"""Config system tests (contract of reference runtime/config.py:706)."""
+import pytest
+
+from deepspeed_tpu.config import Config
+
+
+def test_defaults():
+    cfg = Config()
+    assert cfg.zero_optimization.stage == 0
+    assert cfg.bf16.enabled
+    assert not cfg.fp16.enabled
+
+
+def test_from_dict_deepspeed_style():
+    cfg = Config.from_dict({
+        "train_batch_size": 32,
+        "train_micro_batch_size_per_gpu": 4,
+        "steps_per_print": 100,
+        "gradient_clipping": 1.0,
+        "optimizer": {"type": "AdamW", "params": {"lr": 1e-3, "weight_decay": 0.01}},
+        "scheduler": {"type": "WarmupLR", "params": {"warmup_num_steps": 10}},
+        "bf16": {"enabled": True},
+        "zero_optimization": {
+            "stage": 3,
+            "overlap_comm": True,
+            "reduce_bucket_size": 1000000,
+            "offload_optimizer": {"device": "cpu"},
+        },
+        "mesh": {"fsdp": 4, "tensor": 2, "data": 1},
+    })
+    assert cfg.zero_optimization.stage == 3
+    assert cfg.zero_optimization.offload_optimizer.device == "cpu"
+    assert cfg.optimizer.params["lr"] == 1e-3
+    assert cfg.mesh.fsdp == 4
+
+
+def test_unknown_key_rejected():
+    with pytest.raises(ValueError, match="unknown top-level"):
+        Config.from_dict({"no_such_section_xyz": 1})
+    with pytest.raises(ValueError, match="unknown keys"):
+        Config.from_dict({"zero_optimization": {"staage": 2}})
+
+
+def test_gpu_only_keys_ignored():
+    cfg = Config.from_dict({
+        "amp": {"enabled": True},
+        "zero_optimization": {"stage": 2, "allgather_partitions": True},
+    })
+    assert cfg.zero_optimization.stage == 2
+
+
+def test_batch_reconciliation():
+    cfg = Config.from_dict({"train_batch_size": 32, "train_micro_batch_size_per_gpu": 4})
+    cfg.resolve_batch_terms(dp_world_size=2)
+    assert cfg.gradient_accumulation_steps == 4
+
+    cfg = Config.from_dict({"train_micro_batch_size_per_gpu": 4,
+                            "gradient_accumulation_steps": 2})
+    cfg.resolve_batch_terms(dp_world_size=8)
+    assert cfg.train_batch_size == 64
+
+    cfg = Config.from_dict({"train_batch_size": 30})
+    with pytest.raises(ValueError):
+        cfg.resolve_batch_terms(dp_world_size=8)
+
+
+def test_batch_inconsistent_rejected():
+    cfg = Config.from_dict({
+        "train_batch_size": 32,
+        "train_micro_batch_size_per_gpu": 4,
+        "gradient_accumulation_steps": 4,
+    })
+    with pytest.raises(ValueError, match="inconsistent"):
+        cfg.resolve_batch_terms(dp_world_size=4)
+
+
+def test_fp16_dynamic_scale_defaults():
+    cfg = Config.from_dict({"fp16": {"enabled": True}})
+    assert cfg.fp16.initial_scale_power == 16
+    assert cfg.fp16.loss_scale == 0.0

@@ -1,0 +1,63 @@
+"""LR schedule tests (contract of reference runtime/lr_schedules.py)."""
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from deepspeed_tpu.runtime.lr_schedules import build_scheduler
+
+
+def lr_at(sched, step):
+    return float(sched(jnp.asarray(step, jnp.int32)))
+
+
+def test_warmup_lr_linear():
+    s = build_scheduler("WarmupLR", {"warmup_min_lr": 0.0, "warmup_max_lr": 1e-2,
+                                     "warmup_num_steps": 10, "warmup_type": "linear"})
+    assert lr_at(s, 0) == pytest.approx(1e-3)
+    assert lr_at(s, 9) == pytest.approx(1e-2)
+    assert lr_at(s, 100) == pytest.approx(1e-2)  # hold
+
+
+def test_warmup_lr_log_reaches_max():
+    s = build_scheduler("WarmupLR", {"warmup_max_lr": 1e-2, "warmup_num_steps": 100})
+    assert lr_at(s, 99) == pytest.approx(1e-2, rel=1e-2)
+    assert lr_at(s, 0) < lr_at(s, 50) < lr_at(s, 99)
+
+
+def test_warmup_decay_lr():
+    s = build_scheduler("WarmupDecayLR", {
+        "total_num_steps": 100, "warmup_max_lr": 1e-2, "warmup_num_steps": 10,
+        "warmup_type": "linear"})
+    assert lr_at(s, 9) == pytest.approx(1e-2)
+    assert lr_at(s, 55) == pytest.approx(1e-2 * 0.5, rel=1e-2)
+    assert lr_at(s, 100) == pytest.approx(0.0, abs=1e-9)
+
+
+def test_warmup_cosine_lr():
+    s = build_scheduler("WarmupCosineLR", {
+        "total_num_steps": 100, "warmup_num_steps": 10}, base_lr=1e-2)
+    assert lr_at(s, 10) == pytest.approx(1e-2, rel=1e-2)
+    mid = lr_at(s, 55)
+    assert 0 < mid < 1e-2
+    assert lr_at(s, 100) == pytest.approx(1e-2 * 1e-4, rel=0.1)
+
+
+def test_one_cycle():
+    s = build_scheduler("OneCycle", {"cycle_min_lr": 1e-4, "cycle_max_lr": 1e-2,
+                                     "cycle_first_step_size": 10})
+    assert lr_at(s, 0) == pytest.approx(1e-4)
+    assert lr_at(s, 10) == pytest.approx(1e-2)
+    assert lr_at(s, 20) == pytest.approx(1e-4)
+
+
+def test_lr_range_test():
+    s = build_scheduler("LRRangeTest", {"lr_range_test_min_lr": 1e-4,
+                                        "lr_range_test_step_size": 10,
+                                        "lr_range_test_step_rate": 1.0})
+    assert lr_at(s, 0) == pytest.approx(1e-4)
+    assert lr_at(s, 10) == pytest.approx(2e-4)
+
+
+def test_unknown_scheduler():
+    with pytest.raises(ValueError):
+        build_scheduler("NoSuchSched", {})

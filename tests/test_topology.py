@@ -1,0 +1,50 @@
+"""Mesh topology tests (role of reference utils/groups.py + pipe/topology.py)."""
+import numpy as np
+import pytest
+from jax.sharding import PartitionSpec as P
+
+from deepspeed_tpu.parallel.topology import MeshConfig, MeshTopology
+
+
+def test_auto_axis_resolution():
+    topo = MeshTopology({"fsdp": 4})
+    assert topo.size("fsdp") == 4
+    assert topo.size("data") == 2  # 8 devices / 4
+    assert topo.dp_world_size == 8
+
+
+def test_fixed_sizes():
+    topo = MeshTopology({"data": 2, "fsdp": 2, "tensor": 2})
+    assert topo.num_devices == 8
+    assert topo.tp_world_size == 2
+
+
+def test_mismatched_product_rejected():
+    with pytest.raises(ValueError):
+        MeshTopology({"data": 3, "fsdp": 2})  # 6 != 8, no auto
+
+
+def test_two_autos_rejected():
+    with pytest.raises(ValueError):
+        MeshConfig(data="auto", fsdp="auto").resolve(8)
+
+
+def test_batch_spec_includes_seq():
+    topo = MeshTopology({"data": 2, "seq": 4})
+    spec = topo.batch_spec(ndim=2)
+    assert spec == P(("data", "expert", "fsdp"), "seq")
+
+    topo2 = MeshTopology({"data": 8})
+    assert topo2.batch_spec(ndim=2) == P(("data", "expert", "fsdp"), None)
+
+
+def test_batch_sharding_places_data():
+    import jax
+    import jax.numpy as jnp
+
+    topo = MeshTopology({"data": 4, "seq": 2})
+    x = jnp.zeros((8, 16))
+    y = jax.device_put(x, topo.batch_sharding(ndim=2))
+    # each device holds 8/4 x 16/2
+    shard = y.addressable_shards[0]
+    assert shard.data.shape == (2, 8)
