@@ -60,6 +60,7 @@ def main():
     batch = {"input_ids": rng.integers(0, model.config.vocab_size,
                                        (B, seq_len)).astype(np.int32)}
 
+    loss = None
     for _ in range(warmup):
         loss = engine.train_batch(batch)
     jax.block_until_ready(loss)

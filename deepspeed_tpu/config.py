@@ -328,9 +328,14 @@ class Config:
     def resolve_batch_terms(self, dp_world_size: int) -> None:
         """Reconcile train/micro/GAS (reference runtime/config.py
         ``_configure_train_batch_size``): any two determine the third;
-        all three must satisfy train = micro × GAS × dp_world."""
-        train, micro, gas = (self.train_batch_size, self.train_micro_batch_size_per_gpu,
-                             self.gradient_accumulation_steps)
+        all three must satisfy train = micro × GAS × dp_world. ``"auto"``
+        values (the HF-integration convention) mean "derive me"."""
+        def norm(v):
+            return None if v == AUTO else v
+
+        train, micro, gas = (norm(self.train_batch_size),
+                             norm(self.train_micro_batch_size_per_gpu),
+                             norm(self.gradient_accumulation_steps))
         if train is not None and micro is not None and gas is not None:
             pass
         elif train is not None and micro is not None:
@@ -355,8 +360,9 @@ class Config:
                     f"train_batch_size {train} not divisible by dp_world {dp_world_size}")
             micro = train // dp_world_size
         else:
-            micro, gas = 1, 1
-            train = dp_world_size
+            micro = 1
+            gas = gas or 1
+            train = micro * gas * dp_world_size
         if train != micro * gas * dp_world_size:
             raise ValueError(
                 f"inconsistent batch terms: train_batch_size={train} != "

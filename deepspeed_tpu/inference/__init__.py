@@ -1,0 +1,2 @@
+from .engine import InferenceConfig, InferenceEngine, init_inference  # noqa: F401
+from .sampling import sample_logits  # noqa: F401

@@ -1,0 +1,200 @@
+"""Inference engine (v1): jitted tensor-parallel forward with KV cache.
+
+TPU-native analogue of /root/reference/deepspeed/inference/engine.py
+(``InferenceEngine`` :41) plus the kernel-injection machinery it drives
+(module_inject/replace_module.py:183). The reference reaches fast inference
+by swapping torch modules for fused CUDA kernels and capturing CUDA graphs
+(:527). Under XLA both of those are the compiler's job: the whole
+prefill/decode step is one jitted program (the CUDA-graph analogue), fused
+by XLA, with TP expressed as mesh sharding of the same model the trainer
+uses — no module surgery.
+
+Decode is a ``lax.scan`` over steps with static shapes: KV caches are
+preallocated [B, max_len, KV, D] and appended via dynamic_update_slice —
+the same memory discipline as the reference's preallocated KV cache.
+
+The continuous-batching / paged-KV engine (FastGen analogue,
+reference inference/v2) lives in inference/fastgen.py; this engine is the
+simple whole-batch path (same prompt lengths, no padding).
+"""
+from __future__ import annotations
+
+import dataclasses
+from dataclasses import dataclass, field
+from functools import partial
+from typing import Any
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from ..config import ZeroConfig
+from ..models.transformer import TransformerLM, default_activation_rules
+from ..parallel.topology import BATCH_AXES, MeshConfig, MeshTopology
+from ..runtime.zero.planner import build_plan, unbox_params
+from ..utils.logging import logger
+from .sampling import sample_logits
+
+Pytree = Any
+
+
+@dataclass
+class InferenceConfig:
+    """Reference: inference/config.py:311 ``DeepSpeedInferenceConfig``
+    (GPU-only knobs like kernel injection accepted and ignored)."""
+    dtype: Any = jnp.bfloat16
+    tensor_parallel: int = 1
+    max_batch_size: int = 1
+    max_seq_len: int = 2048
+    # accepted-for-compat, no-op on TPU (XLA fuses/captures already):
+    replace_with_kernel_inject: bool = False
+    enable_cuda_graph: bool = False
+
+    @classmethod
+    def load(cls, cfg) -> "InferenceConfig":
+        if cfg is None:
+            return cls()
+        if isinstance(cfg, InferenceConfig):
+            return cfg
+        cfg = dict(cfg)
+        tp = cfg.pop("tensor_parallel", {})
+        if isinstance(tp, dict):
+            tp = tp.get("tp_size", 1)
+        known = {f.name for f in dataclasses.fields(cls)}
+        ignored = {k: cfg.pop(k) for k in list(cfg) if k not in known}
+        if ignored:
+            logger.info(f"init_inference: ignoring GPU-only keys {sorted(ignored)}")
+        return cls(tensor_parallel=tp, **cfg)
+
+
+class InferenceEngine:
+    def __init__(self, model: TransformerLM, params: Pytree | None = None,
+                 config: InferenceConfig | dict | None = None,
+                 topology: MeshTopology | None = None,
+                 rng: jax.Array | None = None):
+        self.model = model
+        self.config = InferenceConfig.load(config)
+        mcfg = model.config
+        if topology is None:
+            topology = MeshTopology(MeshConfig(tensor=self.config.tensor_parallel,
+                                               data="auto"))
+        self.topology = topology
+        self._rules = default_activation_rules(topology)
+
+        # TP-shard (stage-0) plan for the weights: logical rules only.
+        ids0 = jnp.zeros((1, 8), jnp.int32)
+        if params is None:
+            abstract = jax.eval_shape(
+                lambda r: model.init(r, ids0), rng or jax.random.PRNGKey(0))["params"]
+        else:
+            abstract = params
+        plan = build_plan(topology, ZeroConfig(stage=0), abstract)
+        self.plan = plan
+        shardings = plan.param_shardings
+        cast = lambda t: jax.tree.map(
+            lambda x: x.astype(self.config.dtype)
+            if jnp.issubdtype(x.dtype, jnp.floating) else x, t)
+        if params is None:
+            self.params = jax.jit(
+                lambda r: cast(unbox_params(model.init(r, ids0)["params"])),
+                out_shardings=shardings)(rng or jax.random.PRNGKey(0))
+        else:
+            self.params = jax.device_put(cast(unbox_params(params)), shardings)
+
+        self._decode_fns: dict[tuple, Any] = {}
+        self._fwd = jax.jit(self._forward_impl)
+
+    # ------------------------------------------------------------------
+    def _apply(self, params, ids, **kw):
+        with nn.logical_axis_rules(self._rules):
+            return self.model.apply({"params": params}, ids, **kw)
+
+    def _forward_impl(self, params, input_ids):
+        return self._apply(params, input_ids)
+
+    def forward(self, input_ids) -> jax.Array:
+        """Full-sequence logits (reference engine.forward :587)."""
+        input_ids = self._put_batch(jnp.asarray(input_ids, jnp.int32))
+        return self._fwd(self.params, input_ids)
+
+    __call__ = forward
+
+    def _put_batch(self, x):
+        dp = self.topology.dp_world_size
+        spec = P(BATCH_AXES, *([None] * (x.ndim - 1))) if x.shape[0] % dp == 0 \
+            else P(*([None] * x.ndim))
+        return jax.device_put(x, NamedSharding(self.topology.mesh, spec))
+
+    # ------------------------------------------------------------------
+    def _empty_caches(self, B: int, max_len: int):
+        mcfg = self.model.config
+        shape = (B, max_len, mcfg.kv_heads, mcfg.head_dim)
+        zero = jnp.zeros((), jnp.int32)
+        return [(jnp.zeros(shape, self.config.dtype),
+                 jnp.zeros(shape, self.config.dtype), zero)
+                for _ in range(mcfg.num_layers)]
+
+    def _generate_program(self, prompt_len: int, max_new: int, B: int,
+                          temperature: float, top_k: int, top_p: float,
+                          greedy: bool, eos_id: int | None):
+        """Build the jitted prefill + scan-decode program for one shape."""
+        max_len = prompt_len + max_new
+
+        def run(params, input_ids, rng):
+            caches = self._empty_caches(B, max_len)
+            positions = jnp.broadcast_to(jnp.arange(prompt_len, dtype=jnp.int32),
+                                         (B, prompt_len))
+            logits, caches = self._apply(params, input_ids, positions=positions,
+                                         kv_caches=caches)
+            rng, sub = jax.random.split(rng)
+            next_tok = sample_logits(logits[:, -1], sub, temperature=temperature,
+                                     top_k=top_k, top_p=top_p, greedy=greedy)
+
+            def step(carry, _):
+                caches, tok, rng, done = carry
+                pos = caches[0][2]  # current length
+                positions = jnp.broadcast_to(pos[None, None], (B, 1)).astype(jnp.int32)
+                logits, caches = self._apply(params, tok[:, None],
+                                             positions=positions, kv_caches=caches)
+                rng, sub = jax.random.split(rng)
+                nxt = sample_logits(logits[:, -1], sub, temperature=temperature,
+                                    top_k=top_k, top_p=top_p, greedy=greedy)
+                if eos_id is not None:
+                    nxt = jnp.where(done, eos_id, nxt)
+                    done = done | (nxt == eos_id)
+                return (caches, nxt, rng, done), tok
+
+            done0 = jnp.zeros((B,), bool)
+            (caches, last, rng, done), toks = jax.lax.scan(
+                step, (caches, next_tok, rng, done0), None, length=max_new - 1)
+            toks = jnp.concatenate([jnp.swapaxes(toks, 0, 1), last[:, None]], axis=1)
+            return toks
+
+        return jax.jit(run)
+
+    def generate(self, input_ids, max_new_tokens: int = 32, *,
+                 temperature: float = 1.0, top_k: int = 0, top_p: float = 1.0,
+                 greedy: bool = True, eos_token_id: int | None = None,
+                 rng: jax.Array | None = None) -> jax.Array:
+        """Autoregressive generation (reference engine._generate :616).
+
+        ``input_ids`` [B, prompt_len] int32, unpadded (equal lengths; the
+        ragged path is inference/fastgen.py). Returns [B, max_new_tokens].
+        """
+        input_ids = jnp.asarray(input_ids, jnp.int32)
+        B, prompt_len = input_ids.shape
+        key = (prompt_len, max_new_tokens, B, temperature, top_k, top_p, greedy,
+               eos_token_id)
+        if key not in self._decode_fns:
+            self._decode_fns[key] = self._generate_program(
+                prompt_len, max_new_tokens, B, temperature, top_k, top_p, greedy,
+                eos_token_id)
+        rng = rng if rng is not None else jax.random.PRNGKey(0)
+        return self._decode_fns[key](self.params, self._put_batch(input_ids), rng)
+
+
+def init_inference(model: TransformerLM, config: InferenceConfig | dict | None = None,
+                   params: Pytree | None = None, **kwargs) -> InferenceEngine:
+    """Inference bring-up (reference deepspeed/__init__.py:291)."""
+    return InferenceEngine(model=model, params=params, config=config, **kwargs)

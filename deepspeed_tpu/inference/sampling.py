@@ -1,0 +1,30 @@
+"""Token sampling for generation.
+
+Role of the reference's sampling glue in inference (the HF-generate
+integration in inference/engine.py:616 and FastGen's logits handling):
+pure functions over logits, traceable inside the decode loop.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+def sample_logits(logits: jax.Array, rng: jax.Array, *, temperature: float = 1.0,
+                  top_k: int = 0, top_p: float = 1.0, greedy: bool = False) -> jax.Array:
+    """logits [B, V] → token ids [B]."""
+    if greedy or temperature == 0.0:
+        return jnp.argmax(logits, axis=-1)
+    logits = logits.astype(jnp.float32) / max(temperature, 1e-6)
+    if top_k and top_k > 0:
+        kth = jnp.sort(logits, axis=-1)[..., -top_k][..., None]
+        logits = jnp.where(logits < kth, -jnp.inf, logits)
+    if top_p < 1.0:
+        sorted_logits = jnp.sort(logits, axis=-1)[..., ::-1]
+        probs = jax.nn.softmax(sorted_logits, axis=-1)
+        cum = jnp.cumsum(probs, axis=-1)
+        # smallest set whose cumulative prob >= top_p; keep at least 1
+        cutoff_idx = jnp.sum(cum < top_p, axis=-1, keepdims=True)
+        cutoff = jnp.take_along_axis(sorted_logits, cutoff_idx, axis=-1)
+        logits = jnp.where(logits < cutoff, -jnp.inf, logits)
+    return jax.random.categorical(rng, logits, axis=-1)

@@ -324,7 +324,8 @@ class MoEFFN(nn.Module):
 
         # --- dispatch: [B,S,E] → [B,n,cap,E] --------------------------------
         # combine[b,s,k_,n,c] = kept_gate * onehot(pos)
-        pos_oh = jax.nn.one_hot(pos, capacity, dtype=jnp.float32)       # [B,S,k,cap]
+        pos_oh = jax.nn.one_hot(pos.astype(jnp.int32), capacity,
+                                dtype=jnp.float32)                      # [B,S,k,cap]
         dispatch = jnp.einsum("bskn,bskc->bsnc",
                               keep.astype(jnp.float32) * onehot, pos_oh)  # [B,S,n,cap]
         combine = jnp.einsum("bsk,bskn,bskc->bsnc", kept_gate,

@@ -102,8 +102,8 @@ class MeshTopology:
         shape = tuple(self.axis_sizes[a] for a in AXIS_ORDER)
         dev_array = np.asarray(devices).reshape(shape)
         self.mesh = Mesh(dev_array, AXIS_ORDER)
-        logger.info("mesh: " + " ".join(f"{a}={s}" for a, s in self.axis_sizes.items()
-                                        if s > 1) or "mesh: single device")
+        desc = " ".join(f"{a}={s}" for a, s in self.axis_sizes.items() if s > 1)
+        logger.info(f"mesh: {desc or 'single device'}")
 
     # -- sizes ------------------------------------------------------------
     def size(self, axis: str) -> int:

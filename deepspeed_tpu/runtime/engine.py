@@ -138,7 +138,6 @@ class DeepSpeedEngine:
         self._accum_count = 0
         self._last_loss: jax.Array | None = None
         self.global_steps = int(self.state.global_step)
-        self.skipped_steps = 0
 
         logger.info(
             f"engine up: zero_stage={config.zero_optimization.stage} "
@@ -413,8 +412,27 @@ class DeepSpeedEngine:
 
     def backward(self, batch: dict | None = None, loss=None) -> jax.Array:
         """Compute grads for a microbatch and accumulate (reference
-        engine.backward :1977 + ZeRO IPG accumulation)."""
+        engine.backward :1977 + ZeRO IPG accumulation). Accepts the
+        DeepSpeed-canonical ``backward(loss)`` call shape: a scalar loss (or
+        ``loss=`` kwarg) means "differentiate the batch from the last
+        forward()" — JAX recomputes the forward inside the grad program.
+        A *transformed* loss (e.g. ``backward(loss * alpha)``) cannot be
+        differentiated here (no tape); pass a custom ``loss_fn`` to
+        ``initialize`` instead — a mismatch triggers a warning."""
         self.timers(BACKWARD_GLOBAL_TIMER).start()
+        if batch is not None and not isinstance(batch, dict):
+            # engine.backward(loss) — reference call shape
+            loss, batch = batch, None
+        if loss is not None and self._last_loss is not None:
+            try:
+                if abs(float(loss) - float(self._last_loss)) > 1e-4 * (
+                        abs(float(self._last_loss)) + 1e-8):
+                    logger.warning(
+                        "backward(loss) received a value different from the last "
+                        "forward loss; transformations of the loss are NOT "
+                        "differentiated — use a custom loss_fn in initialize()")
+            except TypeError:
+                pass
         if batch is None:
             batch = getattr(self, "_last_forward_batch", None)
             if batch is None:
@@ -456,6 +474,14 @@ class DeepSpeedEngine:
     @property
     def params(self) -> Pytree:
         return self.state.params
+
+    @property
+    def skipped_steps(self) -> int:
+        """Steps whose optimizer update was skipped by the fp16 overflow
+        check (reference ``engine.skipped_steps``). The optimizer step
+        counter only advances on applied updates, so the difference from
+        ``global_step`` is exactly the skip count."""
+        return int(self.state.global_step) - int(self.state.opt_state.step)
 
     def get_lr(self) -> float:
         return float(self.lr_schedule(self.state.opt_state.step))

@@ -40,14 +40,15 @@ def warmup_decay_lr(total_num_steps: int, warmup_min_lr: float = 0.0,
                     warmup_max_lr: float = 1e-3, warmup_num_steps: int = 1000,
                     warmup_type: str = "log") -> Schedule:
     """Reference ``WarmupDecayLR`` (lr_schedules.py:816): warmup then linear
-    decay to zero at ``total_num_steps``."""
+    decay, flooring at ``warmup_min_lr`` at ``total_num_steps``."""
     warm = warmup_lr(warmup_min_lr, warmup_max_lr, warmup_num_steps, warmup_type)
 
     def fn(step):
         stepf = step.astype(jnp.float32)
         decay = jnp.clip((total_num_steps - stepf) /
                          max(total_num_steps - warmup_num_steps, 1), 0.0, 1.0)
-        return jnp.where(stepf < warmup_num_steps, warm(step), warmup_max_lr * decay)
+        decayed = warmup_min_lr + (warmup_max_lr - warmup_min_lr) * decay
+        return jnp.where(stepf < warmup_num_steps, warm(step), decayed)
 
     return fn
 

@@ -108,18 +108,6 @@ class ZeroPlan:
     def grad_shardings(self) -> Pytree:
         return self.shardings(self.grad_specs)
 
-    def opt_state_specs(self, opt_state) -> Pytree:
-        """Specs for an OptState: moments follow master specs, scalars replicate."""
-        def for_leaf_tree(moments):
-            if moments is None:
-                return None
-            return self.master_specs
-
-        from ...ops.optimizers import OptState
-
-        return OptState(step=P(),
-                        mu=for_leaf_tree(opt_state.mu),
-                        nu=for_leaf_tree(opt_state.nu))
 
 
 def _translate_logical(spec: P | None, ndim: int, topology: MeshTopology,

@@ -74,6 +74,16 @@ def test_batch_inconsistent_rejected():
         cfg.resolve_batch_terms(dp_world_size=4)
 
 
+def test_auto_batch_values():
+    """HF-integration style '"auto"' values mean "derive me"."""
+    cfg = Config.from_dict({"train_batch_size": "auto",
+                            "train_micro_batch_size_per_gpu": 4,
+                            "gradient_accumulation_steps": "auto"})
+    cfg.resolve_batch_terms(dp_world_size=8)
+    assert cfg.train_batch_size == 32
+    assert cfg.gradient_accumulation_steps == 1
+
+
 def test_fp16_dynamic_scale_defaults():
     cfg = Config.from_dict({"fp16": {"enabled": True}})
     assert cfg.fp16.initial_scale_power == 16

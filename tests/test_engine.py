@@ -87,6 +87,32 @@ def test_forward_backward_step_triplet():
     assert ea == pytest.approx(eb, rel=2e-2)
 
 
+def test_backward_accepts_loss_arg():
+    """Reference call shape: loss = engine.forward(b); engine.backward(loss)."""
+    engine, *_ = ds.initialize(model=build_model("tiny-gpt2"),
+                               config=base_config(mesh={"data": 8}))
+    b = make_batch(engine.config.train_batch_size)
+    loss = engine.forward(b)
+    engine.backward(loss)
+    engine.step()
+    assert engine.global_steps == 1
+
+
+def test_skipped_steps_counts_fp16_overflows():
+    cfg = base_config(bf16={"enabled": False},
+                      fp16={"enabled": True, "initial_scale_power": 30,
+                            "hysteresis": 1},
+                      optimizer={"type": "AdamW", "params": {"lr": 1e-2}},
+                      mesh={"data": 8})
+    engine, *_ = ds.initialize(model=build_model("tiny-gpt2"), config=cfg)
+    b = make_batch(engine.config.train_batch_size)
+    for _ in range(3):
+        engine.train_batch(b)
+    # 2^30 scale overflows fp16 grads → at least the first step must skip
+    assert engine.skipped_steps >= 1
+    assert engine.global_steps == 3
+
+
 def test_eval_batch_no_state_change():
     engine, _ = train_losses(base_config(mesh={"data": 8}), steps=1)
     step_before = int(engine.state.global_step)

@@ -33,6 +33,14 @@ def test_warmup_decay_lr():
     assert lr_at(s, 100) == pytest.approx(0.0, abs=1e-9)
 
 
+def test_warmup_decay_lr_floors_at_min():
+    s = build_scheduler("WarmupDecayLR", {
+        "total_num_steps": 100, "warmup_min_lr": 1e-5, "warmup_max_lr": 1e-3,
+        "warmup_num_steps": 10, "warmup_type": "linear"})
+    assert lr_at(s, 100) == pytest.approx(1e-5)
+    assert lr_at(s, 10_000) == pytest.approx(1e-5)
+
+
 def test_warmup_cosine_lr():
     s = build_scheduler("WarmupCosineLR", {
         "total_num_steps": 100, "warmup_num_steps": 10}, base_lr=1e-2)
