@@ -23,7 +23,10 @@ def test_moe_sows_aux_loss():
     model = build_model("tiny-mixtral")
     ids = jnp.zeros((2, 16), jnp.int32)
     variables = model.init(jax.random.PRNGKey(0), ids)
-    _, mut = model.apply(variables, ids, deterministic=False, mutable=["losses"])
+    # init() itself sows into "losses"; apply with params only so sow
+    # counts reflect a single forward pass.
+    _, mut = model.apply({"params": variables["params"]}, ids,
+                         deterministic=False, mutable=["losses"])
     leaves = jax.tree.leaves(mut["losses"])
     assert len(leaves) == model.config.num_layers
     assert all(np.isfinite(float(jnp.sum(l))) for l in leaves)
