@@ -54,7 +54,8 @@ def dot_product_attention(q, k, v, *, causal: bool = True, positions=None,
             from .pallas.flash_attention import flash_attention_usable, flash_attention
 
             if flash_attention_usable(q, k, v, causal=causal, positions=positions,
-                                      mask=mask):
+                                      mask=mask,
+                                      allow_multi_device=(impl == "pallas")):
                 return flash_attention(q, k, v, causal=causal)
         except ImportError:
             pass

@@ -1,0 +1,8 @@
+"""Pallas TPU kernels — the role of the reference's hand-written CUDA under
+/root/reference/csrc/ (transformer attention/softmax kernels, FastGen blocked
+flash) re-designed as Mosaic/Pallas kernels for the MXU/VMEM machine model.
+
+Every kernel here has an XLA fallback in the caller; kernels run compiled on
+TPU and in interpreter mode on CPU for tests.
+"""
+from .flash_attention import flash_attention, flash_attention_usable  # noqa: F401

@@ -1,0 +1,377 @@
+"""Flash attention as a Pallas TPU kernel (fwd + bwd), with GQA.
+
+TPU-native replacement for the reference's fused attention CUDA kernels
+(/root/reference/csrc/transformer/softmax_kernels.cu, attention paths of
+csrc/transformer/inference/csrc/, and the flash-attn-2 port under
+deepspeed/inference/v2/kernels/ragged_ops/blocked_flash/).
+
+Design (standard TPU flash schedule):
+- layout [B, H, S, D]; grid (B, H, num_q_blocks, num_kv_blocks) with the KV
+  block index innermost. TPU grids execute sequentially per core, so the
+  online-softmax state (m, l, acc) lives in VMEM scratch carried across the
+  KV steps of one q block; output is written on the last KV step.
+- causal masking is block-aware: fully-masked KV blocks are predicated off
+  with @pl.when (no MXU work), the diagonal block applies an elementwise
+  mask.
+- GQA: the q-head grid index maps onto kv-head q_head // group in the
+  BlockSpec index_map — K/V are never materialized per-q-head.
+- backward: custom VJP. delta = rowsum(dO*O) precomputed in XLA; one kernel
+  produces dQ (grid over q blocks, KV innermost), one produces per-q-head
+  dK/dV (grid over kv blocks, Q innermost) which are group-summed to the KV
+  heads outside the kernel.
+
+Numerics: logits and softmax state in fp32 (preferred_element_type), inputs
+bf16 or fp32.
+"""
+from __future__ import annotations
+
+import functools
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+
+try:  # pltpu importable everywhere jax is, but keep the guard for safety
+    from jax.experimental.pallas import tpu as pltpu
+except ImportError:  # pragma: no cover
+    pltpu = None
+
+NEG_INF = float(jnp.finfo(jnp.float32).min)
+
+DEFAULT_BLOCK_Q = 128
+DEFAULT_BLOCK_K = 128
+
+
+def _interpret() -> bool:
+    return jax.default_backend() != "tpu"
+
+
+def flash_attention_usable(q, k, v, *, causal: bool, positions=None,
+                           mask=None, allow_multi_device: bool = False) -> bool:
+    """Gate for the dispatcher: full-sequence self-attention only (the
+    decode/cached path has tiny q and is XLA's job).
+
+    By default only claims the kernel when a single device is in play:
+    ``pallas_call`` has no GSPMD partitioning rule, so inside a pjit-sharded
+    model on a multi-device mesh it would force replication of q/k/v.
+    Multi-device callers run it per-shard (inside shard_map, e.g.
+    parallel/sequence.py paths) and opt in with ``allow_multi_device=True``
+    / explicit ``impl='pallas'``.
+    """
+    if not allow_multi_device and jax.device_count() > 1:
+        return False
+    if positions is not None or mask is not None:
+        return False
+    B, Sq, H, D = q.shape
+    Skv, KV = k.shape[1], k.shape[2]
+    if Sq != Skv:                      # prefill/training only
+        return False
+    if Sq % DEFAULT_BLOCK_Q != 0 or Skv % DEFAULT_BLOCK_K != 0:
+        return False
+    if H % KV != 0:
+        return False
+    # head_dim should map onto MXU lanes; smaller dims are padded by Mosaic
+    # but we only claim the kernel when it is profitable.
+    return D in (64, 128, 256)
+
+
+def _block_visible(causal: bool, q_start, k_start, block_q: int):
+    """False iff the whole [block_q, block_k] tile is above the diagonal."""
+    if not causal:
+        return True
+    return k_start <= q_start + block_q - 1
+
+
+def _apply_causal_mask(s, q_start, k_start, block_q: int, block_k: int):
+    q_pos = q_start + jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 0)
+    k_pos = k_start + jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 1)
+    return jnp.where(k_pos <= q_pos, s, NEG_INF)
+
+
+# ---------------------------------------------------------------------------
+# forward
+# ---------------------------------------------------------------------------
+
+def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref,
+                m_scr, l_scr, acc_scr, *, scale: float, causal: bool,
+                block_q: int, block_k: int):
+    qi = pl.program_id(2)
+    kj = pl.program_id(3)
+    nk = pl.num_programs(3)
+
+    @pl.when(kj == 0)
+    def _init():
+        m_scr[:] = jnp.full_like(m_scr, NEG_INF)
+        l_scr[:] = jnp.zeros_like(l_scr)
+        acc_scr[:] = jnp.zeros_like(acc_scr)
+
+    q_start = qi * block_q
+    k_start = kj * block_k
+
+    @pl.when(_block_visible(causal, q_start, k_start, block_q))
+    def _body():
+        q = q_ref[0, 0, :, :]
+        k = k_ref[0, 0, :, :]
+        s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
+                                preferred_element_type=jnp.float32)
+        s = s * scale
+        if causal:
+            s = _apply_causal_mask(s, q_start, k_start, block_q, block_k)
+
+        m_prev = m_scr[:]                       # [block_q, 1]
+        m_cur = jnp.max(s, axis=-1, keepdims=True)
+        m_new = jnp.maximum(m_prev, m_cur)
+        alpha = jnp.exp(m_prev - m_new)
+        p = jnp.exp(s - m_new)                  # [block_q, block_k]
+        l_new = alpha * l_scr[:] + jnp.sum(p, axis=-1, keepdims=True)
+        acc_scr[:] = acc_scr[:] * alpha + jax.lax.dot_general(
+            p.astype(v_ref.dtype), v_ref[0, 0, :, :],
+            (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32)
+        m_scr[:] = m_new
+        l_scr[:] = l_new
+
+    @pl.when(kj == nk - 1)
+    def _finalize():
+        l = l_scr[:]
+        l_safe = jnp.where(l == 0.0, 1.0, l)
+        o_ref[0, 0, :, :] = (acc_scr[:] / l_safe).astype(o_ref.dtype)
+        lse_ref[0, 0, :] = (m_scr[:] + jnp.log(l_safe))[:, 0]
+
+
+def _fwd(q, k, v, *, causal: bool, scale: float,
+         block_q: int, block_k: int):
+    """q: [B,H,Sq,D]; k/v: [B,KV,Skv,D] → (out [B,H,Sq,D], lse [B,H,Sq])."""
+    B, H, Sq, D = q.shape
+    KV, Skv = k.shape[1], k.shape[2]
+    group = H // KV
+    grid = (B, H, Sq // block_q, Skv // block_k)
+
+    kernel = functools.partial(_fwd_kernel, scale=scale, causal=causal,
+                               block_q=block_q, block_k=block_k)
+    out, lse = pl.pallas_call(
+        kernel,
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((1, 1, block_q, D), lambda b, h, i, j: (b, h, i, 0)),
+            pl.BlockSpec((1, 1, block_k, D),
+                         lambda b, h, i, j: (b, h // group, j, 0)),
+            pl.BlockSpec((1, 1, block_k, D),
+                         lambda b, h, i, j: (b, h // group, j, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, 1, block_q, D), lambda b, h, i, j: (b, h, i, 0)),
+            pl.BlockSpec((1, 1, block_q), lambda b, h, i, j: (b, h, i)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((B, H, Sq, D), q.dtype),
+            jax.ShapeDtypeStruct((B, H, Sq), jnp.float32),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((block_q, 1), jnp.float32),
+            pltpu.VMEM((block_q, 1), jnp.float32),
+            pltpu.VMEM((block_q, D), jnp.float32),
+        ],
+        interpret=_interpret(),
+    )(q, k, v)
+    return out, lse
+
+
+# ---------------------------------------------------------------------------
+# backward
+# ---------------------------------------------------------------------------
+
+def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
+               dq_scr, *, scale: float, causal: bool,
+               block_q: int, block_k: int):
+    kj = pl.program_id(3)
+    nk = pl.num_programs(3)
+    qi = pl.program_id(2)
+
+    @pl.when(kj == 0)
+    def _init():
+        dq_scr[:] = jnp.zeros_like(dq_scr)
+
+    q_start = qi * block_q
+    k_start = kj * block_k
+
+    @pl.when(_block_visible(causal, q_start, k_start, block_q))
+    def _body():
+        q = q_ref[0, 0, :, :]
+        k = k_ref[0, 0, :, :]
+        v = v_ref[0, 0, :, :]
+        s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
+                                preferred_element_type=jnp.float32) * scale
+        if causal:
+            s = _apply_causal_mask(s, q_start, k_start, block_q, block_k)
+        p = jnp.exp(s - lse_ref[0, 0, :][:, None])          # [bq, bk]
+        do = do_ref[0, 0, :, :]
+        dp = jax.lax.dot_general(do, v, (((1,), (1,)), ((), ())),
+                                 preferred_element_type=jnp.float32)
+        ds = p * (dp - delta_ref[0, 0, :][:, None]) * scale
+        dq_scr[:] += jax.lax.dot_general(
+            ds.astype(k.dtype), k, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+
+    @pl.when(kj == nk - 1)
+    def _finalize():
+        dq_ref[0, 0, :, :] = dq_scr[:].astype(dq_ref.dtype)
+
+
+def _dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+                dk_ref, dv_ref, dk_scr, dv_scr, *, scale: float,
+                causal: bool, block_q: int, block_k: int):
+    kj = pl.program_id(2)
+    qi = pl.program_id(3)
+    nq = pl.num_programs(3)
+
+    @pl.when(qi == 0)
+    def _init():
+        dk_scr[:] = jnp.zeros_like(dk_scr)
+        dv_scr[:] = jnp.zeros_like(dv_scr)
+
+    q_start = qi * block_q
+    k_start = kj * block_k
+
+    @pl.when(_block_visible(causal, q_start, k_start, block_q))
+    def _body():
+        q = q_ref[0, 0, :, :]
+        k = k_ref[0, 0, :, :]
+        v = v_ref[0, 0, :, :]
+        do = do_ref[0, 0, :, :]
+        s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
+                                preferred_element_type=jnp.float32) * scale
+        if causal:
+            s = _apply_causal_mask(s, q_start, k_start, block_q, block_k)
+        p = jnp.exp(s - lse_ref[0, 0, :][:, None])          # [bq, bk]
+        # dV += P^T @ dO
+        dv_scr[:] += jax.lax.dot_general(
+            p.astype(do.dtype), do, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        dp = jax.lax.dot_general(do, v, (((1,), (1,)), ((), ())),
+                                 preferred_element_type=jnp.float32)
+        ds = p * (dp - delta_ref[0, 0, :][:, None]) * scale  # [bq, bk]
+        # dK += dS^T @ Q
+        dk_scr[:] += jax.lax.dot_general(
+            ds.astype(q.dtype), q, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+
+    @pl.when(qi == nq - 1)
+    def _finalize():
+        dk_ref[0, 0, :, :] = dk_scr[:].astype(dk_ref.dtype)
+        dv_ref[0, 0, :, :] = dv_scr[:].astype(dv_ref.dtype)
+
+
+def _bwd(causal, scale, block_q, block_k, res, do):
+    q, k, v, out, lse = res
+    B, H, Sq, D = q.shape
+    KV, Skv = k.shape[1], k.shape[2]
+    group = H // KV
+
+    delta = jnp.sum(do.astype(jnp.float32) * out.astype(jnp.float32),
+                    axis=-1)                                  # [B,H,Sq]
+
+    grid_dq = (B, H, Sq // block_q, Skv // block_k)
+    dq = pl.pallas_call(
+        functools.partial(_dq_kernel, scale=scale, causal=causal,
+                          block_q=block_q, block_k=block_k),
+        grid=grid_dq,
+        in_specs=[
+            pl.BlockSpec((1, 1, block_q, D), lambda b, h, i, j: (b, h, i, 0)),
+            pl.BlockSpec((1, 1, block_k, D),
+                         lambda b, h, i, j: (b, h // group, j, 0)),
+            pl.BlockSpec((1, 1, block_k, D),
+                         lambda b, h, i, j: (b, h // group, j, 0)),
+            pl.BlockSpec((1, 1, block_q, D), lambda b, h, i, j: (b, h, i, 0)),
+            pl.BlockSpec((1, 1, block_q), lambda b, h, i, j: (b, h, i)),
+            pl.BlockSpec((1, 1, block_q), lambda b, h, i, j: (b, h, i)),
+        ],
+        out_specs=pl.BlockSpec((1, 1, block_q, D),
+                               lambda b, h, i, j: (b, h, i, 0)),
+        out_shape=jax.ShapeDtypeStruct((B, H, Sq, D), q.dtype),
+        scratch_shapes=[pltpu.VMEM((block_q, D), jnp.float32)],
+        interpret=_interpret(),
+    )(q, k, v, do, lse, delta)
+
+    # per-q-head dK/dV, grid over kv blocks with q innermost
+    grid_dkv = (B, H, Skv // block_k, Sq // block_q)
+    dk_h, dv_h = pl.pallas_call(
+        functools.partial(_dkv_kernel, scale=scale, causal=causal,
+                          block_q=block_q, block_k=block_k),
+        grid=grid_dkv,
+        in_specs=[
+            pl.BlockSpec((1, 1, block_q, D), lambda b, h, j, i: (b, h, i, 0)),
+            pl.BlockSpec((1, 1, block_k, D),
+                         lambda b, h, j, i: (b, h // group, j, 0)),
+            pl.BlockSpec((1, 1, block_k, D),
+                         lambda b, h, j, i: (b, h // group, j, 0)),
+            pl.BlockSpec((1, 1, block_q, D), lambda b, h, j, i: (b, h, i, 0)),
+            pl.BlockSpec((1, 1, block_q), lambda b, h, j, i: (b, h, i)),
+            pl.BlockSpec((1, 1, block_q), lambda b, h, j, i: (b, h, i)),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, 1, block_k, D), lambda b, h, j, i: (b, h, j, 0)),
+            pl.BlockSpec((1, 1, block_k, D), lambda b, h, j, i: (b, h, j, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((B, H, Skv, D), k.dtype),
+            jax.ShapeDtypeStruct((B, H, Skv, D), v.dtype),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((block_k, D), jnp.float32),
+            pltpu.VMEM((block_k, D), jnp.float32),
+        ],
+        interpret=_interpret(),
+    )(q, k, v, do, lse, delta)
+
+    if group > 1:  # sum q-head contributions within each GQA group
+        dk = dk_h.reshape(B, KV, group, Skv, D).sum(axis=2).astype(k.dtype)
+        dv = dv_h.reshape(B, KV, group, Skv, D).sum(axis=2).astype(v.dtype)
+    else:
+        dk, dv = dk_h, dv_h
+    return dq, dk, dv
+
+
+# ---------------------------------------------------------------------------
+# public entry: [B,S,H,D] layout to match ops.attention.dot_product_attention
+# ---------------------------------------------------------------------------
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6))
+def _flash(q, k, v, causal, scale, block_q, block_k):
+    out, _ = _fwd(q, k, v, causal=causal, scale=scale,
+                  block_q=block_q, block_k=block_k)
+    return out
+
+
+def _flash_fwd(q, k, v, causal, scale, block_q, block_k):
+    out, lse = _fwd(q, k, v, causal=causal, scale=scale,
+                    block_q=block_q, block_k=block_k)
+    return out, (q, k, v, out, lse)
+
+
+_flash.defvjp(_flash_fwd, _bwd)
+
+
+def flash_attention(q, k, v, *, causal: bool = True,
+                    block_q: int = DEFAULT_BLOCK_Q,
+                    block_k: int = DEFAULT_BLOCK_K,
+                    scale: float | None = None) -> Any:
+    """q: [B,Sq,H,D]; k/v: [B,Skv,KV,D]. Returns [B,Sq,H,D]."""
+    B, Sq, H, D = q.shape
+    if scale is None:
+        scale = 1.0 / (D ** 0.5)
+    block_q = min(block_q, Sq)
+    block_k = min(block_k, k.shape[1])
+    if Sq % block_q or k.shape[1] % block_k:
+        raise ValueError(
+            f"flash_attention requires seq lengths divisible by block sizes: "
+            f"Sq={Sq} % {block_q}, Skv={k.shape[1]} % {block_k}")
+    if q.shape[2] % k.shape[2]:
+        raise ValueError(
+            f"GQA requires num q heads ({q.shape[2]}) divisible by kv heads "
+            f"({k.shape[2]})")
+    qt = jnp.swapaxes(q, 1, 2)          # [B,H,S,D]
+    kt = jnp.swapaxes(k, 1, 2)
+    vt = jnp.swapaxes(v, 1, 2)
+    out = _flash(qt, kt, vt, causal, float(scale), block_q, block_k)
+    return jnp.swapaxes(out, 1, 2)

@@ -1,0 +1,217 @@
+"""Sequence parallelism: Ulysses all-to-all attention + ring attention.
+
+TPU-native counterpart of reference deepspeed/sequence/layer.py
+(``DistributedAttention`` :145, ``_SeqAllToAll`` :90) and
+deepspeed/sequence/cross_entropy.py. Two idioms are provided:
+
+1. **GSPMD (default, used by the model zoo):** activations carry logical
+   axis annotations; XLA inserts the seq<->head all-to-all pair around local
+   attention automatically (models/transformer.py). Nothing to call here.
+
+2. **Explicit (this module):** `shard_map`-based primitives for code that
+   wants hand-scheduled communication — the exact algebra of the reference:
+
+   - ``ulysses_attention`` / ``DistributedAttention``: all-to-all converts
+     [B, S/n, H, D] (sequence-sharded) → [B, S, H/n, D] (head-sharded), runs
+     ANY local attention on the full sequence, and converts back.
+   - ``ring_attention``: blockwise online-softmax attention with K/V blocks
+     rotating around the `seq` axis via ``ppermute`` — the long-context path
+     the reference does NOT have (SURVEY §2.3: no ring/context parallelism
+     upstream); comm rides ICI neighbor links and overlaps with compute.
+   - ``vocab_parallel_cross_entropy``: stable CE over vocab-sharded logits.
+"""
+from __future__ import annotations
+
+import functools
+from typing import Callable
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
+from jax import shard_map
+
+NEG_INF = float(jnp.finfo(jnp.float32).min)
+
+
+# ---------------------------------------------------------------------------
+# Ulysses
+# ---------------------------------------------------------------------------
+
+def _ulysses_body(q, k, v, *, axis_name: str, attn_fn: Callable):
+    """Per-shard body. q/k/v: [B, S/n, H, D] → out [B, S/n, H, D]."""
+    # seq-shard → head-shard (reference _SeqAllToAll scatter_idx=2 :90)
+    q = jax.lax.all_to_all(q, axis_name, split_axis=2, concat_axis=1,
+                           tiled=True)
+    k = jax.lax.all_to_all(k, axis_name, split_axis=2, concat_axis=1,
+                           tiled=True)
+    v = jax.lax.all_to_all(v, axis_name, split_axis=2, concat_axis=1,
+                           tiled=True)
+    out = attn_fn(q, k, v)
+    # head-shard → seq-shard (gather_idx=1)
+    out = jax.lax.all_to_all(out, axis_name, split_axis=1, concat_axis=2,
+                             tiled=True)
+    return out
+
+
+def ulysses_attention(q, k, v, mesh, *, axis: str = "seq",
+                      attn_fn: Callable | None = None,
+                      causal: bool = True):
+    """Full Ulysses attention over a mesh axis.
+
+    q: [B, S, H, D]; k/v: [B, S, KV, D] — *global* shapes; the seq dim is
+    sharded over `axis`. H and KV must be divisible by the axis size.
+    """
+    if attn_fn is None:
+        from ..ops.attention import dot_product_attention
+        attn_fn = functools.partial(dot_product_attention, causal=causal)
+    n = mesh.shape[axis]
+    if q.shape[2] % n or k.shape[2] % n:
+        raise ValueError(
+            f"num heads {q.shape[2]}/{k.shape[2]} not divisible by "
+            f"seq-parallel degree {n}; pad or repeat KV heads first")
+    spec = P(None, axis, None, None)
+    fn = shard_map(
+        functools.partial(_ulysses_body, axis_name=axis, attn_fn=attn_fn),
+        mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec,
+        check_vma=False)
+    return fn(q, k, v)
+
+
+class DistributedAttention:
+    """API-parity shim for reference sequence/layer.py:145.
+
+    Wraps any local attention callable; __call__ takes sequence-sharded
+    q/k/v and returns sequence-sharded output.
+    """
+
+    def __init__(self, local_attention: Callable, mesh,
+                 *, axis: str = "seq"):
+        self.local_attn = local_attention
+        self.mesh = mesh
+        self.axis = axis
+
+    def __call__(self, query, key, value, *args, **kwargs):
+        if args or kwargs:
+            # extra args go AFTER q/k/v, matching the reference signature
+            def attn(q, k, v):
+                return self.local_attn(q, k, v, *args, **kwargs)
+        else:
+            attn = self.local_attn
+        return ulysses_attention(query, key, value, self.mesh,
+                                 axis=self.axis, attn_fn=attn)
+
+
+# ---------------------------------------------------------------------------
+# Ring attention (context parallelism)
+# ---------------------------------------------------------------------------
+
+def _ring_body(q, k, v, *, axis_name: str, causal: bool, scale: float):
+    """Per-shard blockwise attention; k/v blocks rotate around the ring.
+
+    q/k/v: [B, S_loc, H|KV, D]. Shard i owns global positions
+    [i*S_loc, (i+1)*S_loc). Online softmax in fp32.
+    """
+    n = jax.lax.psum(1, axis_name)
+    idx = jax.lax.axis_index(axis_name)
+    B, S, H, D = q.shape
+    KV = k.shape[2]
+    G = H // KV
+    # grouped layout [B, S, KV, G, D]: K/V rotate un-repeated — each
+    # ppermute moves [B,S,KV,D], not the G×-expanded tensor.
+    qg = q.astype(jnp.float32).reshape(B, S, KV, G, D)
+    q_pos = idx * S + jnp.arange(S)                      # [S]
+
+    m = jnp.full((B, KV, G, S, 1), NEG_INF, jnp.float32)
+    l = jnp.zeros((B, KV, G, S, 1), jnp.float32)
+    acc = jnp.zeros((B, KV, G, S, D), jnp.float32)
+
+    perm = [(i, (i + 1) % n) for i in range(n)]          # send to right
+
+    for step in range(n):
+        src = (idx - step) % n                           # owner of current k/v
+        s = jnp.einsum("bqhgd,bkhd->bhgqk", qg,
+                       k.astype(jnp.float32)) * scale    # [B,KV,G,Sq,Sk]
+        if causal:
+            kv_pos = src * S + jnp.arange(S)             # [S] global
+            allow = kv_pos[None, :] <= q_pos[:, None]    # [S_q, S_k]
+            s = jnp.where(allow[None, None, None], s, NEG_INF)
+        m_cur = jnp.max(s, axis=-1, keepdims=True)
+        m_new = jnp.maximum(m, m_cur)
+        # guard fully-masked blocks (exp(NEG_INF - NEG_INF) would be 1)
+        p = jnp.where(s == NEG_INF, 0.0, jnp.exp(s - m_new))
+        alpha = jnp.where(m == NEG_INF, 0.0, jnp.exp(m - m_new))
+        l = alpha * l + jnp.sum(p, axis=-1, keepdims=True)
+        acc = acc * alpha + jnp.einsum(
+            "bhgqk,bkhd->bhgqd", p, v.astype(jnp.float32))
+        m = m_new
+        if step != n - 1:
+            k = jax.lax.ppermute(k, axis_name, perm)
+            v = jax.lax.ppermute(v, axis_name, perm)
+
+    l_safe = jnp.where(l == 0.0, 1.0, l)
+    out = (acc / l_safe).astype(q.dtype)                 # [B,KV,G,S,D]
+    return out.transpose(0, 3, 1, 2, 4).reshape(B, S, H, D)
+
+
+def ring_attention(q, k, v, mesh, *, axis: str = "seq", causal: bool = True,
+                   scale: float | None = None):
+    """Ring (context-parallel) attention over mesh axis `axis`.
+
+    Global shapes q: [B,S,H,D], k/v: [B,S,KV,D]; S sharded over `axis`.
+    Peak activation memory per chip is O(S_local * S_local) per block pair —
+    supports sequences n× longer than single-chip attention.
+    """
+    if scale is None:
+        scale = 1.0 / (q.shape[-1] ** 0.5)
+    spec = P(None, axis, None, None)
+    fn = shard_map(
+        functools.partial(_ring_body, axis_name=axis, causal=causal,
+                          scale=float(scale)),
+        mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec,
+        check_vma=False)
+    return fn(q, k, v)
+
+
+# ---------------------------------------------------------------------------
+# Vocab-parallel cross entropy (reference sequence/cross_entropy.py)
+# ---------------------------------------------------------------------------
+
+def _vp_ce_body(logits, labels, *, axis_name: str, ignore_index: int):
+    """logits: [B, S, V/n] local shard; labels: [B, S] global ids."""
+    n = jax.lax.psum(1, axis_name)
+    idx = jax.lax.axis_index(axis_name)
+    V_loc = logits.shape[-1]
+    lo = idx * V_loc
+
+    logits = logits.astype(jnp.float32)
+    local_max = jnp.max(logits, axis=-1)
+    gmax = jax.lax.pmax(local_max, axis_name)                    # [B,S]
+    sumexp = jnp.sum(jnp.exp(logits - gmax[..., None]), axis=-1)
+    gsum = jax.lax.psum(sumexp, axis_name)                       # [B,S]
+
+    in_shard = (labels >= lo) & (labels < lo + V_loc)
+    local_label = jnp.clip(labels - lo, 0, V_loc - 1)
+    picked = jnp.take_along_axis(logits, local_label[..., None],
+                                 axis=-1)[..., 0]
+    target_logit = jax.lax.psum(jnp.where(in_shard, picked, 0.0), axis_name)
+
+    nll = jnp.log(gsum) + gmax - target_logit                    # [B,S]
+    mask = (labels != ignore_index).astype(jnp.float32)
+    del n
+    return jnp.sum(nll * mask) / jnp.maximum(jnp.sum(mask), 1.0)
+
+
+def vocab_parallel_cross_entropy(logits, labels, mesh, *,
+                                 axis: str = "tensor",
+                                 ignore_index: int = -100):
+    """Cross entropy over vocab-sharded logits without materializing the
+    full softmax on any chip. logits: [B,S,V] sharded over `axis` on dim 2.
+    """
+    fn = shard_map(
+        functools.partial(_vp_ce_body, axis_name=axis,
+                          ignore_index=ignore_index),
+        mesh=mesh,
+        in_specs=(P(None, None, axis), P(None, None)),
+        out_specs=P(),
+        check_vma=False)
+    return fn(logits, labels)

@@ -1,0 +1,95 @@
+"""Pallas flash-attention numerics vs the XLA oracle (role of reference
+tests/unit/ops/transformer/ kernel tests). Runs in interpret mode on CPU."""
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from deepspeed_tpu.ops.attention import _xla_attention
+from deepspeed_tpu.ops.pallas.flash_attention import (
+    flash_attention, flash_attention_usable)
+
+
+def _rand(shape, key, dtype=jnp.float32):
+    return jax.random.normal(key, shape, dtype=dtype)
+
+
+@pytest.mark.parametrize("causal", [True, False])
+@pytest.mark.parametrize("gqa", [1, 2])
+def test_forward_matches_xla(causal, gqa):
+    B, S, H, D = 2, 256, 4, 64
+    KV = H // gqa
+    ks = jax.random.split(jax.random.PRNGKey(0), 3)
+    q = _rand((B, S, H, D), ks[0])
+    k = _rand((B, S, KV, D), ks[1])
+    v = _rand((B, S, KV, D), ks[2])
+    assert flash_attention_usable(q, k, v, causal=causal,
+                                  allow_multi_device=True)
+    out = flash_attention(q, k, v, causal=causal, block_q=128, block_k=128)
+    ref = _xla_attention(q, k, v, causal=causal, positions=None,
+                         kv_len=None, mask=None)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               atol=2e-5, rtol=2e-5)
+
+
+def test_grads_match_xla():
+    B, S, H, D = 1, 256, 2, 64
+    KV = 1  # GQA group of 2
+    ks = jax.random.split(jax.random.PRNGKey(1), 3)
+    q = _rand((B, S, H, D), ks[0])
+    k = _rand((B, S, KV, D), ks[1])
+    v = _rand((B, S, KV, D), ks[2])
+
+    def loss_flash(q, k, v):
+        o = flash_attention(q, k, v, causal=True, block_q=128, block_k=128)
+        return jnp.sum(o * o)
+
+    def loss_ref(q, k, v):
+        o = _xla_attention(q, k, v, causal=True, positions=None,
+                           kv_len=None, mask=None)
+        return jnp.sum(o * o)
+
+    g_flash = jax.grad(loss_flash, argnums=(0, 1, 2))(q, k, v)
+    g_ref = jax.grad(loss_ref, argnums=(0, 1, 2))(q, k, v)
+    for a, b, name in zip(g_flash, g_ref, "qkv"):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   atol=5e-4, rtol=5e-4,
+                                   err_msg=f"d{name} mismatch")
+
+
+def test_bf16_forward_close():
+    B, S, H, D = 1, 128, 2, 64
+    ks = jax.random.split(jax.random.PRNGKey(2), 3)
+    q = _rand((B, S, H, D), ks[0], jnp.bfloat16)
+    k = _rand((B, S, H, D), ks[1], jnp.bfloat16)
+    v = _rand((B, S, H, D), ks[2], jnp.bfloat16)
+    out = flash_attention(q, k, v, causal=True, block_q=128, block_k=128)
+    ref = _xla_attention(q, k, v, causal=True, positions=None,
+                        kv_len=None, mask=None)
+    assert out.dtype == jnp.bfloat16
+    np.testing.assert_allclose(np.asarray(out, np.float32),
+                               np.asarray(ref, np.float32),
+                               atol=3e-2, rtol=3e-2)
+
+
+def test_usable_gate():
+    q = jnp.zeros((1, 100, 4, 64))   # seq not block-aligned
+    k = v = jnp.zeros((1, 100, 4, 64))
+    assert not flash_attention_usable(q, k, v, causal=True,
+                                      allow_multi_device=True)
+    q2 = jnp.zeros((1, 1, 4, 64))    # decode shape
+    k2 = v2 = jnp.zeros((1, 256, 4, 64))
+    assert not flash_attention_usable(q2, k2, v2, causal=True,
+                                      allow_multi_device=True)
+    # multi-device default: kernel not claimed (pjit would replicate inputs)
+    q3 = jnp.zeros((1, 256, 4, 64))
+    k3 = v3 = jnp.zeros((1, 256, 4, 64))
+    if jax.device_count() > 1:
+        assert not flash_attention_usable(q3, k3, v3, causal=True)
+
+
+def test_shape_validation():
+    q = jnp.zeros((1, 150, 4, 64))
+    k = v = jnp.zeros((1, 150, 4, 64))
+    with pytest.raises(ValueError, match="divisible by block"):
+        flash_attention(q, k, v, causal=True)
