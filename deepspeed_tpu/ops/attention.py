@@ -47,15 +47,23 @@ def _xla_attention(q, k, v, *, causal, positions, kv_len, mask):
 
 
 def dot_product_attention(q, k, v, *, causal: bool = True, positions=None,
-                          kv_len=None, mask=None, impl: str = "auto"):
-    """q: [B,Sq,H,D]; k/v: [B,Skv,KV,D] (KV divides H for GQA)."""
+                          kv_len=None, mask=None, impl: str = "auto",
+                          allow_multi_device: bool = False):
+    """q: [B,Sq,H,D]; k/v: [B,Skv,KV,D] (KV divides H for GQA).
+
+    ``allow_multi_device`` must ONLY be set by callers running per-shard
+    inside shard_map (e.g. parallel/sequence.py): pallas_call has no GSPMD
+    partitioning rule, so claiming the kernel inside a pjit-sharded model on
+    a multi-device mesh would force q/k/v replication. ``impl='pallas'``
+    alone does not opt in.
+    """
     if impl in ("auto", "pallas"):
         try:
             from .pallas.flash_attention import flash_attention_usable, flash_attention
 
             if flash_attention_usable(q, k, v, causal=causal, positions=positions,
                                       mask=mask,
-                                      allow_multi_device=(impl == "pallas")):
+                                      allow_multi_device=allow_multi_device):
                 return flash_attention(q, k, v, causal=causal)
         except ImportError:
             pass

@@ -30,6 +30,8 @@ import jax.numpy as jnp
 from jax.sharding import PartitionSpec as P
 from jax import shard_map
 
+from .. import comm
+
 NEG_INF = float(jnp.finfo(jnp.float32).min)
 
 
@@ -40,16 +42,12 @@ NEG_INF = float(jnp.finfo(jnp.float32).min)
 def _ulysses_body(q, k, v, *, axis_name: str, attn_fn: Callable):
     """Per-shard body. q/k/v: [B, S/n, H, D] → out [B, S/n, H, D]."""
     # seq-shard → head-shard (reference _SeqAllToAll scatter_idx=2 :90)
-    q = jax.lax.all_to_all(q, axis_name, split_axis=2, concat_axis=1,
-                           tiled=True)
-    k = jax.lax.all_to_all(k, axis_name, split_axis=2, concat_axis=1,
-                           tiled=True)
-    v = jax.lax.all_to_all(v, axis_name, split_axis=2, concat_axis=1,
-                           tiled=True)
+    q = comm.all_to_all(q, axis_name, split_axis=2, concat_axis=1)
+    k = comm.all_to_all(k, axis_name, split_axis=2, concat_axis=1)
+    v = comm.all_to_all(v, axis_name, split_axis=2, concat_axis=1)
     out = attn_fn(q, k, v)
     # head-shard → seq-shard (gather_idx=1)
-    out = jax.lax.all_to_all(out, axis_name, split_axis=1, concat_axis=2,
-                             tiled=True)
+    out = comm.all_to_all(out, axis_name, split_axis=1, concat_axis=2)
     return out
 
 
@@ -63,7 +61,11 @@ def ulysses_attention(q, k, v, mesh, *, axis: str = "seq",
     """
     if attn_fn is None:
         from ..ops.attention import dot_product_attention
-        attn_fn = functools.partial(dot_product_attention, causal=causal)
+
+        # per-shard inside shard_map → safe (and intended) to use the
+        # Pallas flash kernel even on a multi-device mesh
+        attn_fn = functools.partial(dot_product_attention, causal=causal,
+                                    allow_multi_device=True)
     n = mesh.shape[axis]
     if q.shape[2] % n or k.shape[2] % n:
         raise ValueError(
@@ -111,8 +113,8 @@ def _ring_body(q, k, v, *, axis_name: str, causal: bool, scale: float):
     q/k/v: [B, S_loc, H|KV, D]. Shard i owns global positions
     [i*S_loc, (i+1)*S_loc). Online softmax in fp32.
     """
-    n = jax.lax.psum(1, axis_name)
-    idx = jax.lax.axis_index(axis_name)
+    n = jax.lax.axis_size(axis_name)
+    idx = comm.axis_index(axis_name)
     B, S, H, D = q.shape
     KV = k.shape[2]
     G = H // KV
@@ -124,8 +126,6 @@ def _ring_body(q, k, v, *, axis_name: str, causal: bool, scale: float):
     m = jnp.full((B, KV, G, S, 1), NEG_INF, jnp.float32)
     l = jnp.zeros((B, KV, G, S, 1), jnp.float32)
     acc = jnp.zeros((B, KV, G, S, D), jnp.float32)
-
-    perm = [(i, (i + 1) % n) for i in range(n)]          # send to right
 
     for step in range(n):
         src = (idx - step) % n                           # owner of current k/v
@@ -145,8 +145,8 @@ def _ring_body(q, k, v, *, axis_name: str, causal: bool, scale: float):
             "bhgqk,bkhd->bhgqd", p, v.astype(jnp.float32))
         m = m_new
         if step != n - 1:
-            k = jax.lax.ppermute(k, axis_name, perm)
-            v = jax.lax.ppermute(v, axis_name, perm)
+            k = comm.send_recv_next(k, axis_name)        # rotate ring rightward
+            v = comm.send_recv_next(v, axis_name)
 
     l_safe = jnp.where(l == 0.0, 1.0, l)
     out = (acc / l_safe).astype(q.dtype)                 # [B,KV,G,S,D]
@@ -178,26 +178,24 @@ def ring_attention(q, k, v, mesh, *, axis: str = "seq", causal: bool = True,
 
 def _vp_ce_body(logits, labels, *, axis_name: str, ignore_index: int):
     """logits: [B, S, V/n] local shard; labels: [B, S] global ids."""
-    n = jax.lax.psum(1, axis_name)
-    idx = jax.lax.axis_index(axis_name)
+    idx = comm.axis_index(axis_name)
     V_loc = logits.shape[-1]
     lo = idx * V_loc
 
     logits = logits.astype(jnp.float32)
     local_max = jnp.max(logits, axis=-1)
-    gmax = jax.lax.pmax(local_max, axis_name)                    # [B,S]
+    gmax = comm.all_reduce(local_max, axis_name, op="max")       # [B,S]
     sumexp = jnp.sum(jnp.exp(logits - gmax[..., None]), axis=-1)
-    gsum = jax.lax.psum(sumexp, axis_name)                       # [B,S]
+    gsum = comm.all_reduce(sumexp, axis_name)                    # [B,S]
 
     in_shard = (labels >= lo) & (labels < lo + V_loc)
     local_label = jnp.clip(labels - lo, 0, V_loc - 1)
     picked = jnp.take_along_axis(logits, local_label[..., None],
                                  axis=-1)[..., 0]
-    target_logit = jax.lax.psum(jnp.where(in_shard, picked, 0.0), axis_name)
+    target_logit = comm.all_reduce(jnp.where(in_shard, picked, 0.0), axis_name)
 
     nll = jnp.log(gsum) + gmax - target_logit                    # [B,S]
     mask = (labels != ignore_index).astype(jnp.float32)
-    del n
     return jnp.sum(nll * mask) / jnp.maximum(jnp.sum(mask), 1.0)
 
 

@@ -82,10 +82,13 @@ class MeshConfig:
                     f"device count {num_devices} not divisible by fixed mesh product {fixed}")
             sizes[auto_axes[0]] = num_devices // fixed
         else:
-            if fixed != num_devices:
+            if fixed > num_devices:
                 raise ValueError(
-                    f"mesh product {fixed} != device count {num_devices}; "
+                    f"mesh product {fixed} > device count {num_devices}; "
                     f"set one axis to 'auto' or fix the sizes")
+            # fixed < num_devices: run on the first `fixed` devices (the
+            # analogue of launching on a rank subset via --include,
+            # reference launcher/runner.py:265).
         return {name: sizes[name] for name in AXIS_ORDER}
 
 
@@ -100,7 +103,13 @@ class MeshTopology:
         devices = list(devices if devices is not None else jax.devices())
         self.axis_sizes = config.resolve(len(devices))
         shape = tuple(self.axis_sizes[a] for a in AXIS_ORDER)
-        dev_array = np.asarray(devices).reshape(shape)
+        n_used = int(np.prod(shape))
+        if n_used < len(devices):
+            logger.warning(
+                f"mesh uses {n_used} of {len(devices)} devices; "
+                f"{len(devices) - n_used} devices idle (set an axis to 'auto' "
+                f"to absorb them)")
+        dev_array = np.asarray(devices[:n_used]).reshape(shape)
         self.mesh = Mesh(dev_array, AXIS_ORDER)
         desc = " ".join(f"{a}={s}" for a, s in self.axis_sizes.items() if s > 1)
         logger.info(f"mesh: {desc or 'single device'}")

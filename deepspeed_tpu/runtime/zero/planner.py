@@ -110,9 +110,12 @@ class ZeroPlan:
 
 
 
-def _translate_logical(spec: P | None, ndim: int, topology: MeshTopology,
+def _translate_logical(spec: P | None, shape: tuple[int, ...], topology: MeshTopology,
                        rules: dict[str, str | None]) -> list[Any]:
-    """Map logical axis names to mesh axes, dropping size-1 mesh axes."""
+    """Map logical axis names to mesh axes, dropping size-1 mesh axes and
+    dims not divisible by the axis extent (e.g. GQA kv_heads < tensor size
+    → replicate the kv projection, Megatron's small-kv fallback)."""
+    ndim = len(shape)
     entries: list[Any] = [None] * ndim
     if spec is None:
         return entries
@@ -120,8 +123,15 @@ def _translate_logical(spec: P | None, ndim: int, topology: MeshTopology,
         if name is None or i >= ndim:
             continue
         mesh_axis = rules.get(name, None)
-        if mesh_axis is not None and topology.size(mesh_axis) > 1:
+        if mesh_axis is None or topology.size(mesh_axis) <= 1:
+            continue
+        if shape[i] % topology.size(mesh_axis) == 0:
             entries[i] = mesh_axis
+        else:
+            logger.warning(
+                f"param dim '{name}' of size {shape[i]} (shape {shape}) not "
+                f"divisible by mesh axis '{mesh_axis}'={topology.size(mesh_axis)}"
+                f" — replicating that dim (consider padding, e.g. vocab)")
     return entries
 
 
@@ -171,7 +181,7 @@ def build_plan(topology: MeshTopology, zero_config: ZeroConfig,
     def leaf_specs(leaf):
         leaf_val, logical = _leaf_spec_from_metadata(leaf)
         shape = tuple(leaf_val.shape)
-        base = _translate_logical(logical, len(shape), topology, rules)
+        base = _translate_logical(logical, shape, topology, rules)
 
         # compute-param spec: fsdp only at stage 3, and only for big params
         p_entries = list(base)

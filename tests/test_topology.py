@@ -19,9 +19,15 @@ def test_fixed_sizes():
     assert topo.tp_world_size == 2
 
 
-def test_mismatched_product_rejected():
+def test_oversized_product_rejected():
     with pytest.raises(ValueError):
-        MeshTopology({"data": 3, "fsdp": 2})  # 6 != 8, no auto
+        MeshTopology({"data": 3, "fsdp": 4})  # 12 > 8
+
+
+def test_undersized_product_uses_device_subset():
+    # 6 < 8 devices: run on the first 6 (the --include analogue)
+    topo = MeshTopology({"data": 3, "fsdp": 2})
+    assert topo.num_devices == 6
 
 
 def test_two_autos_rejected():
