@@ -36,17 +36,17 @@ import jax.numpy as jnp
 
 from ..ops.attention import dot_product_attention
 
-# Logical activation axis names (mapped to mesh axes by engine rules):
-BATCH = "act_batch"
-SEQ = "act_seq"
-EMBED = "act_embed"
-HEADS = "act_heads"
-MLP = "act_mlp"
-EXPERT = "act_expert"
-
-
-def constrain(x: jax.Array, *names: str | None) -> jax.Array:
-    return nn.with_logical_constraint(x, tuple(names))
+# Logical activation axis names (canonical home: parallel/axes.py);
+# re-exported here for back-compat.
+from ..parallel.axes import (  # noqa: E402
+    BATCH,
+    EMBED,
+    EXPERT,
+    HEADS,
+    MLP,
+    SEQ,
+    constrain,
+)
 
 
 def default_activation_rules(topology) -> list[tuple[str, Any]]:
@@ -271,84 +271,28 @@ class DenseFFN(nn.Module):
 
 
 class MoEFFN(nn.Module):
-    """Top-k routed expert FFN with capacity (GShard dense dispatch).
-
-    TPU-native version of reference moe/sharded_moe.py (``TopKGate`` :449,
-    ``MOELayer`` :533, ``_AllToAll`` :96): the dispatch/combine einsums below
-    become the expert all-to-all under GSPMD because tokens are sharded over
-    the batch axes while expert tensors are sharded over 'expert'.
-    """
+    """Routed expert FFN — thin adapter over the first-class MoE layer
+    (deepspeed_tpu/moe/layer.py; reference deepspeed/moe/layer.py:17)."""
     config: ModelConfig
 
     @nn.compact
     def __call__(self, x, deterministic: bool = True):
+        from ..moe.layer import MoE
+
         cfg = self.config
         moe = cfg.moe
-        B, S, E = x.shape
-        n_exp, k = moe.num_experts, moe.top_k
-        tokens = B * S
-        cap_factor = moe.eval_capacity_factor if deterministic else moe.capacity_factor
-        capacity = max(int(k * tokens / n_exp * cap_factor / max(B, 1)), moe.min_capacity)
-        # capacity is per batch-group: route within each batch row group for
-        # a static shape that shards over the batch axes.
-        x2 = x.reshape(B, S, E)
-
-        wr = self.param("w_router", nn.with_partitioning(_dense_init(), ("embed", "expert")),
-                        (E, n_exp), jnp.float32)
-        logits = jnp.einsum("bse,en->bsn", x2.astype(jnp.float32), wr)  # router in fp32
-        probs = jax.nn.softmax(logits, axis=-1)
-
-        # --- top-k gating with capacity (reference top2gating :290) -------
-        gate_vals, expert_idx = jax.lax.top_k(probs, k)            # [B,S,k]
-        # position of each token within its expert's queue
-        onehot = jax.nn.one_hot(expert_idx, n_exp, dtype=jnp.float32)  # [B,S,k,n]
-        # priority: earlier tokens + higher k-rank first
-        flat = onehot.reshape(B, S * k, n_exp)
-        pos_in_expert = jnp.cumsum(flat, axis=1) * flat - 1.0          # [B,S*k,n]
-        pos_in_expert = pos_in_expert.reshape(B, S, k, n_exp)
-        keep = (pos_in_expert < capacity) & (onehot > 0)
-        pos = jnp.clip(jnp.sum(pos_in_expert * onehot, axis=-1), 0, capacity - 1)  # [B,S,k]
-        kept_gate = gate_vals * jnp.sum(keep, axis=-1)                  # zero dropped
-
-        # renormalize top-k gates (mixtral style)
-        denom = jnp.sum(kept_gate, axis=-1, keepdims=True)
-        kept_gate = kept_gate / jnp.maximum(denom, 1e-9)
-
-        # aux load-balance loss (reference sharded_moe.py top1gating :183)
-        me = jnp.mean(probs, axis=(0, 1))                  # [n]
-        ce = jnp.mean(jnp.sum(onehot, axis=2), axis=(0, 1))  # fraction routed
-        aux_loss = jnp.sum(me * ce) * n_exp * moe.aux_loss_weight
-        z_loss = jnp.mean(jnp.square(jax.nn.logsumexp(logits, axis=-1))) * \
-            moe.router_z_loss_weight
-        self.sow("losses", "moe_aux_loss", aux_loss + z_loss)
-
-        # --- dispatch: [B,S,E] → [B,n,cap,E] --------------------------------
-        # combine[b,s,k_,n,c] = kept_gate * onehot(pos)
-        pos_oh = jax.nn.one_hot(pos.astype(jnp.int32), capacity,
-                                dtype=jnp.float32)                      # [B,S,k,cap]
-        dispatch = jnp.einsum("bskn,bskc->bsnc",
-                              keep.astype(jnp.float32) * onehot, pos_oh)  # [B,S,n,cap]
-        combine = jnp.einsum("bsk,bskn,bskc->bsnc", kept_gate,
-                             keep.astype(jnp.float32) * onehot, pos_oh)
-
-        expert_in = jnp.einsum("bsnc,bse->nbce", dispatch.astype(cfg.dtype), x2)
-        expert_in = constrain(expert_in, EXPERT, BATCH, None, EMBED)
-
-        # --- expert FFN (grouped GEMM over the expert dim) ----------------
-        F = cfg.ffn_size
-        wg = self.param("w_gate", nn.with_partitioning(_dense_init(), ("expert", "embed", "expert_mlp")),
-                        (n_exp, E, F), jnp.float32)
-        wu = self.param("w_up", nn.with_partitioning(_dense_init(), ("expert", "embed", "expert_mlp")),
-                        (n_exp, E, F), jnp.float32)
-        wd = self.param("w_down", nn.with_partitioning(_dense_init(), ("expert", "expert_mlp", "embed")),
-                        (n_exp, F, E), jnp.float32)
-        h = jax.nn.silu(jnp.einsum("nbce,nef->nbcf", expert_in, wg.astype(cfg.dtype))) * \
-            jnp.einsum("nbce,nef->nbcf", expert_in, wu.astype(cfg.dtype))
-        expert_out = jnp.einsum("nbcf,nfe->nbce", h, wd.astype(cfg.dtype))
-        expert_out = constrain(expert_out, EXPERT, BATCH, None, EMBED)
-
-        out = jnp.einsum("bsnc,nbce->bse", combine.astype(cfg.dtype), expert_out)
-        return constrain(out, BATCH, SEQ, EMBED)
+        return MoE(
+            hidden_size=cfg.hidden_size,
+            num_experts=moe.num_experts,
+            ffn_size=cfg.ffn_size,
+            k=moe.top_k,
+            capacity_factor=moe.capacity_factor,
+            eval_capacity_factor=moe.eval_capacity_factor,
+            min_capacity=moe.min_capacity,
+            activation="silu_glu" if cfg.activation == "silu_glu" else "gelu",
+            aux_loss_weight=moe.aux_loss_weight,
+            z_loss_weight=moe.router_z_loss_weight,
+            name="moe_layer")(x, deterministic)
 
 
 class Block(nn.Module):

@@ -1,0 +1,9 @@
+"""Mixture-of-Experts (reference deepspeed/moe/)."""
+from .layer import MoE, Experts, TopKGate  # noqa: F401
+from .sharded_moe import (  # noqa: F401
+    GateOutput,
+    compute_capacity,
+    top1gating,
+    top2gating,
+    topkgating,
+)

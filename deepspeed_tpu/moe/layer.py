@@ -1,0 +1,140 @@
+"""First-class MoE layer + experts container.
+
+TPU-native re-design of reference deepspeed/moe/layer.py (``MoE`` :17) and
+experts.py (``Experts`` :13). The reference wraps a user expert module,
+deep-copies it ``num_local_experts`` times, and moves tokens between
+expert-parallel ranks with explicit all-to-alls. Here the experts are ONE
+stacked parameter tree with a leading ``expert`` logical axis (grouped-GEMM
+layout — the megablocks-style formulation the MXU likes) and the
+dispatch/combine einsums lower to the expert all-to-all via GSPMD.
+
+TP↔EP activation remapping (reference moe/mappings.py _gather_tokens /
+_drop_tokens) is likewise a sharding change: the dispatch einsum's operands
+carry batch-axis sharding in, expert-axis sharding out — no manual gather.
+"""
+from __future__ import annotations
+
+from typing import Callable
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+from ..parallel.axes import BATCH, EMBED, EXPERT, SEQ, constrain as _constrain
+from .sharded_moe import GateOutput, topkgating
+
+
+class TopKGate(nn.Module):
+    """Router (reference sharded_moe.py:449 ``TopKGate``): fp32 linear +
+    top-k capacity gating. Sows nothing; returns the GateOutput."""
+    hidden_size: int
+    num_experts: int
+    k: int = 2
+    capacity_factor: float = 1.0
+    eval_capacity_factor: float = 1.0
+    min_capacity: int = 4
+    noisy_gate_policy: str | None = None     # None | 'RSample'
+    drop_tokens: bool = True
+
+    @nn.compact
+    def __call__(self, x: jax.Array, deterministic: bool = True) -> GateOutput:
+        wg = self.param(
+            "wg",
+            nn.with_partitioning(nn.initializers.variance_scaling(
+                1.0, "fan_in", "normal"), ("embed", "expert")),
+            (self.hidden_size, self.num_experts), jnp.float32)
+        logits = jnp.einsum("gse,en->gsn", x.astype(jnp.float32), wg)
+        rng = None
+        if self.noisy_gate_policy == "RSample" and not deterministic:
+            rng = self.make_rng("gating")
+        return topkgating(
+            logits, self.k,
+            self.eval_capacity_factor if deterministic else self.capacity_factor,
+            self.min_capacity, noise_rng=rng, drop_tokens=self.drop_tokens)
+
+
+class Experts(nn.Module):
+    """Stacked expert FFNs (reference experts.py:13) as one grouped GEMM.
+
+    The expert body is a SwiGLU FFN by default; ``activation='gelu'`` picks
+    the GPT-style two-matrix variant.
+    """
+    hidden_size: int
+    ffn_size: int
+    num_experts: int
+    activation: str = "silu_glu"
+
+    @nn.compact
+    def __call__(self, x: jax.Array) -> jax.Array:    # [n, g, cap, E]
+        E, F, n = self.hidden_size, self.ffn_size, self.num_experts
+        init = nn.initializers.variance_scaling(1.0, "fan_in", "normal")
+        dtype = x.dtype
+        if self.activation == "silu_glu":
+            wg = self.param("w_gate", nn.with_partitioning(
+                init, ("expert", "embed", "expert_mlp")), (n, E, F), jnp.float32)
+            wu = self.param("w_up", nn.with_partitioning(
+                init, ("expert", "embed", "expert_mlp")), (n, E, F), jnp.float32)
+            wd = self.param("w_down", nn.with_partitioning(
+                init, ("expert", "expert_mlp", "embed")), (n, F, E), jnp.float32)
+            h = jax.nn.silu(jnp.einsum("ngce,nef->ngcf", x, wg.astype(dtype))) * \
+                jnp.einsum("ngce,nef->ngcf", x, wu.astype(dtype))
+        else:
+            wu = self.param("w_up", nn.with_partitioning(
+                init, ("expert", "embed", "expert_mlp")), (n, E, F), jnp.float32)
+            wd = self.param("w_down", nn.with_partitioning(
+                init, ("expert", "expert_mlp", "embed")), (n, F, E), jnp.float32)
+            h = jax.nn.gelu(jnp.einsum("ngce,nef->ngcf", x, wu.astype(dtype)))
+        return jnp.einsum("ngcf,nfe->ngce", h, wd.astype(dtype))
+
+
+class MoE(nn.Module):
+    """The user-facing MoE layer (reference moe/layer.py:17 ``MoE``).
+
+    Input [B, S, E] (batch-sharded) → routed expert FFN → [B, S, E].
+    Sows ``losses/moe_aux_loss`` (weighted aux + z loss) for the engine's
+    loss function to pick up — the role of the reference's l_aux return.
+    """
+    hidden_size: int
+    num_experts: int = 8
+    ffn_size: int | None = None
+    k: int = 2
+    capacity_factor: float = 1.0
+    eval_capacity_factor: float = 1.0
+    min_capacity: int = 4
+    noisy_gate_policy: str | None = None
+    drop_tokens: bool = True
+    activation: str = "silu_glu"
+    aux_loss_weight: float = 0.01
+    z_loss_weight: float = 0.001
+
+    @nn.compact
+    def __call__(self, x: jax.Array, deterministic: bool = True) -> jax.Array:
+        B, S, E = x.shape
+        dtype = x.dtype
+        gate = TopKGate(
+            hidden_size=self.hidden_size, num_experts=self.num_experts,
+            k=self.k, capacity_factor=self.capacity_factor,
+            eval_capacity_factor=self.eval_capacity_factor,
+            min_capacity=self.min_capacity,
+            noisy_gate_policy=self.noisy_gate_policy,
+            drop_tokens=self.drop_tokens, name="gate")(x, deterministic)
+
+        self.sow("losses", "moe_aux_loss",
+                 gate.aux_loss * self.aux_loss_weight +
+                 gate.z_loss * self.z_loss_weight)
+
+        # dispatch: [B,S,E] tokens → [n, B, cap, E] expert inputs. Under
+        # GSPMD this einsum IS the expert all-to-all (_AllToAll :96).
+        expert_in = jnp.einsum("gsnc,gse->ngce",
+                               gate.dispatch.astype(dtype), x)
+        expert_in = _constrain(expert_in, EXPERT, BATCH, None, EMBED)
+
+        expert_out = Experts(
+            hidden_size=self.hidden_size,
+            ffn_size=self.ffn_size or 4 * self.hidden_size,
+            num_experts=self.num_experts,
+            activation=self.activation, name="experts")(expert_in)
+        expert_out = _constrain(expert_out, EXPERT, BATCH, None, EMBED)
+
+        out = jnp.einsum("gsnc,ngce->gse", gate.combine.astype(dtype), expert_out)
+        return _constrain(out, BATCH, SEQ, EMBED)

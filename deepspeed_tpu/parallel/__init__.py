@@ -1,3 +1,11 @@
+from .pipeline import (  # noqa: F401
+    LayerSpec,
+    PipelinedTransformerLM,
+    PipelineModule,
+    TiedLayerSpec,
+    initialize_pipelined,
+    spmd_pipeline,
+)
 from .topology import (  # noqa: F401
     AXIS_ORDER,
     BATCH_AXES,

@@ -1,0 +1,23 @@
+"""Canonical logical activation axis names.
+
+One vocabulary shared by the model zoo (models/transformer.py), the MoE
+package (moe/layer.py), and the engine's rule table — the names here map to
+mesh axes via ``default_activation_rules``. Keeping them in one module means
+a rename cannot silently desynchronize a with_logical_constraint from the
+installed rules.
+"""
+from __future__ import annotations
+
+import flax.linen as nn
+import jax
+
+BATCH = "act_batch"
+SEQ = "act_seq"
+EMBED = "act_embed"
+HEADS = "act_heads"
+MLP = "act_mlp"
+EXPERT = "act_expert"
+
+
+def constrain(x: jax.Array, *names: str | None) -> jax.Array:
+    return nn.with_logical_constraint(x, tuple(names))

@@ -1,0 +1,398 @@
+"""Pipeline parallelism — SPMD circular pipeline over the ``pipe`` mesh axis.
+
+TPU-native re-design of reference runtime/pipe/ (``PipelineModule``
+module.py:86, ``LayerSpec`` :30, ``TiedLayerSpec`` :77, ``PipelineEngine``
+engine.py:61 with its ``_exec_*`` instruction interpreter and the 1F1B
+``TrainSchedule`` schedule.py:189, p2p send/recv p2p.py).
+
+The reference is MPMD: each stage is a different process running an
+instruction schedule, exchanging activations over NCCL p2p. On TPU the
+idiomatic equivalent is a *single* SPMD program: every device runs the same
+per-stage function; stage identity is the device's index along the ``pipe``
+mesh axis; the p2p send/recv pair is one ``ppermute`` ring shift; and the
+schedule is a ``lax.scan`` over ``M + P - 1`` ticks (M microbatches through
+P stages — a GPipe/circular schedule; its bubble fraction (P-1)/(M+P-1) is
+identical to 1F1B, which differs only in activation liveness, a concern the
+XLA scheduler + rematerialization own here).
+
+Composition with the other axes: the shard_map is *partial* — only ``pipe``
+is manual; data/fsdp/tensor/seq stay GSPMD-auto inside the stage body, so
+ZeRO sharding and Megatron TP compose unchanged with pipelining.
+
+Tied weights (``TiedLayerSpec``): under SPMD there is no tied-weight
+replica + allreduce protocol (reference pipe/module.py:77, engine.py:275) —
+tying is simply reusing one parameter pytree leaf in two places; autodiff
+sums the contributions. See ``PipelinedTransformerLM.tie_embeddings``.
+"""
+from __future__ import annotations
+
+import dataclasses
+from functools import partial
+from typing import Any, Callable, Sequence
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
+
+from .. import comm
+from ..utils.logging import logger
+
+Pytree = Any
+
+
+# ---------------------------------------------------------------------------
+# Core primitive
+# ---------------------------------------------------------------------------
+
+def spmd_pipeline(stage_fn: Callable[[Pytree, jax.Array, Pytree], jax.Array],
+                  stage_params: Pytree,
+                  xs: jax.Array,
+                  aux: Pytree = None,
+                  *,
+                  mesh,
+                  axis: str = "pipe",
+                  remat: bool = True) -> jax.Array:
+    """Run microbatches through a P-stage pipeline laid out on mesh ``axis``.
+
+    ``stage_params``: pytree whose leaves have leading dim L (total layers),
+    L divisible by P; dim 0 is sharded over ``axis`` so each stage holds
+    L/P layers. ``stage_fn(local_params, x, aux_m)`` consumes one
+    microbatch activation plus that microbatch's aux inputs and must return
+    an array of the same shape/dtype as ``x`` (the inter-stage wire format).
+
+    ``xs``: [M, ...] microbatched activations entering stage 0.
+    ``aux``: optional pytree of [M, ...] per-microbatch side inputs
+    (positions, masks) that every stage can read.
+
+    Returns [M, ...] — the final stage's outputs, in microbatch order.
+    """
+    n = mesh.shape[axis]
+    M = xs.shape[0]
+    fn = jax.checkpoint(stage_fn) if remat else stage_fn
+
+    if n == 1:
+        def seq_step(_, t):
+            aux_m = jax.tree.map(lambda a: jax.lax.dynamic_index_in_dim(
+                a, t, 0, keepdims=False), aux)
+            x = jax.lax.dynamic_index_in_dim(xs, t, 0, keepdims=False)
+            return None, fn(stage_params, x, aux_m)
+
+        _, ys = jax.lax.scan(seq_step, None, jnp.arange(M))
+        return ys
+
+    def body(params, xs, aux):
+        # squeeze the broadcast stage dim (see below)
+        xs = xs[0]
+        aux = jax.tree.map(lambda a: a[0], aux)
+        idx = jax.lax.axis_index(axis)
+        T = M + n - 1
+        state0 = jnp.zeros_like(xs[0])
+
+        def step(state, t):
+            # stage `idx` works on microbatch m = t - idx at tick t
+            m = jnp.clip(t - idx, 0, M - 1)
+            inp = jax.lax.dynamic_index_in_dim(xs, m, 0, keepdims=False)
+            cur = jnp.where(idx == 0, inp, state)
+            aux_m = jax.tree.map(lambda a: jax.lax.dynamic_index_in_dim(
+                a, m, 0, keepdims=False), aux)
+            y = fn(params, cur, aux_m)
+            nxt = comm.send_recv_next(y, axis)   # the p2p.py send/recv pair
+            return nxt, y
+
+        _, ys = jax.lax.scan(step, state0, jnp.arange(T))
+        return ys[None]                          # [1, T, ...] per stage
+
+    # Inputs are broadcast over a leading pipe-sharded stage dim rather than
+    # passed with a replicated in_spec: the cotangent of a replicated input
+    # would need a psum over the manual axis, which the XLA SPMD partitioner
+    # miscompiles for partial-manual shard_maps (jaxlib 0.9.0 crashes with
+    # "Invalid binary instruction opcode copy"); a broadcast's transpose is a
+    # plain GSPMD reduction outside the shard_map, which is also free to
+    # schedule better.
+    xs_b = jnp.broadcast_to(xs[None], (n, *xs.shape))
+    aux_b = jax.tree.map(lambda a: jnp.broadcast_to(a[None], (n, *a.shape)), aux)
+    out = jax.shard_map(
+        body,
+        mesh=mesh,
+        axis_names={axis},
+        in_specs=(jax.tree.map(lambda _: P(axis), stage_params), P(axis), P(axis)),
+        out_specs=P(axis),
+        check_vma=False,
+    )(stage_params, xs_b, aux_b)
+    # final stage's outputs appear at ticks n-1 .. n-1+M
+    return out[n - 1, n - 1:n - 1 + M]
+
+
+def stack_layer_params(module, rng: jax.Array, num_layers: int,
+                       *init_args) -> Pytree:
+    """Init ``num_layers`` independent copies of ``module``'s params stacked
+    on a leading dim carrying the ``pipe_layers`` logical axis (the ZeRO
+    planner maps it to the ``pipe`` mesh axis; remaining dims then get
+    fsdp/tensor sharding — ZeRO × TP × PP composition for free)."""
+    import flax.linen as nn
+
+    from ..runtime.zero.planner import unbox_params
+
+    def init_one(r):
+        return module.init(r, *init_args)["params"]
+
+    boxed = jax.eval_shape(init_one, rng)
+    rngs = jax.random.split(rng, num_layers)
+    stacked = jax.vmap(lambda r: unbox_params(init_one(r)))(rngs)
+
+    def rebox(spec_leaf, value):
+        names = spec_leaf.names if isinstance(spec_leaf, nn.Partitioned) else \
+            (None,) * (value.ndim - 1)
+        return nn.Partitioned(value, names=("pipe_layers", *names))
+
+    return jax.tree.map(rebox, boxed, stacked,
+                        is_leaf=lambda l: isinstance(l, nn.Partitioned))
+
+
+# ---------------------------------------------------------------------------
+# LayerSpec / PipelineModule (API parity)
+# ---------------------------------------------------------------------------
+
+@dataclasses.dataclass
+class LayerSpec:
+    """Deferred layer construction (reference pipe/module.py:30)."""
+    module_cls: type
+    args: tuple = ()
+    kwargs: dict = dataclasses.field(default_factory=dict)
+
+    def build(self):
+        return self.module_cls(*self.args, **self.kwargs)
+
+
+@dataclasses.dataclass
+class TiedLayerSpec(LayerSpec):
+    """Reference pipe/module.py:77. Under SPMD, tying is parameter reuse —
+    ``key`` identifies the shared parameter group. PipelineModule's uniform
+    staged stack cannot express tying (rejects these specs); see
+    ``PipelinedTransformerLM.tie_embeddings`` for the embed/head tie."""
+    key: str = "tied"
+
+
+class PipelineModule:
+    """A uniform stack of layers partitioned over the ``pipe`` axis
+    (reference runtime/pipe/module.py:86, ``partition_method='uniform'``).
+
+    All specs must describe the SAME module class/config (SPMD pipelining
+    requires homogeneous stages); embedding/head layers live outside the
+    staged stack (see ``PipelinedTransformerLM`` for the full-LM pattern).
+
+    ``init(rng, x, *apply_args)`` → boxed params with leading logical axis
+    ``pipe_layers`` (the ZeRO planner maps it to the ``pipe`` mesh axis and
+    then applies fsdp/tensor sharding to the remaining dims — ZeRO × TP × PP
+    composition for free).
+    ``apply(params, xs, aux=None)`` → pipelined forward over microbatches.
+    """
+
+    def __init__(self, layers: Sequence[LayerSpec], topology,
+                 num_microbatches: int, *, remat: bool = True):
+        if not layers:
+            raise ValueError("PipelineModule needs at least one LayerSpec")
+        if any(isinstance(s, TiedLayerSpec) for s in layers):
+            raise NotImplementedError(
+                "TiedLayerSpec inside the staged stack is not supported: tie "
+                "parameters by reusing one pytree leaf outside the stack "
+                "(see PipelinedTransformerLM.tie_embeddings)")
+        first = layers[0]
+        for spec in layers[1:]:
+            if (spec.module_cls, spec.args, tuple(sorted(spec.kwargs.items()))) != (
+                    first.module_cls, first.args, tuple(sorted(first.kwargs.items()))):
+                raise ValueError(
+                    "SPMD pipelining requires homogeneous stages: all LayerSpecs "
+                    "must build the same module (put embed/head outside the stack)")
+        self.num_layers = len(layers)
+        self.module = first.build()
+        self.topology = topology
+        self.num_microbatches = num_microbatches
+        self.remat = remat
+        pp = topology.size("pipe")
+        if self.num_layers % pp != 0:
+            raise ValueError(f"{self.num_layers} layers not divisible by "
+                             f"pipe={pp} stages")
+        self.layers_per_stage = self.num_layers // pp
+
+    def init(self, rng: jax.Array, x: jax.Array, *apply_args) -> Pytree:
+        return stack_layer_params(self.module, rng, self.num_layers,
+                                  x, *apply_args)
+
+    def apply(self, params: Pytree, xs: jax.Array, aux: Pytree = None,
+              extra_apply_args: tuple = ()) -> jax.Array:
+        def stage_fn(local_params, x, aux_m):
+            def layer(x, p):
+                args = (aux_m,) if aux is not None else ()
+                return self.module.apply({"params": p}, x,
+                                         *args, *extra_apply_args), None
+
+            x, _ = jax.lax.scan(layer, x, local_params)
+            return x
+
+        return spmd_pipeline(stage_fn, params, xs, aux,
+                             mesh=self.topology.mesh, remat=self.remat)
+
+
+# ---------------------------------------------------------------------------
+# Flagship integration: pipelined causal LM
+# ---------------------------------------------------------------------------
+
+class PipelinedTransformerLM:
+    """TransformerLM with its block stack run through the SPMD pipeline —
+    the role of the reference's GPT2ModelPipe-style models built on
+    ``PipelineModule``. Functional (init/apply/loss_fn) rather than flax, so
+    the engine drives it through ``initialize(loss_fn=..., params=...)``.
+
+    Embedding, final norm, and the (tied) LM head run under plain GSPMD on
+    every pipe rank (they are < 1% of FLOPs; replicating their compute over
+    ``pipe`` costs nothing and avoids heterogeneous stages).
+    """
+
+    def __init__(self, config, topology, num_microbatches: int,
+                 *, remat: bool = True):
+        from ..models.transformer import Block
+
+        if config.moe is not None:
+            raise NotImplementedError(
+                "MoE + pipeline in one model is not supported yet "
+                "(aux-loss plumbing through shard_map)")
+        self.config = config
+        self.topology = topology
+        self.num_microbatches = num_microbatches
+        cfg = config
+        self._block_mod = Block(cfg)
+        pp = topology.size("pipe")
+        if cfg.num_layers % pp != 0:
+            raise ValueError(f"{cfg.num_layers} layers not divisible by pipe={pp}")
+        self.remat = remat
+
+    # -- params ------------------------------------------------------------
+    def init(self, rng: jax.Array, sample_ids: jax.Array) -> Pytree:
+        import flax.linen as nn
+
+        from ..models.transformer import Norm
+
+        cfg = self.config
+        B, S = sample_ids.shape
+        x = jnp.zeros((1, S, cfg.hidden_size), cfg.dtype)
+        pos = jnp.zeros((1, S), jnp.int32)
+
+        r_embed, r_pos, r_blocks, r_norm, r_head = jax.random.split(rng, 5)
+
+        blocks = stack_layer_params(self._block_mod, r_blocks, cfg.num_layers,
+                                    x, pos)
+
+        params: dict[str, Any] = {
+            "embed": nn.Partitioned(
+                jax.random.normal(r_embed, (cfg.vocab_size, cfg.hidden_size),
+                                  jnp.float32) * 0.02,
+                names=("vocab", "embed")),
+            "blocks": blocks,
+            "ln_final": Norm(cfg).init(r_norm, x)["params"],
+        }
+        if cfg.position_embedding == "learned":
+            params["pos_embed"] = nn.Partitioned(
+                jax.random.normal(r_pos, (cfg.max_seq_len, cfg.hidden_size),
+                                  jnp.float32) * 0.02,
+                names=(None, "embed"))
+        if not cfg.tie_embeddings:
+            params["unembed"] = nn.Partitioned(
+                jax.random.normal(r_head, (cfg.hidden_size, cfg.vocab_size),
+                                  jnp.float32) * 0.02,
+                names=("embed", "vocab"))
+        return params
+
+    # -- forward -----------------------------------------------------------
+    def apply(self, params: Pytree, input_ids: jax.Array) -> jax.Array:
+        from ..models.transformer import BATCH, EMBED, SEQ, Norm, constrain
+
+        cfg = self.config
+        M = self.num_microbatches
+        B, S = input_ids.shape
+        if B % M:
+            raise ValueError(f"batch {B} not divisible by {M} microbatches")
+        mb = B // M
+
+        positions = jnp.broadcast_to(jnp.arange(S, dtype=jnp.int32), (B, S))
+        x = params["embed"].astype(cfg.dtype)[input_ids]
+        if cfg.position_embedding == "learned":
+            x = x + params["pos_embed"].astype(cfg.dtype)[positions]
+        x = constrain(x, BATCH, SEQ, EMBED)
+
+        xs = constrain(x.reshape(M, mb, S, cfg.hidden_size),
+                       None, BATCH, SEQ, EMBED)
+        pos_mb = positions.reshape(M, mb, S)
+
+        def stage_fn(local_params, x, pos):
+            def layer(x, p):
+                return self._block_mod.apply({"params": p}, x, pos), None
+
+            x, _ = jax.lax.scan(layer, x, local_params)
+            return x
+
+        ys = spmd_pipeline(stage_fn, params["blocks"], xs, pos_mb,
+                           mesh=self.topology.mesh, remat=self.remat)
+        x = constrain(ys.reshape(B, S, cfg.hidden_size), BATCH, SEQ, EMBED)
+
+        x = Norm(cfg).apply({"params": params["ln_final"]}, x)
+        if cfg.tie_embeddings:
+            logits = jnp.einsum("bse,ve->bsv", x, params["embed"].astype(cfg.dtype))
+        else:
+            logits = jnp.einsum("bse,ev->bsv", x, params["unembed"].astype(cfg.dtype))
+        return constrain(logits, BATCH, SEQ, None)
+
+    # -- engine plumbing ---------------------------------------------------
+    def loss_fn(self, params: Pytree, batch: dict) -> jax.Array:
+        from ..models.loss import IGNORE_INDEX, cross_entropy_lm
+
+        ids = batch["input_ids"]
+        labels = batch.get("labels")
+        if labels is None:
+            labels = jnp.concatenate(
+                [ids[:, 1:], jnp.full_like(ids[:, :1], IGNORE_INDEX)], axis=1)
+        return cross_entropy_lm(self.apply(params, ids), labels)
+
+
+def initialize_pipelined(model_config, config, topology=None,
+                         num_microbatches: int | None = None, **kwargs):
+    """Bring-up for the pipelined flagship: builds PipelinedTransformerLM,
+    inits params into the planner's sharded layout, and returns the standard
+    ``(engine, optimizer, dataloader, lr_scheduler)`` tuple.
+
+    The pipeline consumes ``num_microbatches`` per ``train_batch`` (default:
+    gradient_accumulation_steps, matching reference PipelineEngine
+    train_batch semantics, pipe/engine.py:337); the engine's own GAS loop is
+    set to 1 — the pipeline IS the microbatch loop.
+    """
+    from ..config import Config
+    from ..parallel.topology import MeshTopology
+    from ..runtime.engine import DeepSpeedEngine
+
+    cfg = Config.load(config)
+    topo = topology or MeshTopology(cfg.mesh)
+    gas = cfg.gradient_accumulation_steps
+    M = num_microbatches or (gas if isinstance(gas, int) else 1)
+    model = PipelinedTransformerLM(model_config, topo, M)
+
+    micro = cfg.train_micro_batch_size_per_gpu
+    if not isinstance(micro, int):
+        raise ValueError("pipelined initialize needs an explicit "
+                         "train_micro_batch_size_per_gpu")
+    B = micro * M * (topo.size("data") * topo.size("expert") * topo.size("fsdp"))
+    S = model_config.max_seq_len
+    sample = jnp.zeros((B, min(S, 128)), jnp.int32)
+    params = model.init(jax.random.PRNGKey(cfg.seed), sample)
+
+    # the pipeline IS the microbatch loop: fold GAS into the per-call batch
+    cfg.gradient_accumulation_steps = 1
+    cfg.train_micro_batch_size_per_gpu = micro * M
+    cfg.train_batch_size = B
+
+    engine = DeepSpeedEngine(config=cfg, loss_fn=model.loss_fn, params=params,
+                             topology=topo, **kwargs)
+    engine.pipeline_model = model
+    logger.info(f"pipelined engine: stages={topo.size('pipe')} "
+                f"microbatches={M} layers/stage="
+                f"{model_config.num_layers // topo.size('pipe')}")
+    return engine, engine.optimizer, None, engine.lr_schedule

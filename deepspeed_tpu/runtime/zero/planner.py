@@ -54,6 +54,7 @@ DEFAULT_LOGICAL_RULES: tuple[tuple[str, str | None], ...] = (
     ("mlp", "tensor"),         # FFN hidden dim
     ("expert", "expert"),      # MoE expert dim
     ("expert_mlp", "tensor"),  # FFN hidden within an expert
+    ("pipe_layers", "pipe"),   # stacked pipeline stages (parallel/pipeline.py)
     ("embed", None),           # model dim — fsdp candidate
     ("head_dim", None),
     ("layers", None),
