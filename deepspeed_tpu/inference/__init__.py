@@ -1,2 +1,9 @@
 from .engine import InferenceConfig, InferenceEngine, init_inference  # noqa: F401
+from .engine_v2 import (  # noqa: F401
+    InferenceEngineV2,
+    RaggedInferenceConfig,
+    build_engine,
+)
+from .ragged import BlockedAllocator, SequenceDescriptor, StateManager  # noqa: F401
 from .sampling import sample_logits  # noqa: F401
+from .scheduler import SplitFuseScheduler  # noqa: F401

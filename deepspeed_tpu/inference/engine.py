@@ -29,10 +29,8 @@ import jax
 import jax.numpy as jnp
 from jax.sharding import NamedSharding, PartitionSpec as P
 
-from ..config import ZeroConfig
 from ..models.transformer import TransformerLM, default_activation_rules
 from ..parallel.topology import BATCH_AXES, MeshConfig, MeshTopology
-from ..runtime.zero.planner import build_plan, unbox_params
 from ..utils.logging import logger
 from .sampling import sample_logits
 
@@ -83,24 +81,10 @@ class InferenceEngine:
         self._rules = default_activation_rules(topology)
 
         # TP-shard (stage-0) plan for the weights: logical rules only.
-        ids0 = jnp.zeros((1, 8), jnp.int32)
-        if params is None:
-            abstract = jax.eval_shape(
-                lambda r: model.init(r, ids0), rng or jax.random.PRNGKey(0))["params"]
-        else:
-            abstract = params
-        plan = build_plan(topology, ZeroConfig(stage=0), abstract)
-        self.plan = plan
-        shardings = plan.param_shardings
-        cast = lambda t: jax.tree.map(
-            lambda x: x.astype(self.config.dtype)
-            if jnp.issubdtype(x.dtype, jnp.floating) else x, t)
-        if params is None:
-            self.params = jax.jit(
-                lambda r: cast(unbox_params(model.init(r, ids0)["params"])),
-                out_shardings=shardings)(rng or jax.random.PRNGKey(0))
-        else:
-            self.params = jax.device_put(cast(unbox_params(params)), shardings)
+        from .weights import load_tp_params
+
+        self.params, self.plan = load_tp_params(model, params, rng, topology,
+                                                self.config.dtype)
 
         self._decode_fns: dict[tuple, Any] = {}
         self._fwd = jax.jit(self._forward_impl)

@@ -1,0 +1,301 @@
+"""Inference engine v2: continuous batching over a paged KV pool (FastGen).
+
+TPU-native re-design of reference inference/v2 (``InferenceEngineV2``
+engine_v2.py:30 with ``put`` :107 / ``query`` :158 / ``can_schedule`` :184 /
+``flush`` :242, ``engine_factory.build_hf_engine`` :69, paged
+``BlockedKVCache`` ragged/kv_cache.py, blocked-flash ragged attention
+kernels kernels/ragged_ops/).
+
+Architecture (TPU-first):
+- KV lives in ONE pool per model: [L, 2, num_blocks * block_size, KV, D],
+  sharded over ``tensor`` on the KV-head dim. Sequences own block lists
+  (host-side allocator, inference/ragged.py).
+- Each step is one of two cached jitted programs — prefill ([S, chunk]
+  prompt chunks) or decode ([S, 1]) — built by the SplitFuse scheduler
+  (inference/scheduler.py). New KV is scattered into the pool by flat token
+  slot; attention gathers each slot's pages via its block table and runs
+  masked attention against them (the XLA formulation of the blocked-flash
+  paged kernel; a Pallas in-place paged kernel is the optimization path).
+- The model is the SAME TransformerLM parameter tree the trainer produces —
+  no weight surgery; the ragged forward reads the tree directly.
+"""
+from __future__ import annotations
+
+import dataclasses
+from dataclasses import dataclass
+from functools import partial
+from typing import Any
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from ..models.transformer import (
+    DenseFFN,
+    ModelConfig,
+    Norm,
+    TransformerLM,
+    default_activation_rules,
+    rope,
+)
+from ..parallel.topology import MeshConfig, MeshTopology
+from ..utils.logging import logger
+from .ragged import StateManager, StepPlan
+from .sampling import sample_logits
+from .scheduler import SplitFuseScheduler
+from .weights import load_tp_params
+
+Pytree = Any
+
+
+@dataclass
+class RaggedInferenceConfig:
+    """Reference inference/v2/config_v2.py ``RaggedInferenceEngineConfig``."""
+    block_size: int = 16
+    num_blocks: int = 256
+    max_seqs: int = 8                 # state_manager max_tracked_sequences
+    chunk: int = 64                   # SplitFuse token budget per prefill step
+    max_seq_len: int = 2048
+    dtype: Any = jnp.bfloat16
+    tensor_parallel: int = 1
+    greedy: bool = True
+    temperature: float = 1.0
+    top_k: int = 0
+    top_p: float = 1.0
+
+
+class InferenceEngineV2:
+    def __init__(self, model: TransformerLM, params: Pytree | None = None,
+                 config: RaggedInferenceConfig | dict | None = None,
+                 topology: MeshTopology | None = None,
+                 rng: jax.Array | None = None):
+        if isinstance(config, dict):
+            config = RaggedInferenceConfig(**config)
+        self.config = config or RaggedInferenceConfig()
+        cfg = self.config
+        self.model = model
+        self.mcfg: ModelConfig = model.config
+        if self.mcfg.moe is not None:
+            raise NotImplementedError("MoE ragged inference lands with the "
+                                      "grouped-GEMM decode path")
+        if topology is None:
+            topology = MeshTopology(MeshConfig(tensor=cfg.tensor_parallel, data=1))
+        self.topology = topology
+        self._rules = default_activation_rules(topology)
+
+        max_blocks_per_seq = -(-cfg.max_seq_len // cfg.block_size)
+        self.state = StateManager(cfg.num_blocks, cfg.block_size, cfg.max_seqs,
+                                  max_blocks_per_seq)
+        self.scheduler = SplitFuseScheduler(self.state, cfg.chunk)
+
+        # --- weights: same tree as the trainer, TP-sharded ---------------
+        self.params, _ = load_tp_params(model, params, rng, topology, cfg.dtype)
+
+        # --- the paged KV pool -------------------------------------------
+        m = self.mcfg
+        pool_tokens = cfg.num_blocks * cfg.block_size
+        kv_spec = P(None, None, None, "tensor", None) \
+            if m.kv_heads % max(topology.size("tensor"), 1) == 0 else \
+            P(None, None, None, None, None)
+        self._pool_sharding = NamedSharding(topology.mesh, kv_spec)
+        self.kv_pool = jax.device_put(
+            jnp.zeros((m.num_layers, 2, pool_tokens, m.kv_heads, m.head_dim),
+                      cfg.dtype), self._pool_sharding)
+
+        self._programs: dict[int, Any] = {}
+        self._rng = jax.random.PRNGKey(17)
+        self._results: dict[int, list[int]] = {}
+        logger.info(
+            f"engine_v2 up: blocks={cfg.num_blocks}x{cfg.block_size} "
+            f"pool={self.kv_pool.nbytes / 1e6:.0f}MB max_seqs={cfg.max_seqs} "
+            f"chunk={cfg.chunk} tp={topology.size('tensor')}")
+
+    # ------------------------------------------------------------------
+    # ragged forward (reads the TransformerLM param tree directly;
+    # reference model_implementations/inference_transformer_base.py:48)
+    # ------------------------------------------------------------------
+    def _ragged_forward(self, params, kv_pool, token_ids, positions, slot_map,
+                        block_tables, seq_lens, sample_idx):
+        m = self.mcfg
+        cfg = self.config
+        S, T = token_ids.shape
+        bs = cfg.block_size
+        ctx = self.state.max_blocks_per_seq * bs
+        H, KV, D = m.num_heads, m.kv_heads, m.head_dim
+
+        x = params["embed"].astype(cfg.dtype)[token_ids]           # [S,T,E]
+        if m.position_embedding == "learned":
+            x = x + params["pos_embed"].astype(cfg.dtype)[positions]
+
+        # flat pool slots this step's tokens write to; padded tokens hit the
+        # trash block (slot_map==0..bs-1 range of block 0)
+        flat_slots = slot_map.reshape(-1)                          # [S*T]
+        # per-slot context token indices from the block table
+        page_index = (block_tables[:, :, None] * bs +
+                      jnp.arange(bs)[None, None, :]).reshape(S, ctx)  # [S,ctx]
+
+        def layer(x, layer_params_and_kv):
+            p, kv = layer_params_and_kv                            # kv [2,P,KV,D]
+            h = Norm(m).apply({"params": p["ln_attn"]}, x)
+            a = p["attn"]
+            q = jnp.einsum("ste,ehd->sthd", h, a["wq"].astype(cfg.dtype))
+            k = jnp.einsum("ste,ehd->sthd", h, a["wk"].astype(cfg.dtype))
+            v = jnp.einsum("ste,ehd->sthd", h, a["wv"].astype(cfg.dtype))
+            if m.position_embedding == "rope":
+                q, k = rope(q, k, positions, m.rope_theta)
+
+            # scatter new KV into the pool (trash block absorbs padding)
+            kv = kv.at[0, flat_slots].set(k.reshape(-1, KV, D).astype(kv.dtype))
+            kv = kv.at[1, flat_slots].set(v.reshape(-1, KV, D).astype(kv.dtype))
+
+            # gather each slot's pages: [S, ctx, KV, D]
+            K = kv[0, page_index]
+            V = kv[1, page_index]
+            if KV != H:
+                K = jnp.repeat(K, H // KV, axis=2)
+                V = jnp.repeat(V, H // KV, axis=2)
+
+            scores = jnp.einsum("sthd,schd->shtc", q, K).astype(jnp.float32)
+            scores = scores / (D ** 0.5)
+            # pages are position-ordered, so context index j IS absolute
+            # position j: valid iff j < seq_len, causal iff j <= query pos
+            cpos = jnp.arange(ctx)[None, :]
+            valid = (cpos < seq_lens[:, None])[:, None, None, :]
+            causal = cpos[:, None, :] <= positions[:, :, None]     # [S,T,ctx]
+            mask = valid & causal[:, None, :, :]
+            scores = jnp.where(mask, scores, jnp.finfo(jnp.float32).min)
+            w = jax.nn.softmax(scores, axis=-1).astype(V.dtype)
+            o = jnp.einsum("shtc,schd->sthd", w, V)
+            o = jnp.einsum("sthd,hde->ste", o, a["wo"].astype(cfg.dtype))
+            x = x + o
+
+            h = Norm(m).apply({"params": p["ln_ffn"]}, x)
+            x = x + DenseFFN(m).apply({"params": p["ffn"]}, h)
+            return x, kv
+
+        new_kv = []
+        for i in range(m.num_layers):
+            x, kv_i = layer(x, (params[f"layer_{i}"], kv_pool[i]))
+            new_kv.append(kv_i)
+        kv_pool = jnp.stack(new_kv)
+
+        x = Norm(m).apply({"params": params["ln_final"]}, x)
+        last = jnp.take_along_axis(
+            x, sample_idx[:, None, None].astype(jnp.int32), axis=1)[:, 0]  # [S,E]
+        if m.tie_embeddings:
+            logits = jnp.einsum("se,ve->sv", last, params["embed"].astype(cfg.dtype))
+        else:
+            logits = jnp.einsum("se,ev->sv", last, params["unembed"].astype(cfg.dtype))
+        return kv_pool, logits
+
+    def _program(self, T: int):
+        if T not in self._programs:
+            def step(params, kv_pool, token_ids, positions, slot_map,
+                     block_tables, seq_lens, sample_idx, rng):
+                with nn.logical_axis_rules(self._rules):
+                    kv_pool, logits = self._ragged_forward(
+                        params, kv_pool, token_ids, positions, slot_map,
+                        block_tables, seq_lens, sample_idx)
+                cfg = self.config
+                toks = sample_logits(logits.astype(jnp.float32), rng,
+                                     temperature=cfg.temperature,
+                                     top_k=cfg.top_k, top_p=cfg.top_p,
+                                     greedy=cfg.greedy)
+                return kv_pool, toks
+
+            self._programs[T] = jax.jit(step, donate_argnums=(1,),
+                                        out_shardings=(self._pool_sharding, None))
+        return self._programs[T]
+
+    # ------------------------------------------------------------------
+    # public API (reference engine_v2.py put/query/flush)
+    # ------------------------------------------------------------------
+    def can_schedule(self, prompt_len: int, max_new_tokens: int = 32) -> bool:
+        """Admission check (reference ``can_schedule`` :184) against the
+        worst-case block budget (blocks are reserved at admit)."""
+        return self.state.can_admit(prompt_len, max_new_tokens)
+
+    def put(self, uid: int, prompt_tokens, max_new_tokens: int = 32) -> None:
+        """Admit a request (reference ``put`` :107). Raises if the pool or
+        slot budget is exhausted — callers gate on ``can_schedule``."""
+        toks = [int(t) for t in prompt_tokens]
+        if not toks:
+            raise ValueError("empty prompt")
+        if len(toks) + max_new_tokens > self.config.max_seq_len:
+            raise ValueError("prompt + max_new_tokens exceeds max_seq_len")
+        if not self.state.can_admit(len(toks), max_new_tokens):
+            raise RuntimeError("cannot schedule: pool/slots exhausted")
+        self.state.admit(uid, toks, max_new_tokens)
+        self._results[uid] = []
+
+    def query(self, uid: int) -> dict:
+        """Request status (reference ``query`` :158)."""
+        seq = self.state.seqs.get(uid)
+        if seq is None:
+            return {"live": False, "generated": self._results.get(uid, [])}
+        return {"live": True, "done": seq.done,
+                "generated": list(self._results[uid]),
+                "n_computed": seq.n_computed}
+
+    def flush(self, uid: int) -> list[int]:
+        """Release a request's KV + slot, returning generated tokens
+        (reference ``flush`` :242)."""
+        if uid in self.state.seqs:
+            self.state.release(uid)
+        return self._results.pop(uid, [])
+
+    def step(self) -> dict[int, int]:
+        """Run one scheduled forward step; returns {uid: sampled_token} for
+        sequences that produced a token. Empty dict = nothing to do."""
+        plan = self.scheduler.next_step()
+        if plan is None:
+            return {}
+        fn = self._program(plan.token_ids.shape[1])
+        self._rng, sub = jax.random.split(self._rng)
+        self.kv_pool, toks = fn(
+            self.params, self.kv_pool,
+            jnp.asarray(plan.token_ids), jnp.asarray(plan.positions),
+            jnp.asarray(plan.slot_map),
+            jnp.asarray(plan.block_tables), jnp.asarray(plan.seq_lens),
+            jnp.asarray(plan.sample_idx), sub)
+        toks = np.asarray(toks)
+        sampled = {uid: int(toks[s]) for s, uid in enumerate(plan.uids)
+                   if uid >= 0 and plan.do_sample[s]}
+        self.scheduler.commit(plan, sampled)
+        for uid, t in sampled.items():
+            self._results[uid].append(t)
+        return sampled
+
+    def generate(self, prompts: list[list[int]], max_new_tokens: int = 32
+                 ) -> list[list[int]]:
+        """Convenience driver: continuous-batch a set of prompts to
+        completion (the MII serving loop, compressed)."""
+        pending = list(enumerate(prompts))
+        out: dict[int, list[int]] = {}
+        live: set[int] = set()
+        while pending or live:
+            while pending and self.can_schedule(len(pending[0][1]),
+                                                max_new_tokens):
+                uid, toks = pending.pop(0)
+                self.put(uid, toks, max_new_tokens)
+                live.add(uid)
+            if not live:
+                raise RuntimeError(
+                    f"prompt of {len(pending[0][1])} tokens can never be "
+                    f"scheduled with num_blocks={self.config.num_blocks}")
+            self.step()
+            for uid in list(live):
+                seq = self.state.seqs.get(uid)
+                if seq is not None and seq.done:
+                    out[uid] = self.flush(uid)
+                    live.remove(uid)
+        return [out[i] for i in range(len(prompts))]
+
+
+def build_engine(model: TransformerLM, params: Pytree | None = None,
+                 config: RaggedInferenceConfig | dict | None = None,
+                 **kwargs) -> InferenceEngineV2:
+    """Factory (reference inference/v2/engine_factory.py:69 build_hf_engine)."""
+    return InferenceEngineV2(model=model, params=params, config=config, **kwargs)

@@ -1,0 +1,137 @@
+"""Host-side ragged batching state: blocked KV allocator + sequence manager.
+
+TPU-native re-design of reference inference/v2/ragged/
+(``BlockedAllocator`` blocked_allocator.py:11, ``DSSequenceDescriptor``
+sequence_descriptor.py, ``DSStateManager`` ragged_manager.py:19,
+``RaggedBatchWrapper`` ragged_wrapper.py:31). This logic is device-agnostic
+bookkeeping in both frameworks — the allocator hands out fixed-size KV
+blocks from a device-resident pool; sequences own block lists; the batch
+wrapper packs per-step descriptors (block tables, positions, lengths) that
+the jitted forward consumes as plain int32 arrays.
+"""
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+
+import numpy as np
+
+
+class BlockedAllocator:
+    """Free-list allocator over ``num_blocks`` KV blocks (reference
+    blocked_allocator.py:11). Block 0 is reserved as the trash block —
+    padded tokens scatter their (masked) KV there."""
+
+    TRASH = 0
+
+    def __init__(self, num_blocks: int):
+        if num_blocks < 2:
+            raise ValueError("need at least 2 blocks (one is reserved)")
+        self.num_blocks = num_blocks
+        self._free: list[int] = list(range(1, num_blocks))
+
+    @property
+    def free_blocks(self) -> int:
+        return len(self._free)
+
+    def allocate(self, n: int) -> list[int]:
+        if n > len(self._free):
+            raise RuntimeError(f"KV pool exhausted: want {n}, "
+                               f"free {len(self._free)}")
+        out, self._free = self._free[:n], self._free[n:]
+        return out
+
+    def free(self, blocks: list[int]) -> None:
+        for b in blocks:
+            if b == self.TRASH or b < 0 or b >= self.num_blocks:
+                raise ValueError(f"bad block id {b}")
+        self._free.extend(blocks)
+
+
+@dataclass
+class SequenceDescriptor:
+    """Per-uid state (reference sequence_descriptor.py DSSequenceDescriptor)."""
+    uid: int
+    tokens: list[int]                 # full token history (prompt + generated)
+    slot: int = -1                    # batch slot while scheduled
+    n_computed: int = 0               # tokens whose KV is already in the pool
+    blocks: list[int] = field(default_factory=list)
+    max_new_tokens: int = 0
+    n_generated: int = 0
+    done: bool = False
+
+    @property
+    def pending_tokens(self) -> int:
+        """Tokens not yet run through the model. > 1 → still prefilling the
+        prompt (chunked); == 1 → the next step is a decode of the last
+        (sampled or final-prompt) token."""
+        return len(self.tokens) - self.n_computed
+
+
+class StateManager:
+    """Tracks live sequences + owns the allocator (reference
+    ragged_manager.py:19 ``DSStateManager``)."""
+
+    def __init__(self, num_blocks: int, block_size: int, max_seqs: int,
+                 max_blocks_per_seq: int):
+        self.allocator = BlockedAllocator(num_blocks)
+        self.block_size = block_size
+        self.max_seqs = max_seqs
+        # static block-table width → step programs never recompile
+        self.max_blocks_per_seq = max_blocks_per_seq
+        self.seqs: dict[int, SequenceDescriptor] = {}
+        self._free_slots = list(range(max_seqs))
+
+    def _blocks_for(self, n_tokens: int) -> int:
+        return -(-n_tokens // self.block_size)
+
+    def can_admit(self, prompt_len: int, max_new_tokens: int = 0) -> bool:
+        """Admission requires the WORST-CASE block budget (prompt + all
+        generated tokens) to be free right now — blocks are reserved at
+        admit time, so a scheduled step can never exhaust the pool mid-run
+        (the failure mode lazy allocation would have)."""
+        need = self._blocks_for(prompt_len + max_new_tokens)
+        return bool(self._free_slots) and self.allocator.free_blocks >= need
+
+    def admit(self, uid: int, tokens: list[int], max_new_tokens: int) -> SequenceDescriptor:
+        if uid in self.seqs:
+            raise ValueError(f"uid {uid} already live")
+        if not tokens:
+            raise ValueError("empty prompt")
+        if not self._free_slots:
+            raise RuntimeError("no free sequence slots")
+        seq = SequenceDescriptor(uid=uid, tokens=list(tokens),
+                                 max_new_tokens=max_new_tokens,
+                                 slot=self._free_slots.pop(0))
+        try:
+            seq.blocks = self.allocator.allocate(
+                self._blocks_for(len(tokens) + max_new_tokens))
+        except RuntimeError:
+            self._free_slots.insert(0, seq.slot)
+            raise
+        self.seqs[uid] = seq
+        return seq
+
+    def release(self, uid: int) -> None:
+        seq = self.seqs.pop(uid)
+        if seq.blocks:
+            self.allocator.free(seq.blocks)
+        if seq.slot >= 0:
+            self._free_slots.append(seq.slot)
+            self._free_slots.sort()
+
+
+@dataclass
+class StepPlan:
+    """One scheduled forward step (the RaggedBatchWrapper analogue): plain
+    arrays the jitted program consumes. All shapes static:
+    [max_seqs, chunk]."""
+    kind: str                         # 'prefill' | 'decode'
+    token_ids: np.ndarray             # [S, T] int32
+    positions: np.ndarray             # [S, T] int32 (pad → 0)
+    slot_map: np.ndarray              # [S, T] int32 → pool token slot (block*bs+off)
+    active: np.ndarray                # [S, T] bool — real tokens
+    block_tables: np.ndarray          # [S, max_blocks] int32
+    seq_lens: np.ndarray              # [S] int32, length incl. this step's tokens
+    sample_idx: np.ndarray            # [S] int32 index into T of last real token
+    do_sample: np.ndarray             # [S] bool — emit a token for this slot
+    uids: list[int] = field(default_factory=list)   # uid per slot (-1 = empty)

@@ -1,0 +1,105 @@
+"""Continuous-batching scheduler (Dynamic SplitFuse, TPU formulation).
+
+Reference: inference/v2 engine scheduling (``InferenceEngineV2.put``
+engine_v2.py:107, ``can_schedule`` :184) and the Dynamic SplitFuse policy
+from the FastGen blog — long prompts are decomposed into fixed-size chunks
+so every forward step has near-constant token count.
+
+TPU deviation (by design): FastGen packs prompt chunks and decode tokens
+into ONE ragged batch; under XLA's static shapes that would force a mixed
+layout padded to worst case. Instead the scheduler emits alternating
+fixed-shape steps — a prefill step ([max_seqs, chunk] prompt chunks) or a
+decode step ([max_seqs, 1]) — which hits the same goal (constant per-step
+work, no long-prompt head-of-line blocking) with exactly two compiled
+programs. Prefill is prioritized when chunks are pending; decodes for
+already-running sequences batch together.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+from .ragged import SequenceDescriptor, StateManager, StepPlan
+
+
+class SplitFuseScheduler:
+    def __init__(self, state: StateManager, chunk: int):
+        self.state = state
+        self.chunk = chunk
+
+    def _desc(self, kind: str, T: int, entries) -> StepPlan:
+        S = self.state.max_seqs
+        bs = self.state.block_size
+        max_blocks = self.state.max_blocks_per_seq
+        plan = StepPlan(
+            kind=kind,
+            token_ids=np.zeros((S, T), np.int32),
+            positions=np.zeros((S, T), np.int32),
+            slot_map=np.zeros((S, T), np.int32),     # trash block slot 0
+            active=np.zeros((S, T), bool),
+            block_tables=np.zeros((S, max_blocks), np.int32),
+            seq_lens=np.zeros(S, np.int32),
+            sample_idx=np.zeros(S, np.int32),
+            do_sample=np.zeros(S, bool),
+            uids=[-1] * S,
+        )
+        for seq, toks, start_pos, sample in entries:
+            s = seq.slot
+            n = len(toks)
+            plan.token_ids[s, :n] = toks
+            plan.positions[s, :n] = np.arange(start_pos, start_pos + n)
+            for j in range(n):
+                pos = start_pos + j
+                blk = seq.blocks[pos // bs]
+                plan.slot_map[s, j] = blk * bs + pos % bs
+            plan.active[s, :n] = True
+            plan.block_tables[s, :len(seq.blocks)] = seq.blocks
+            plan.seq_lens[s] = start_pos + n
+            plan.sample_idx[s] = n - 1
+            plan.do_sample[s] = sample
+            plan.uids[s] = seq.uid
+        return plan
+
+    def next_step(self) -> StepPlan | None:
+        """Build the next step plan, or None if nothing to run."""
+        st = self.state
+        prefill: list[SequenceDescriptor] = []
+        decode: list[SequenceDescriptor] = []
+        for seq in st.seqs.values():
+            if seq.done:
+                continue
+            (prefill if seq.pending_tokens > 1 else decode).append(seq)
+
+        # blocks were reserved for prompt + max_new_tokens at admit time,
+        # so neither branch can exhaust the pool here
+        if prefill:
+            entries = []
+            for seq in prefill[:st.max_seqs]:
+                n = min(self.chunk, seq.pending_tokens)
+                toks = seq.tokens[seq.n_computed:seq.n_computed + n]
+                # sample only when this chunk consumes the last pending token
+                finishes = n == seq.pending_tokens
+                entries.append((seq, toks, seq.n_computed, finishes))
+            return self._desc("prefill", self.chunk, entries)
+
+        if decode:
+            entries = [(seq, seq.tokens[-1:], seq.n_computed, True)
+                       for seq in decode[:st.max_seqs]]
+            return self._desc("decode", 1, entries)
+        return None
+
+    def commit(self, plan: StepPlan, sampled: dict[int, int]) -> None:
+        """Advance sequence state after a step ran. ``sampled``: uid → token
+        for every slot that had do_sample."""
+        st = self.state
+        for s, uid in enumerate(plan.uids):
+            if uid < 0:
+                continue
+            seq = st.seqs[uid]
+            n = int(plan.active[s].sum())
+            seq.n_computed += n
+            if plan.do_sample[s]:
+                tok = sampled[uid]
+                seq.tokens.append(tok)
+                seq.n_generated += 1
+                if seq.n_generated >= seq.max_new_tokens:
+                    seq.done = True

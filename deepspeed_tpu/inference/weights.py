@@ -1,0 +1,41 @@
+"""Shared inference weight loading: init-or-take params, cast to the
+inference dtype, TP-shard per the stage-0 plan (used by both the v1 and v2
+engines; reference inference/engine.py:334 checkpoint loading w/ sharding).
+"""
+from __future__ import annotations
+
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+
+from ..config import ZeroConfig
+from ..runtime.zero.planner import build_plan, unbox_params
+
+Pytree = Any
+
+
+def load_tp_params(model, params: Pytree | None, rng: jax.Array | None,
+                   topology, dtype) -> tuple[Pytree, Any]:
+    """Returns (sharded_params, plan). ``params=None`` → fresh init directly
+    into the sharded layout."""
+    ids0 = jnp.zeros((1, 8), jnp.int32)
+    rng = rng if rng is not None else jax.random.PRNGKey(0)
+    if params is None:
+        abstract = jax.eval_shape(lambda r: model.init(r, ids0), rng)["params"]
+    else:
+        abstract = params
+    plan = build_plan(topology, ZeroConfig(stage=0), abstract)
+
+    def cast(t):
+        return jax.tree.map(
+            lambda x: x.astype(dtype)
+            if jnp.issubdtype(x.dtype, jnp.floating) else x, t)
+
+    if params is None:
+        out = jax.jit(
+            lambda r: cast(unbox_params(model.init(r, ids0)["params"])),
+            out_shardings=plan.param_shardings)(rng)
+    else:
+        out = jax.device_put(cast(unbox_params(params)), plan.param_shardings)
+    return out, plan
