@@ -1,0 +1,3 @@
+"""Experiment monitoring (reference deepspeed/monitor/)."""
+from .monitor import Monitor, MonitorMaster  # noqa: F401
+from .backends import CSVMonitor, TensorBoardMonitor, WandbMonitor  # noqa: F401

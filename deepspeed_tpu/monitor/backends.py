@@ -1,0 +1,95 @@
+"""Monitor backends (reference deepspeed/monitor/{tensorboard,wandb,
+csv_monitor}.py). CSV is always available; TB/W&B import lazily and disable
+themselves (with a log line) when the package is absent.
+"""
+from __future__ import annotations
+
+import os
+from typing import Sequence
+
+from ..utils.logging import logger
+from .monitor import Monitor
+
+
+class TensorBoardMonitor(Monitor):
+    def __init__(self, config):
+        super().__init__(config)
+        self.writer = None
+        if not self.enabled:
+            return
+        try:
+            from torch.utils.tensorboard import SummaryWriter
+        except Exception:
+            try:
+                from tensorboardX import SummaryWriter  # type: ignore
+            except Exception:
+                logger.warning("tensorboard not available; TB monitor disabled")
+                self.enabled = False
+                return
+        path = os.path.join(config.output_path or "runs", config.job_name)
+        self.writer = SummaryWriter(log_dir=path)
+
+    def write_events(self, event_list: Sequence[tuple]) -> None:
+        if not self.enabled or self.writer is None:
+            return
+        for tag, value, step in event_list:
+            self.writer.add_scalar(tag, float(value), int(step))
+
+    def flush(self) -> None:
+        if self.writer is not None:
+            self.writer.flush()
+
+
+class WandbMonitor(Monitor):
+    def __init__(self, config):
+        super().__init__(config)
+        if not self.enabled:
+            return
+        try:
+            import wandb
+        except Exception:
+            logger.warning("wandb not available; wandb monitor disabled")
+            self.enabled = False
+            return
+        self._wandb = wandb
+        wandb.init(project=config.project, group=config.group,
+                   entity=config.team, name=config.job_name)
+
+    def write_events(self, event_list: Sequence[tuple]) -> None:
+        if not self.enabled:
+            return
+        for tag, value, step in event_list:
+            self._wandb.log({tag: float(value)}, step=int(step))
+
+
+class CSVMonitor(Monitor):
+    """One csv per tag under output_path/job_name (reference
+    csv_monitor.py)."""
+
+    def __init__(self, config):
+        super().__init__(config)
+        self._files: dict[str, object] = {}
+        if not self.enabled:
+            return
+        self.dir = os.path.join(config.output_path or "csv_logs",
+                                config.job_name)
+        os.makedirs(self.dir, exist_ok=True)
+
+    def _file(self, tag: str):
+        if tag not in self._files:
+            safe = tag.replace("/", "_")
+            f = open(os.path.join(self.dir, f"{safe}.csv"), "a")
+            if f.tell() == 0:
+                f.write("step,value\n")
+            self._files[tag] = f
+        return self._files[tag]
+
+    def write_events(self, event_list: Sequence[tuple]) -> None:
+        if not self.enabled:
+            return
+        for tag, value, step in event_list:
+            self._file(tag).write(f"{int(step)},{float(value)}\n")
+
+    def flush(self) -> None:
+        for f in self._files.values():
+            f.flush()

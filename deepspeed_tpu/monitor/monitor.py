@@ -1,0 +1,54 @@
+"""Monitor ABC + fan-out master.
+
+TPU-native counterpart of reference deepspeed/monitor/monitor.py
+(``Monitor`` ABC :13, ``MonitorMaster`` :30). The contract is unchanged —
+``write_events([(tag, value, step), ...])`` fanned out to every enabled
+backend — because it is host-side bookkeeping with nothing device-specific.
+Backends degrade gracefully when their package is missing (tensorboard /
+wandb are optional in the image).
+"""
+from __future__ import annotations
+
+from abc import ABC, abstractmethod
+from typing import Any, Sequence
+
+Event = tuple  # (tag: str, value: float, step: int)
+
+
+class Monitor(ABC):
+    def __init__(self, config):
+        self.enabled = bool(getattr(config, "enabled", False))
+
+    @abstractmethod
+    def write_events(self, event_list: Sequence[Event]) -> None:
+        ...
+
+    def flush(self) -> None:  # optional
+        pass
+
+
+class MonitorMaster(Monitor):
+    """Fan-out to tensorboard/wandb/csv backends per config (reference
+    monitor.py:30)."""
+
+    def __init__(self, config):
+        from .backends import CSVMonitor, TensorBoardMonitor, WandbMonitor
+
+        self.backends: list[Monitor] = []
+        for attr, cls in (("tensorboard", TensorBoardMonitor),
+                          ("wandb", WandbMonitor),
+                          ("csv_monitor", CSVMonitor)):
+            sub = getattr(config, attr, None)
+            if sub is not None and getattr(sub, "enabled", False):
+                backend = cls(sub)
+                if backend.enabled:
+                    self.backends.append(backend)
+        self.enabled = bool(self.backends)
+
+    def write_events(self, event_list: Sequence[Event]) -> None:
+        for b in self.backends:
+            b.write_events(event_list)
+
+    def flush(self) -> None:
+        for b in self.backends:
+            b.flush()
