@@ -1,0 +1,8 @@
+"""Specialized collective paths (reference /root/reference/deepspeed/runtime/comm/)."""
+from .compressed import (  # noqa: F401
+    all_to_all_quant_reduce,
+    compressed_all_reduce,
+    hierarchical_quant_reduce,
+    quantized_all_gather,
+    reduce_scatter_coalesced,
+)

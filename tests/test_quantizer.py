@@ -1,0 +1,102 @@
+"""Quantizer tests (reference tests/unit/ops/quantizer + fp_quantizer)."""
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from deepspeed_tpu.ops.quantizer import (
+    FP8_E5M2,
+    dequantize,
+    fake_quantize,
+    fp_dequantize,
+    fp_quantize,
+    quantize,
+)
+
+
+@pytest.mark.parametrize("bits", [8, 4])
+@pytest.mark.parametrize("symmetric", [True, False])
+def test_int_roundtrip_error(bits, symmetric):
+    rng = np.random.default_rng(0)
+    x = jnp.asarray(rng.normal(size=(1000,)).astype(np.float32))
+    q = quantize(x, bits=bits, block_size=256, symmetric=symmetric)
+    y = dequantize(q)
+    assert y.shape == x.shape and y.dtype == x.dtype
+    # error bounded by half a quantization step per block
+    qmax = 2 ** (bits - 1) - 1
+    tol = (np.abs(np.asarray(x)).max() / qmax) * 0.75
+    assert float(jnp.max(jnp.abs(y - x))) <= tol
+
+
+def test_int8_exact_on_grid():
+    # values exactly representable: scale = 1 when amax = 127
+    x = jnp.asarray(np.arange(-127, 128, dtype=np.float32))
+    q = quantize(x, bits=8, block_size=256)
+    assert float(jnp.max(jnp.abs(dequantize(q) - x))) < 1e-5
+
+
+def test_int4_pack_shape():
+    x = jnp.ones((64, 64), jnp.float32)
+    q = quantize(x, bits=4, block_size=512)
+    assert q.data.dtype == jnp.uint8
+    assert q.data.size == x.size // 2  # two codes per byte
+    assert q.nbytes < x.nbytes // 4
+
+
+def test_quantize_jits_and_pads():
+    x = jnp.asarray(np.random.default_rng(1).normal(size=(3, 5, 7)), jnp.float32)
+
+    @jax.jit
+    def roundtrip(v):
+        return dequantize(quantize(v, bits=8, block_size=64))
+
+    y = roundtrip(x)
+    assert y.shape == x.shape
+    assert float(jnp.max(jnp.abs(y - x))) < 0.1
+
+
+@pytest.mark.parametrize("dtype", [None, FP8_E5M2])
+def test_fp8_roundtrip(dtype):
+    rng = np.random.default_rng(2)
+    x = jnp.asarray(rng.normal(size=(512,)).astype(np.float32)) * 10
+    q = fp_quantize(x, bits=8, block_size=128, dtype=dtype)
+    y = fp_dequantize(q)
+    rel = jnp.abs(y - x) / (jnp.abs(x) + 1e-3)
+    # e4m3 has 3 mantissa bits → ~6% worst-case relative error; e5m2 ~12.5%
+    assert float(jnp.max(rel)) < (0.07 if dtype is None else 0.15)
+
+
+def test_fp6_roundtrip():
+    rng = np.random.default_rng(3)
+    x = jnp.asarray(rng.normal(size=(1024,)).astype(np.float32))
+    q = fp_quantize(x, bits=6, block_size=256)
+    assert q.data.dtype == jnp.uint8
+    assert q.data.size == 1024 * 3 // 4  # 6 bits/value packed
+    y = fp_dequantize(q)
+    # e3m2: 2 mantissa bits → ~12.5% worst-case relative error on normals;
+    # near-zero values fall into subnormal absolute spacing (scale/16).
+    scale = float(jnp.max(jnp.abs(x))) / 28.0
+    rel = jnp.abs(y - x) / jnp.maximum(jnp.abs(x), scale)
+    assert float(jnp.max(rel)) < 0.15
+
+
+def test_fp6_exact_codes():
+    # representable e3m2 values (scale=1 when amax==28) roundtrip exactly
+    vals = [0.0, 0.0625, 0.25, 1.0, 1.25, 1.5, 1.75, 2.0, 3.5, 28.0,
+            -1.0, -28.0, -0.25]
+    x = jnp.asarray(vals + [28.0] * (256 - len(vals)), jnp.float32)
+    q = fp_quantize(x, bits=6, block_size=256)
+    y = fp_dequantize(q)
+    np.testing.assert_allclose(np.asarray(y)[:len(vals)], vals, atol=1e-6)
+
+
+def test_fake_quantize_ste_gradient():
+    x = jnp.asarray(np.linspace(-2, 2, 64), jnp.float32)
+
+    def loss(v):
+        return jnp.sum(fake_quantize(v, bits=8, block_size=64) ** 2)
+
+    g = jax.grad(loss)(x)
+    # STE: grad flows as if identity through the quantizer
+    np.testing.assert_allclose(np.asarray(g), 2 * np.asarray(
+        fake_quantize(x, bits=8, block_size=64)), rtol=1e-5)
