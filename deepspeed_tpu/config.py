@@ -146,12 +146,17 @@ class ActivationCheckpointingConfig:
     """Reference: runtime/activation_checkpointing/checkpointing.py. On TPU
     this maps to ``jax.checkpoint`` with a rematerialization policy."""
     partition_activations: bool = False  # maps to activation sharding over 'seq'
+    cpu_checkpointing: bool = False      # maps to the 'offload' remat policy
     number_checkpoints: int | None = None
-    # TPU extension: jax.checkpoint policy name
-    policy: str = "none"  # none|full|dots_saveable|nothing_saveable|dots_with_no_batch_dims_saveable
+    # TPU extension: jax.checkpoint policy name (ops/remat.py registry)
+    policy: str = "none"  # none|full|dots_saveable|nothing_saveable|dots_with_no_batch_dims_saveable|offload
 
-    _IGNORED_KEYS = ("cpu_checkpointing", "contiguous_memory_optimization",
+    _IGNORED_KEYS = ("contiguous_memory_optimization",
                      "synchronize_checkpoint_boundary", "profile")
+
+    def __post_init__(self):
+        if self.cpu_checkpointing and self.policy == "none":
+            self.policy = "offload"
 
 
 @dataclass

@@ -94,6 +94,7 @@ class ModelConfig:
     moe: MoEConfig | None = None
     dtype: Any = jnp.bfloat16                # compute dtype
     remat: bool = False                      # rematerialize each block
+    remat_policy: str = "nothing_saveable"   # runtime/activation_checkpointing.py
     attn_impl: str = "auto"                  # auto | pallas | xla
 
     @property
@@ -345,8 +346,11 @@ class TransformerLM(nn.Module):
 
         block_cls = Block
         if cfg.remat:
-            block_cls = nn.remat(Block, static_argnums=(4,),
-                                 policy=jax.checkpoint_policies.nothing_saveable)
+            from ..ops.remat import remat_module
+
+            # remat=True always checkpoints; 'none' would contradict it
+            policy = cfg.remat_policy if cfg.remat_policy != "none" else "full"
+            block_cls = remat_module(Block, policy=policy, static_argnums=(4,))
 
         new_caches = [] if kv_caches is not None else None
         for i in range(cfg.num_layers):

@@ -1,0 +1,67 @@
+"""Rematerialization policy registry (neutral layer: used by both the model
+zoo and the runtime's activation-checkpointing API — see
+runtime/activation_checkpointing.py for the DeepSpeed-parity surface and the
+mapping to the reference's CheckpointFunction)."""
+from __future__ import annotations
+
+from typing import Any, Callable
+
+import jax
+
+from ..utils.logging import logger
+
+_cp = jax.checkpoint_policies
+
+#: name → jax.checkpoint policy ("full" remat saves nothing; "none" disables)
+POLICIES: dict[str, Any] = {
+    "none": None,
+    "full": _cp.nothing_saveable,
+    "nothing_saveable": _cp.nothing_saveable,
+    "dots_saveable": _cp.dots_saveable,
+    "checkpoint_dots": _cp.dots_saveable,
+    "dots_with_no_batch_dims_saveable": _cp.dots_with_no_batch_dims_saveable,
+    "checkpoint_dots_with_no_batch_dims": _cp.dots_with_no_batch_dims_saveable,
+    "everything_saveable": _cp.everything_saveable,
+}
+
+
+def make_policy(name: str):
+    """Resolve a policy name to a ``jax.checkpoint`` policy.
+
+    ``cpu`` / ``offload`` implement the reference's ``cpu_checkpointing``
+    (checkpointing.py:472): matmul outputs are kept on device, everything
+    else saved is offloaded to pinned host memory instead of recomputed.
+    """
+    if name in POLICIES:
+        return POLICIES[name]
+    if name in ("cpu", "offload", "offload_dots"):
+        try:
+            return _cp.offload_dot_with_no_batch_dims("device", "pinned_host")
+        except Exception:  # backend without host-offload support
+            logger.warning("activation offload policy unavailable on this "
+                           "backend; falling back to dots_saveable")
+            return _cp.dots_saveable
+    raise ValueError(f"unknown activation checkpointing policy '{name}'; "
+                     f"one of {sorted(POLICIES)} or 'offload'")
+
+
+def checkpoint_fn(fn: Callable, policy: str = "full",
+                  prevent_cse: bool = True, static_argnums=()) -> Callable:
+    """Wrap ``fn`` so its intermediates are rematerialized in backward."""
+    pol = make_policy(policy)
+    if pol is None and policy == "none":
+        return fn
+    return jax.checkpoint(fn, policy=pol, prevent_cse=prevent_cse,
+                          static_argnums=static_argnums)
+
+
+def remat_module(module_cls, policy: str = "full", static_argnums=()):
+    """nn.remat a flax module class with the named policy (the per-block
+    wrapping the reference applies per transformer layer)."""
+    import flax.linen as nn
+
+    pol = make_policy(policy)
+    if pol is None:
+        return module_cls
+    return nn.remat(module_cls, policy=pol, prevent_cse=True,
+                    static_argnums=static_argnums)

@@ -1,0 +1,76 @@
+"""Activation checkpointing (rematerialization).
+
+TPU-native re-design of
+/root/reference/deepspeed/runtime/activation_checkpointing/checkpointing.py:
+- ``CheckpointFunction`` (:486) — a hand-rolled autograd.Function that stashes
+  (optionally partitioned/CPU-moved) inputs and replays forward in backward,
+  with a CUDA RNG fork tracker (:124) so dropout replays identically.
+- partitioned activations (:375) — each model-parallel rank keeps 1/mp of the
+  stashed activation, all-gathered back before replay.
+
+Under JAX every piece collapses into ``jax.checkpoint``:
+- replay-in-backward is the transform itself; there is no tape to manage.
+- RNG forking is unnecessary — dropout keys are explicit function inputs, so
+  the recomputation is bit-identical by construction.
+- *what* to stash is a checkpoint **policy** (save nothing / save matmul
+  outputs / offload residuals to host), strictly more general than the
+  reference's all-or-nothing stash. The registry lives in ops/remat.py.
+- partitioned activations = sharding the residual stream over the ``seq``
+  axis between layers, which the model zoo already does via logical
+  constraints; the engine warns if the flag is set without a seq axis.
+- CPU checkpointing (:472) = the ``offload`` policy: saved residuals live in
+  pinned host memory (``offload_src='device', offload_dst='pinned_host'``)
+  and XLA schedules the D2H/H2D copies asynchronously.
+
+API parity: ``configure(config)`` + module-level ``checkpoint(fn, *args)``
+mirror the reference's Megatron-style entry points (checkpointing.py:893,
+:486); the policy-based API is the native surface.
+"""
+from __future__ import annotations
+
+from typing import Callable
+
+from ..config import ActivationCheckpointingConfig, Config, _take
+from ..ops.remat import (  # noqa: F401  (re-exported native surface)
+    POLICIES,
+    checkpoint_fn,
+    make_policy,
+    remat_module,
+)
+
+# --------------------------------------------------------------------------
+# Megatron-style module-level API (reference checkpointing.py:893 configure,
+# :486 checkpoint) for drop-in porting of reference training scripts.
+# --------------------------------------------------------------------------
+_configured = ActivationCheckpointingConfig()
+
+
+def configure(config: Config | ActivationCheckpointingConfig | dict | None = None,
+              **kwargs) -> None:
+    """Set the module-level checkpointing behavior from a DeepSpeed-style
+    config section (accepts the whole Config, the section dict — unknown /
+    GPU-specific keys filtered like any config section — or kwargs)."""
+    global _configured
+    if isinstance(config, Config):
+        _configured = config.activation_checkpointing
+    elif isinstance(config, ActivationCheckpointingConfig):
+        _configured = config
+    elif isinstance(config, dict):
+        _configured = _take(dict(config), ActivationCheckpointingConfig,
+                            "activation_checkpointing")
+    if kwargs:
+        import dataclasses
+
+        _configured = dataclasses.replace(_configured, **kwargs)
+
+
+def is_configured() -> bool:
+    return _configured.policy != "none"
+
+
+def checkpoint(function: Callable, *args):
+    """Reference-parity call shape: run ``function(*args)`` under the
+    configured remat policy (checkpointing.py:486 ``CheckpointFunction``).
+    Must be called inside a traced (grad/jit) context to have effect."""
+    policy = _configured.policy if _configured.policy != "none" else "full"
+    return checkpoint_fn(function, policy=policy)(*args)

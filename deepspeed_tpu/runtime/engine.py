@@ -94,6 +94,27 @@ class DeepSpeedEngine:
         self.topology = topology or MeshTopology(config.mesh)
         config.resolve_batch_terms(self.topology.dp_world_size)
 
+        # activation checkpointing: flip the model zoo's remat switch from the
+        # DeepSpeed-style config section (reference checkpointing.py:893)
+        ac = config.activation_checkpointing
+        if ac.policy != "none" and model is not None and hasattr(model, "config") \
+                and hasattr(model.config, "remat"):
+            if loss_fn is not None:
+                logger.warning(
+                    "activation_checkpointing is configured but a custom "
+                    "loss_fn was supplied — the engine cannot rewire a loss "
+                    "closure; apply ops/remat.py policies (or cfg.remat) in "
+                    "your own model for checkpointing to take effect")
+            else:
+                self.model = model = model.clone(config=dataclasses.replace(
+                    model.config, remat=True, remat_policy=ac.policy))
+        if ac.partition_activations and self.topology.size("seq") <= 1:
+            logger.warning("partition_activations=True but the mesh has no "
+                           "'seq' axis — activations stay unpartitioned")
+        from . import activation_checkpointing as _ac_mod
+
+        _ac_mod.configure(ac)
+
         if loss_fn is None:
             if model is None:
                 raise ValueError("need a model or a loss_fn")
