@@ -157,6 +157,13 @@ class ActivationCheckpointingConfig:
     def __post_init__(self):
         if self.cpu_checkpointing and self.policy == "none":
             self.policy = "offload"
+        elif self.cpu_checkpointing and self.policy not in ("offload", "cpu"):
+            from .utils.logging import logger
+
+            logger.warning(
+                f"activation_checkpointing.cpu_checkpointing=true conflicts "
+                f"with explicit policy='{self.policy}'; the explicit policy "
+                f"wins and activations are NOT offloaded to host")
 
 
 @dataclass

@@ -1,0 +1,122 @@
+"""Environment / compatibility report — the ``ds_report`` analogue
+(reference deepspeed/env_report.py + bin/ds_report).
+
+Reports framework versions, visible devices, and per-feature compatibility
+(the analogue of the reference's op-builder compatibility matrix: instead of
+CUDA extensions we probe Pallas lowering, native host extensions, and
+distributed bring-up prerequisites).
+
+Run as ``python -m deepspeed_tpu.env_report``.
+"""
+from __future__ import annotations
+
+import importlib
+import os
+import shutil
+import sys
+
+GREEN_OK = "\033[92m[OKAY]\033[0m"
+RED_NO = "\033[91m[NO]\033[0m"
+YELLOW_WARN = "\033[93m[WARN]\033[0m"
+
+
+def _version(mod_name: str) -> str | None:
+    try:
+        mod = importlib.import_module(mod_name)
+        return getattr(mod, "__version__", "unknown")
+    except Exception:
+        return None
+
+
+def feature_report() -> list[tuple[str, bool, str]]:
+    """Probe each optional capability: (name, compatible, detail)."""
+    import jax
+
+    feats: list[tuple[str, bool, str]] = []
+
+    # device backend
+    try:
+        devs = jax.devices()
+        plat = devs[0].platform
+        feats.append(("device backend", True, f"{plat} x{len(devs)}"))
+        on_tpu = plat == "tpu" or devs[0].device_kind.lower().startswith("tpu")
+    except Exception as e:
+        feats.append(("device backend", False, str(e)))
+        on_tpu = False
+
+    # pallas lowering (flash attention kernel path)
+    try:
+        from .ops.pallas import flash_attention  # noqa: F401
+
+        feats.append(("pallas kernels", True,
+                      "TPU lowering" if on_tpu else "interpret-mode fallback on CPU"))
+    except Exception as e:
+        feats.append(("pallas kernels", False, str(e)))
+
+    # native host extension (async I/O + SIMD optimizer)
+    try:
+        from .ops.native import lib_status
+
+        ok, detail = lib_status()
+        feats.append(("native host ops (aio/cpu-adam)", ok, detail))
+    except Exception:
+        feats.append(("native host ops (aio/cpu-adam)", False,
+                      "not built (python fallback active)"))
+
+    # checkpointing backend
+    feats.append(("orbax checkpointing", _version("orbax.checkpoint") is not None,
+                  f"orbax {_version('orbax.checkpoint')}"))
+
+    # multi-host distributed
+    has_coord = bool(os.environ.get("COORDINATOR_ADDRESS")
+                     or os.environ.get("JAX_COORDINATOR_ADDRESS"))
+    feats.append(("multi-host init env", True,
+                  "coordinator set" if has_coord else "single-process (no coordinator env)"))
+
+    # launcher tooling
+    for tool in ("ssh", "pdsh", "srun", "mpirun"):
+        if shutil.which(tool):
+            feats.append((f"launcher: {tool}", True, shutil.which(tool)))
+
+    # C++ toolchain (for building native ops from source)
+    cxx = shutil.which("g++") or shutil.which("clang++")
+    feats.append(("C++ toolchain", cxx is not None, cxx or "no g++/clang++"))
+    return feats
+
+
+def main(hide_errors: bool = False) -> str:
+    import jax
+
+    from .version import __version__
+
+    lines = ["-" * 72,
+             "deepspeed_tpu environment report (ds_report analogue)",
+             "-" * 72,
+             f"deepspeed_tpu ......... {__version__}",
+             f"python ................ {sys.version.split()[0]}",
+             f"jax ................... {_version('jax')}",
+             f"jaxlib ................ {_version('jaxlib')}",
+             f"flax .................. {_version('flax')}",
+             f"optax ................. {_version('optax')}",
+             f"orbax-checkpoint ...... {_version('orbax.checkpoint')}",
+             f"numpy ................. {_version('numpy')}",
+             "-" * 72,
+             "feature compatibility:"]
+    for name, ok, detail in feature_report():
+        mark = GREEN_OK if ok else RED_NO
+        lines.append(f"  {name:<34s} {mark}  {detail}")
+    lines.append("-" * 72)
+    try:
+        lines.append(f"default backend: {jax.default_backend()}, "
+                     f"devices: {[str(d) for d in jax.devices()]}")
+    except Exception as e:
+        if not hide_errors:
+            lines.append(f"device query failed: {e}")
+    lines.append("-" * 72)
+    text = "\n".join(lines)
+    print(text)
+    return text
+
+
+if __name__ == "__main__":
+    main()

@@ -35,8 +35,18 @@ def make_policy(name: str):
     if name in POLICIES:
         return POLICIES[name]
     if name in ("cpu", "offload", "offload_dots"):
+        pol = _cp.offload_dot_with_no_batch_dims("device", "pinned_host")
+        # Constructing the policy always succeeds; whether the backend
+        # supports pinned_host offload only surfaces at compile time. Probe
+        # with a tiny checkpointed grad so a missing memory space degrades to
+        # dots_saveable here instead of failing inside the user's train step.
         try:
-            return _cp.offload_dot_with_no_batch_dims("device", "pinned_host")
+            import jax.numpy as jnp
+
+            f = jax.checkpoint(lambda x: jnp.sin(x @ x), policy=pol)
+            jax.jit(jax.grad(lambda x: f(x).sum())).lower(
+                jax.ShapeDtypeStruct((4, 4), jnp.float32)).compile()
+            return pol
         except Exception:  # backend without host-offload support
             logger.warning("activation offload policy unavailable on this "
                            "backend; falling back to dots_saveable")

@@ -1,0 +1,12 @@
+"""Profiling: FLOPs profiler + XLA cost analysis.
+
+TPU-native analogue of the reference's flops profiler package
+(deepspeed/profiling/flops_profiler/profiler.py).
+"""
+from .flops_profiler import (  # noqa: F401
+    FlopsProfiler,
+    cost_analysis,
+    get_model_profile,
+    human_flops,
+    human_params,
+)

@@ -150,6 +150,13 @@ class DeepSpeedEngine:
             configure_comms_logger(enabled=True, verbose=config.comms_logger.verbose,
                                    debug=config.comms_logger.debug)
 
+        # flops profiler, fired once at profile_step (reference engine.py:1867)
+        self.flops_profiler = None
+        if config.flops_profiler.enabled:
+            from ..profiling import FlopsProfiler
+
+            self.flops_profiler = FlopsProfiler(config.flops_profiler)
+
         # ---- state bring-up (reference _configure_distributed_model :1137)
         self._init_state(params, sample_batch, rng)
         self._build_programs()
@@ -403,6 +410,11 @@ class DeepSpeedEngine:
         self.tput_timer.start()
         self.timers(TRAIN_BATCH_TIMER).start()
         batch = self._shard_batch(self._reshape_for_gas(batch), with_gas_dim=True)
+        if self.flops_profiler is not None and not self.flops_profiler.profiled:
+            self.flops_profiler.maybe_profile_step(
+                self._train_step, (self.state, batch), self.global_steps,
+                params=self.num_parameters(),
+                latency_s=self.tput_timer.last_step_s)
         self.state, loss = self._train_step(self.state, batch)
         self.global_steps += 1
         if self.config.wall_clock_breakdown:

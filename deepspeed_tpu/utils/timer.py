@@ -158,14 +158,14 @@ class ThroughputTimer:
         self.step_elapsed_time = 0.0
         self.start_time = 0.0
         self.started = False
+        self.last_step_s: float | None = None
 
     def update_epoch_count(self) -> None:
         self.local_step_count = 0
 
     def start(self) -> None:
         self.started = True
-        if self.global_step_count >= self.start_step:
-            self.start_time = time.perf_counter()
+        self.start_time = time.perf_counter()
 
     def stop(self, global_step: bool = True, report_speed: bool = True,
              sync_val: Any | None = None, flops_per_sample: float | None = None) -> None:
@@ -175,9 +175,12 @@ class ThroughputTimer:
         if global_step:
             self.global_step_count += 1
             self.local_step_count += 1
-        if self.start_time and self.global_step_count > self.start_step:
+        if self.start_time:
             _sync(sync_val)
             duration = time.perf_counter() - self.start_time
+            self.last_step_s = duration
+            if self.global_step_count <= self.start_step:
+                return  # warmup steps don't count toward averages
             self.total_elapsed_time += duration
             self.step_elapsed_time += duration
             if global_step and report_speed and self.global_step_count % self.steps_per_output == 0:

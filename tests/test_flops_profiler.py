@@ -1,0 +1,87 @@
+"""Flops profiler + env report tests (reference
+tests/unit/profiling/flops_profiler/test_flops_profiler.py analogue)."""
+import io
+
+import jax
+import jax.numpy as jnp
+import pytest
+
+from deepspeed_tpu.models import build_model
+from deepspeed_tpu.profiling import (FlopsProfiler, cost_analysis,
+                                     get_model_profile, human_flops,
+                                     human_params)
+
+
+def test_cost_analysis_matmul_flops():
+    n = 128
+    costs = cost_analysis(lambda a, b: a @ b,
+                          jnp.ones((n, n)), jnp.ones((n, n)))
+    # XLA counts 2*n^3 for an n^3 MAC matmul
+    assert costs["flops"] == pytest.approx(2 * n**3)
+
+
+def test_get_model_profile_numbers():
+    m = build_model("tiny-gpt2")
+    flops, macs, params = get_model_profile(
+        m, input_shape=(2, 32), print_profile=False, as_string=False)
+    assert flops > 0 and macs == pytest.approx(flops / 2)
+    # params: model has ~24.6k params
+    assert 10_000 < params < 100_000
+    # FLOPs must be at least the analytic matmul floor: 2 * params-ish * tokens
+    assert flops > 2 * params * 64 * 0.5
+
+
+def test_per_module_tree_and_report():
+    m = build_model("tiny-gpt2")
+    prof = FlopsProfiler()
+    res = prof.profile_model(m, jnp.zeros((1, 16), jnp.int32))
+    paths = [r.path for r in res.modules]
+    assert "" in paths  # root
+    assert any("attn" in p for p in paths)
+    root = res.modules[0]
+    child_sum = sum(r.flops for r in res.modules if r.depth == 1)
+    # children should account for most of the root's flops
+    assert child_sum <= root.flops * 1.01
+    assert child_sum > root.flops * 0.5
+    buf = io.StringIO()
+    prof.print_profile(res, file=buf)
+    assert "Flops Profiler" in buf.getvalue()
+
+
+def test_engine_integration(tmp_path):
+    import numpy as np
+
+    import deepspeed_tpu as ds
+
+    out = tmp_path / "flops.txt"
+    engine, *_ = ds.initialize(
+        model=build_model("tiny-gpt2"),
+        config={
+            "train_micro_batch_size_per_gpu": 2,
+            "optimizer": {"type": "AdamW", "params": {"lr": 1e-3}},
+            "zero_optimization": {"stage": 0},
+            "flops_profiler": {"enabled": True, "profile_step": 1,
+                               "output_file": str(out)},
+        })
+    rng = np.random.default_rng(0)
+    gbs = engine.config.train_batch_size
+    batch = {"input_ids": rng.integers(0, 256, (gbs, 32)),
+             "labels": rng.integers(0, 256, (gbs, 32))}
+    engine.train_batch(batch)
+    engine.train_batch(batch)
+    text = out.read_text()
+    assert "fwd FLOPs" in text
+    assert engine.flops_profiler.profiled
+
+
+def test_human_format():
+    assert human_flops(2.5e12) == "2.50 T"
+    assert human_params(1_300_000) == "1.30 M"
+
+
+def test_env_report_runs(capsys):
+    from deepspeed_tpu import env_report
+
+    text = env_report.main()
+    assert "deepspeed_tpu environment report" in text
+    assert "jax" in text
