@@ -149,6 +149,18 @@ def init_distributed(coordinator_address: str | None = None,
         num_processes = int(os.environ["DS_TPU_NUM_PROCESSES"])
     if process_id is None and os.environ.get("DS_TPU_PROCESS_ID"):
         process_id = int(os.environ["DS_TPU_PROCESS_ID"])
+    # scheduler-env discovery (reference comm.py:688 mpi_discovery): under
+    # mpirun/srun the launcher spawns ranks directly and only the coordinator
+    # address travels via env; rank/world come from the scheduler.
+    if coordinator_address and process_id is None:
+        for rank_var, size_var in (("OMPI_COMM_WORLD_RANK", "OMPI_COMM_WORLD_SIZE"),
+                                   ("SLURM_PROCID", "SLURM_NTASKS"),
+                                   ("PMI_RANK", "PMI_SIZE")):
+            if os.environ.get(rank_var) is not None:
+                process_id = int(os.environ[rank_var])
+                if num_processes is None and os.environ.get(size_var):
+                    num_processes = int(os.environ[size_var])
+                break
     if coordinator_address:
         logger.info(f"init_distributed: coordinator={coordinator_address} "
                     f"nprocs={num_processes} pid={process_id}")

@@ -157,7 +157,8 @@ class ActivationCheckpointingConfig:
     def __post_init__(self):
         if self.cpu_checkpointing and self.policy == "none":
             self.policy = "offload"
-        elif self.cpu_checkpointing and self.policy not in ("offload", "cpu"):
+        elif self.cpu_checkpointing and self.policy not in ("offload", "cpu",
+                                                            "offload_dots"):
             from .utils.logging import logger
 
             logger.warning(

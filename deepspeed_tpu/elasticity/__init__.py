@@ -1,0 +1,14 @@
+"""Elastic training: batch-size / chip-count compatibility solver.
+
+TPU analogue of the reference elasticity package
+(deepspeed/elasticity/elasticity.py). Recovery on TPU is restart-based
+(checkpoint-resume under a new mesh); this package guarantees that every
+allowed chip count trains with the SAME global batch size, so restarts are
+mathematically transparent to convergence.
+"""
+from .elasticity import (  # noqa: F401
+    ElasticityError,
+    compute_elastic_config,
+    elasticity_enabled,
+    get_valid_chip_counts,
+)

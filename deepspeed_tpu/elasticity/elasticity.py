@@ -1,0 +1,189 @@
+"""Elastic batch-size solver (reference deepspeed/elasticity/elasticity.py,
+`compute_elastic_config` :233, v0.1 solver :83, v0.2 solver :126).
+
+Given acceptable micro-batch sizes and a max global batch size, find the
+global batch size compatible with the largest set of chip counts — i.e. for
+every valid chip count ``w`` there is a micro batch ``m`` and integer GAS
+with ``batch == m * w * gas``. A job restarted on any valid ``w`` keeps the
+exact same global batch (and therefore the same optimization trajectory).
+
+The scaling heuristic follows the reference: scale each candidate base (every
+micro batch + their LCM) by the largest highly-composite number that keeps the
+product under the cap; highly-composite multipliers maximize the divisor count
+and therefore the number of compatible chip counts.
+"""
+from __future__ import annotations
+
+import math
+from dataclasses import dataclass, field
+
+from ..utils.logging import logger
+
+# Highly composite numbers (more divisors than any smaller integer) — the
+# multiplier vocabulary for candidate batch sizes.
+HCN_LIST = [1, 2, 4, 6, 12, 24, 36, 48, 60, 120, 180, 240, 360, 720, 840,
+            1260, 1680, 2520, 5040, 7560, 10080, 15120, 20160, 25200, 27720,
+            45360, 50400, 55440, 83160, 110880, 166320, 221760, 277200,
+            332640, 498960, 554400, 665280]
+
+LATEST_ELASTICITY_VERSION = 0.2
+MINIMUM_ELASTICITY_VERSION = 0.1
+
+
+class ElasticityError(Exception):
+    pass
+
+
+@dataclass
+class ElasticityConfig:
+    """The ``elasticity`` config section (reference elasticity/config.py)."""
+    enabled: bool = False
+    max_train_batch_size: int = 2000
+    micro_batch_sizes: list[int] = field(default_factory=lambda: [2, 4, 6])
+    min_gpus: int = 1
+    max_gpus: int = 10000
+    min_time: int = 0
+    prefer_larger_batch: bool = True
+    ignore_non_elastic_batch_info: bool = False
+    version: float = LATEST_ELASTICITY_VERSION
+    # v0.2 node-level terms (reference :126)
+    model_parallel_size: int = 1
+    num_gpus_per_node: int = 1
+
+    @classmethod
+    def from_dict(cls, d: dict) -> "ElasticityConfig":
+        known = {k: v for k, v in d.items() if k in cls.__dataclass_fields__}
+        unknown = set(d) - set(known)
+        if unknown:
+            logger.warning(f"elasticity: ignoring unknown keys {sorted(unknown)}")
+        return cls(**known)
+
+
+def elasticity_enabled(config: dict) -> bool:
+    return bool(config.get("elasticity", {}).get("enabled", False))
+
+
+def _candidate_batch_sizes(bases: list[int], cap: int) -> list[int]:
+    out = set()
+    for base in bases:
+        if base >= cap:
+            out.add(base)
+            continue
+        budget = cap // base
+        mult = max((h for h in HCN_LIST if h <= budget), default=1)
+        out.add(mult * base)
+    return sorted(out)
+
+
+def get_valid_chip_counts(batch_size: int, micro_batches: list[int],
+                          min_chips: int, max_chips: int) -> list[int]:
+    """All chip counts w in [min,max] with an (m, gas) so m*w*gas == batch."""
+    valid: set[int] = set()
+    for m in micro_batches:
+        if batch_size % m:
+            continue
+        q = batch_size // m  # w * gas
+        for w in range(1, int(math.isqrt(q)) + 1):
+            if q % w == 0:
+                for cand in (w, q // w):
+                    if min_chips <= cand <= max_chips:
+                        valid.add(cand)
+    return sorted(valid)
+
+
+def _solve_v01(micro_batches: list[int], max_batch: int, min_chips: int,
+               max_chips: int, prefer_larger: bool) -> tuple[int, list[int]]:
+    """v0.1 solver (reference :83)."""
+    if not micro_batches:
+        raise ElasticityError("micro_batch_sizes must be non-empty")
+    if any(m <= 0 for m in micro_batches):
+        raise ElasticityError(f"micro batches must be positive: {micro_batches}")
+    if any(m > max_batch for m in micro_batches):
+        raise ElasticityError(
+            f"all micro batches {micro_batches} must be <= "
+            f"max_train_batch_size {max_batch}")
+    bases = sorted(set(micro_batches) | {math.lcm(*micro_batches)})
+    best_batch, best_valid = min(micro_batches), []
+    for b in _candidate_batch_sizes(bases, max_batch):
+        valid = get_valid_chip_counts(b, micro_batches, min_chips, max_chips)
+        better = len(valid) > len(best_valid) or (
+            len(valid) == len(best_valid)
+            and ((prefer_larger and b > best_batch)
+                 or (not prefer_larger and b < best_batch)))
+        if better:
+            best_batch, best_valid = b, valid
+    return best_batch, best_valid
+
+
+def _solve_v02(cfg: ElasticityConfig,
+               current_num_chips: int | None) -> tuple[int, list[int], int | None]:
+    """v0.2 node-level solver (reference :126): chip counts move in whole
+    nodes and model parallelism divides each node."""
+    if cfg.num_gpus_per_node % cfg.model_parallel_size:
+        raise ElasticityError(
+            f"chips per node ({cfg.num_gpus_per_node}) must be divisible by "
+            f"model_parallel_size ({cfg.model_parallel_size})")
+    dp_per_node = cfg.num_gpus_per_node // cfg.model_parallel_size
+    node_batch, valid_nodes = _solve_v01(
+        cfg.micro_batch_sizes,
+        max(1, cfg.max_train_batch_size // dp_per_node),
+        max(1, cfg.min_gpus // cfg.num_gpus_per_node),
+        max(1, cfg.max_gpus // cfg.num_gpus_per_node),
+        cfg.prefer_larger_batch)
+    final_batch = node_batch * dp_per_node
+    valid_dp_sizes = [n * dp_per_node for n in valid_nodes]
+
+    micro: int | None = None
+    if current_num_chips:
+        current_dp = current_num_chips // cfg.model_parallel_size
+        if current_dp not in valid_dp_sizes:
+            raise ElasticityError(
+                f"current chip count {current_num_chips} (dp={current_dp}) is "
+                f"not in the valid set {valid_dp_sizes}")
+        per_replica = final_batch // current_dp
+        fitting = [m for m in cfg.micro_batch_sizes if per_replica % m == 0]
+        if fitting:
+            micro = max(fitting) if cfg.prefer_larger_batch else min(fitting)
+    return final_batch, valid_dp_sizes, micro
+
+
+def compute_elastic_config(ds_config: dict, target_deepspeed_version: str = "",
+                           num_gpus: int | None = None,
+                           return_microbatch: bool = False):
+    """Solve the elastic schedule from a DeepSpeed-style config dict
+    (reference elasticity.py:233).
+
+    Returns ``(final_batch_size, valid_chip_counts)`` and, for v0.2 with
+    ``num_gpus`` given (or ``return_microbatch``), the chosen micro batch.
+    """
+    section = ds_config.get("elasticity")
+    if not section or not section.get("enabled", False):
+        raise ElasticityError("'elasticity' section missing or disabled")
+    cfg = ElasticityConfig.from_dict(section)
+    if not (MINIMUM_ELASTICITY_VERSION <= cfg.version <= LATEST_ELASTICITY_VERSION):
+        raise ElasticityError(
+            f"elasticity version {cfg.version} unsupported "
+            f"({MINIMUM_ELASTICITY_VERSION}..{LATEST_ELASTICITY_VERSION})")
+
+    # non-elastic batch terms in the same config are a footgun (reference :276)
+    if not cfg.ignore_non_elastic_batch_info:
+        for key in ("train_batch_size", "train_micro_batch_size_per_gpu",
+                    "gradient_accumulation_steps"):
+            if key in ds_config:
+                raise ElasticityError(
+                    f"elasticity is enabled but '{key}' is also set; remove it "
+                    f"or set elasticity.ignore_non_elastic_batch_info")
+
+    if cfg.version >= 0.2:
+        batch, valid, micro = _solve_v02(cfg, num_gpus)
+        logger.info(f"elasticity v0.2: batch={batch} valid_dp={valid} micro={micro}")
+        if return_microbatch or num_gpus is not None:
+            return batch, valid, micro
+        return batch, valid
+    batch, valid = _solve_v01(cfg.micro_batch_sizes, cfg.max_train_batch_size,
+                              cfg.min_gpus, cfg.max_gpus, cfg.prefer_larger_batch)
+    logger.info(f"elasticity v0.1: batch={batch} valid_chips={valid}")
+    if num_gpus is not None and num_gpus not in valid:
+        raise ElasticityError(
+            f"current chip count {num_gpus} not in valid set {valid}")
+    return batch, valid

@@ -68,7 +68,8 @@ def feature_report() -> list[tuple[str, bool, str]]:
                   f"orbax {_version('orbax.checkpoint')}"))
 
     # multi-host distributed
-    has_coord = bool(os.environ.get("COORDINATOR_ADDRESS")
+    has_coord = bool(os.environ.get("DS_TPU_COORDINATOR")
+                     or os.environ.get("COORDINATOR_ADDRESS")
                      or os.environ.get("JAX_COORDINATOR_ADDRESS"))
     feats.append(("multi-host init env", True,
                   "coordinator set" if has_coord else "single-process (no coordinator env)"))

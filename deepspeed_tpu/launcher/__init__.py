@@ -1,0 +1,8 @@
+"""Launcher: multi-host job bring-up CLI.
+
+TPU analogue of the reference launcher package (deepspeed/launcher/ +
+bin/deepspeed): a resource-aware runner that starts one worker process per
+host slot across a pod, wiring the JAX distributed rendezvous env
+(``DS_TPU_COORDINATOR`` / ``DS_TPU_NUM_PROCESSES`` / ``DS_TPU_PROCESS_ID``)
+instead of torch.distributed's.
+"""

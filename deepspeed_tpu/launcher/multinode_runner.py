@@ -1,0 +1,197 @@
+"""Multi-node backends for the runner (reference
+deepspeed/launcher/multinode_runner.py: PDSH :51, OpenMPI :118, SLURM :328).
+
+Each runner turns (resources, command) into one subprocess invocation that
+fans the per-node launcher out across hosts. The reference's MPI runners
+spawn the training script directly (one rank per process); we do the same,
+relying on ``comm.init_distributed``'s env discovery (OMPI/SLURM vars).
+"""
+from __future__ import annotations
+
+import os
+import shutil
+import sys
+from abc import ABC, abstractmethod
+
+#: env prefixes propagated to remote nodes (reference runner.py EXPORT_ENVS)
+EXPORT_PREFIXES = ("DS_", "JAX_", "XLA_", "TPU_", "LIBTPU_", "PYTHONPATH",
+                   "NCCL_", "PALLAS_")
+
+
+def collect_exports(extra_env: dict | None = None) -> dict[str, str]:
+    exports = {k: v for k, v in os.environ.items()
+               if k.startswith(EXPORT_PREFIXES)}
+    # ~/.deepspeed_env-style extra env file (reference runner.py DS_ENV_FILE)
+    env_file = os.environ.get("DS_ENV_FILE",
+                              os.path.expanduser("~/.deepspeed_env"))
+    if os.path.isfile(env_file):
+        with open(env_file) as f:
+            for line in f:
+                line = line.strip()
+                if line and not line.startswith("#") and "=" in line:
+                    k, _, v = line.partition("=")
+                    exports[k.strip()] = v.strip()
+    if extra_env:
+        exports.update(extra_env)
+    return exports
+
+
+def _quote(s: str) -> str:
+    import shlex
+
+    return shlex.quote(s)
+
+
+class MultiNodeRunner(ABC):
+    def __init__(self, args, world_info: dict[str, int]):
+        self.args = args                  # runner CLI namespace
+        self.world_info = world_info      # host -> slots (active resources)
+        self.exports = collect_exports()
+
+    @property
+    def name(self) -> str:
+        return type(self).__name__.replace("Runner", "").lower()
+
+    @abstractmethod
+    def backend_exists(self) -> bool: ...
+
+    @abstractmethod
+    def get_cmd(self, environment: dict, active_resources: dict) -> list[str]:
+        """The local command that launches the whole job."""
+
+    def _user_cmd(self) -> list[str]:
+        cmd = []
+        if not self.args.no_python:
+            cmd += [sys.executable, "-u"]
+            if self.args.module:
+                cmd += ["-m"]
+        cmd += [self.args.user_script] + list(self.args.user_args)
+        return cmd
+
+    def _launcher_cmd_for_node(self, node_rank: int | str,
+                               nnodes: int, nproc: int) -> list[str]:
+        return [sys.executable, "-u", "-m", "deepspeed_tpu.launcher.launch",
+                "--nnodes", str(nnodes),
+                "--node_rank", str(node_rank),
+                "--nproc_per_node", str(nproc),
+                "--master_addr", self.args.master_addr,
+                "--master_port", str(self.args.master_port)] \
+            + (["--module"] if self.args.module else []) \
+            + (["--no_python"] if self.args.no_python else []) \
+            + [self.args.user_script] + list(self.args.user_args)
+
+
+class PDSHRunner(MultiNodeRunner):
+    """pdsh fan-out; %n is pdsh's per-target rank substitution
+    (reference multinode_runner.py:51)."""
+
+    def backend_exists(self) -> bool:
+        return shutil.which("pdsh") is not None
+
+    def get_cmd(self, environment, active_resources):
+        hosts = ",".join(active_resources.keys())
+        nproc = next(iter(active_resources.values()))
+        exports = "".join(f"export {k}={_quote(v)}; "
+                          for k, v in self.exports.items())
+        launcher = " ".join(
+            self._launcher_cmd_for_node("%n", len(active_resources), nproc))
+        remote = f"{exports}cd {_quote(os.getcwd())}; {launcher}"
+        return ["pdsh", "-S", "-f", "1024", "-w", hosts] \
+            + (self.args.launcher_args.split() if self.args.launcher_args else []) \
+            + [remote]
+
+
+class SSHRunner(MultiNodeRunner):
+    """Plain-ssh fan-out for pods without pdsh: one ssh per node, managed by
+    a tiny local supervisor loop (same teardown semantics as launch.py)."""
+
+    def backend_exists(self) -> bool:
+        return shutil.which("ssh") is not None
+
+    def get_cmd(self, environment, active_resources):
+        # the runner special-cases SSHRunner and calls run() instead
+        raise NotImplementedError("SSHRunner manages its own processes")
+
+    def run(self, active_resources: dict) -> int:
+        import subprocess
+        import time
+
+        exports = "".join(f"export {k}={_quote(v)}; "
+                          for k, v in self.exports.items())
+        procs = []
+        for rank, (host, slots) in enumerate(active_resources.items()):
+            launcher = " ".join(
+                self._launcher_cmd_for_node(rank, len(active_resources), slots))
+            remote = f"{exports}cd {_quote(os.getcwd())}; {launcher}"
+            ssh = ["ssh", "-o", "StrictHostKeyChecking=no"]
+            if self.args.launcher_args:
+                ssh += self.args.launcher_args.split()
+            procs.append(subprocess.Popen(ssh + [host, remote]))
+        # first failure tears down the peers (same semantics as launch.py —
+        # a dead node would leave the others hung in collectives)
+        exit_code = 0
+        alive = set(range(len(procs)))
+        while alive:
+            time.sleep(0.5)
+            for i in sorted(alive):
+                rc = procs[i].poll()
+                if rc is None:
+                    continue
+                alive.discard(i)
+                if rc != 0 and exit_code == 0:
+                    exit_code = rc
+                    for j in sorted(alive):
+                        procs[j].terminate()
+        return exit_code
+
+
+class OpenMPIRunner(MultiNodeRunner):
+    """mpirun spawns every rank directly; ranks discover the rendezvous from
+    OMPI_COMM_WORLD_* env (reference multinode_runner.py:118)."""
+
+    def backend_exists(self) -> bool:
+        return shutil.which("mpirun") is not None
+
+    def get_cmd(self, environment, active_resources):
+        total = sum(active_resources.values())
+        hosts = ",".join(f"{h}:{s}" for h, s in active_resources.items())
+        cmd = ["mpirun", "-n", str(total), "--host", hosts,
+               "--mca", "btl", "^openib", "--mca", "btl_tcp_if_include", "eth0"]
+        for k, v in self.exports.items():
+            cmd += ["-x", f"{k}={v}"]
+        cmd += ["-x", f"DS_TPU_COORDINATOR={self.args.master_addr}:{self.args.master_port}"]
+        if self.args.launcher_args:
+            cmd += self.args.launcher_args.split()
+        return cmd + self._user_cmd()
+
+
+class SlurmRunner(MultiNodeRunner):
+    """srun spawns every rank; ranks discover the rendezvous from
+    SLURM_PROCID/SLURM_NTASKS (reference multinode_runner.py:328)."""
+
+    def backend_exists(self) -> bool:
+        return shutil.which("srun") is not None
+
+    def get_cmd(self, environment, active_resources):
+        total = sum(active_resources.values())
+        # --include/--exclude were already applied by the runner's
+        # parse_inclusion_exclusion; srun gets the surviving host set
+        cmd = ["srun", "-n", str(total),
+               "--nodes", str(len(active_resources)),
+               "--nodelist", ",".join(active_resources.keys())]
+        if self.args.launcher_args:
+            cmd += self.args.launcher_args.split()
+        exports = []
+        for k, v in self.exports.items():
+            exports.append(f"{k}={v}")
+        exports.append(
+            f"DS_TPU_COORDINATOR={self.args.master_addr}:{self.args.master_port}")
+        return cmd + ["--export", "ALL," + ",".join(exports)] + self._user_cmd()
+
+
+RUNNERS = {
+    "pdsh": PDSHRunner,
+    "ssh": SSHRunner,
+    "openmpi": OpenMPIRunner,
+    "slurm": SlurmRunner,
+}

@@ -1,0 +1,223 @@
+"""Job runner CLI (reference deepspeed/launcher/runner.py:398 + bin/deepspeed).
+
+    python -m deepspeed_tpu.launcher.runner [-H hostfile] [--include ...] \
+        [--launcher pdsh|ssh|openmpi|slurm] train.py --args
+
+Responsibilities (mirroring the reference):
+- hostfile parsing (``host slots=N`` lines, reference runner.py:210)
+- ``--include`` / ``--exclude`` resource filtering with ``host:slot,slot``
+  syntax (reference runner.py:265)
+- elastic node-count resolution from the config's ``elasticity`` section
+  (reference runner.py:383)
+- single-node fast path: exec the per-node launcher directly
+- multi-node: delegate to a MultiNodeRunner backend.
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import re
+import socket
+import subprocess
+import sys
+from collections import OrderedDict
+
+from ..utils.logging import logger
+from .multinode_runner import RUNNERS, SSHRunner
+
+DLTS_HOSTFILE = "/job/hostfile"  # reference default hostfile location
+
+
+def parse_args(argv=None):
+    p = argparse.ArgumentParser(
+        description="deepspeed_tpu launcher",
+        usage="python -m deepspeed_tpu.launcher.runner [options] script [script_args]")
+    p.add_argument("-H", "--hostfile", type=str, default=DLTS_HOSTFILE)
+    p.add_argument("-i", "--include", type=str, default="")
+    p.add_argument("-e", "--exclude", type=str, default="")
+    p.add_argument("--num_nodes", type=int, default=-1)
+    p.add_argument("--min_elastic_nodes", type=int, default=-1)
+    p.add_argument("--max_elastic_nodes", type=int, default=-1)
+    p.add_argument("--num_gpus", "--num_chips", dest="num_gpus", type=int, default=-1,
+                   help="worker processes per node (TPU: usually 1 per host)")
+    p.add_argument("--master_port", type=int,
+                   default=int(os.environ.get("DS_TPU_MASTER_PORT", 29500)))
+    p.add_argument("--master_addr", type=str,
+                   default=os.environ.get("DS_TPU_MASTER_ADDR", ""))
+    p.add_argument("--launcher", type=str, default="pdsh",
+                   choices=sorted(RUNNERS.keys()))
+    p.add_argument("--launcher_args", type=str, default="")
+    p.add_argument("--module", action="store_true")
+    p.add_argument("--no_python", action="store_true")
+    p.add_argument("--force_multi", action="store_true")
+    p.add_argument("--elastic_training", action="store_true")
+    p.add_argument("--deepspeed_config", type=str, default=None)
+    p.add_argument("user_script", type=str)
+    p.add_argument("user_args", nargs=argparse.REMAINDER)
+    return p.parse_args(argv)
+
+
+# ---------------------------------------------------------------------------
+# resource pool parsing (reference runner.py:210-363)
+def parse_hostfile(path: str) -> "OrderedDict[str, int]":
+    """``hostname slots=N`` per line; '#' comments."""
+    resources: "OrderedDict[str, int]" = OrderedDict()
+    if not os.path.isfile(path):
+        return resources
+    with open(path) as f:
+        for lineno, raw in enumerate(f, 1):
+            line = raw.split("#", 1)[0].strip()
+            if not line:
+                continue
+            m = re.fullmatch(r"(\S+)(?:\s+slots=(\d+))?", line)
+            if not m:
+                raise ValueError(f"{path}:{lineno}: bad hostfile line {raw!r}")
+            host, slots = m.group(1), int(m.group(2) or 1)
+            if host in resources:
+                raise ValueError(f"{path}:{lineno}: duplicate host {host}")
+            resources[host] = slots
+    return resources
+
+
+def _parse_filter(spec: str) -> dict[str, list[int] | None]:
+    """``host1@host2:0,2`` → {host1: None, host2: [0, 2]}."""
+    out: dict[str, list[int] | None] = {}
+    for part in spec.split("@"):
+        part = part.strip()
+        if not part:
+            continue
+        if ":" in part:
+            host, _, slots = part.partition(":")
+            out[host] = [int(s) for s in slots.split(",") if s != ""]
+        else:
+            out[part] = None
+    return out
+
+
+def parse_inclusion_exclusion(resources: "OrderedDict[str, int]",
+                              include: str, exclude: str) -> "OrderedDict[str, int]":
+    """Apply --include/--exclude (reference runner.py:265). Slot-level
+    filtering keeps a *count* of surviving slots (TPU workers are fungible
+    within a host)."""
+    if include and exclude:
+        raise ValueError("--include and --exclude are mutually exclusive")
+    active: "OrderedDict[str, int]" = OrderedDict()
+    if include:
+        for host, slots in _parse_filter(include).items():
+            if host not in resources:
+                raise ValueError(f"--include host {host} not in hostfile")
+            avail = resources[host]
+            if slots is None:
+                active[host] = avail
+            else:
+                bad = [s for s in slots if s >= avail]
+                if bad:
+                    raise ValueError(f"--include slots {bad} out of range for "
+                                     f"{host} (slots={avail})")
+                active[host] = len(set(slots))
+        return active
+    active = OrderedDict(resources)
+    if exclude:
+        for host, slots in _parse_filter(exclude).items():
+            if host not in active:
+                raise ValueError(f"--exclude host {host} not in hostfile")
+            if slots is None:
+                del active[host]
+            else:
+                remaining = active[host] - len(set(slots))
+                if remaining < 0:
+                    raise ValueError(f"--exclude removes more slots than {host} has")
+                if remaining == 0:
+                    del active[host]
+                else:
+                    active[host] = remaining
+    return active
+
+
+def fetch_hostfile_or_local(args) -> "OrderedDict[str, int]":
+    resources = parse_hostfile(args.hostfile)
+    if not resources:
+        nproc = args.num_gpus if args.num_gpus > 0 else 1
+        return OrderedDict({socket.gethostname(): nproc})
+    return resources
+
+
+# ---------------------------------------------------------------------------
+def resolve_elastic_nodes(args, resources) -> "OrderedDict[str, int]":
+    """Clamp the node set per the config's elasticity section
+    (reference runner.py:383)."""
+    if not args.elastic_training:
+        return resources
+    if args.deepspeed_config is None:
+        raise ValueError("--elastic_training needs --deepspeed_config")
+    with open(args.deepspeed_config) as f:
+        ds_config = json.load(f)
+    from ..elasticity import compute_elastic_config
+
+    slots = next(iter(resources.values()))
+    _, valid_chips = compute_elastic_config(ds_config)[:2]
+    valid_nodes = sorted({c // slots for c in valid_chips
+                          if c % slots == 0 and 0 < c // slots <= len(resources)})
+    if not valid_nodes:
+        raise ValueError(
+            f"no valid node count <= {len(resources)} for elastic config "
+            f"(valid chip counts {valid_chips}, {slots} slots/node)")
+    n = valid_nodes[-1]
+    if args.max_elastic_nodes > 0:
+        n = min(n, args.max_elastic_nodes)
+    if args.min_elastic_nodes > 0 and n < args.min_elastic_nodes:
+        raise ValueError(
+            f"largest valid elastic node count {n} is below "
+            f"--min_elastic_nodes {args.min_elastic_nodes} "
+            f"(valid chip counts {valid_chips}, {slots} slots/node)")
+    logger.info(f"elastic training: using {n}/{len(resources)} nodes")
+    return OrderedDict(list(resources.items())[:n])
+
+
+def main(argv=None) -> int:
+    args = parse_args(argv)
+    resources = fetch_hostfile_or_local(args)
+    active = parse_inclusion_exclusion(resources, args.include, args.exclude)
+    if args.num_nodes > 0:
+        active = OrderedDict(list(active.items())[:args.num_nodes])
+    if args.num_gpus > 0:
+        active = OrderedDict((h, args.num_gpus) for h in active)
+    active = resolve_elastic_nodes(args, active)
+    if not active:
+        raise ValueError("no usable hosts after filtering")
+
+    multi_node = args.force_multi or len(active) > 1
+    if not args.master_addr:
+        args.master_addr = next(iter(active)) if multi_node else "127.0.0.1"
+
+    if not multi_node:
+        host, nproc = next(iter(active.items()))
+        cmd = [sys.executable, "-u", "-m", "deepspeed_tpu.launcher.launch",
+               "--nnodes", "1", "--node_rank", "0",
+               "--nproc_per_node", str(nproc),
+               "--master_addr", args.master_addr,
+               "--master_port", str(args.master_port)] \
+            + (["--module"] if args.module else []) \
+            + (["--no_python"] if args.no_python else []) \
+            + [args.user_script] + list(args.user_args)
+        logger.info(f"single-node launch on {host}: {' '.join(cmd)}")
+        return subprocess.call(cmd)
+
+    nprocs = set(active.values())
+    if len(nprocs) > 1:
+        raise ValueError(f"heterogeneous slot counts unsupported: {dict(active)}")
+
+    runner_cls = RUNNERS[args.launcher]
+    runner = runner_cls(args, dict(active))
+    if not runner.backend_exists():
+        raise RuntimeError(f"launcher backend '{args.launcher}' not found on PATH")
+    if isinstance(runner, SSHRunner):
+        return runner.run(active)
+    cmd = runner.get_cmd(dict(os.environ), active)
+    logger.info(f"{args.launcher} launch: {' '.join(cmd)}")
+    return subprocess.call(cmd)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -4,6 +4,7 @@ runtime/activation_checkpointing.py for the DeepSpeed-parity surface and the
 mapping to the reference's CheckpointFunction)."""
 from __future__ import annotations
 
+import functools
 from typing import Any, Callable
 
 import jax
@@ -35,24 +36,31 @@ def make_policy(name: str):
     if name in POLICIES:
         return POLICIES[name]
     if name in ("cpu", "offload", "offload_dots"):
-        pol = _cp.offload_dot_with_no_batch_dims("device", "pinned_host")
-        # Constructing the policy always succeeds; whether the backend
-        # supports pinned_host offload only surfaces at compile time. Probe
-        # with a tiny checkpointed grad so a missing memory space degrades to
-        # dots_saveable here instead of failing inside the user's train step.
-        try:
-            import jax.numpy as jnp
-
-            f = jax.checkpoint(lambda x: jnp.sin(x @ x), policy=pol)
-            jax.jit(jax.grad(lambda x: f(x).sum())).lower(
-                jax.ShapeDtypeStruct((4, 4), jnp.float32)).compile()
-            return pol
-        except Exception:  # backend without host-offload support
-            logger.warning("activation offload policy unavailable on this "
-                           "backend; falling back to dots_saveable")
-            return _cp.dots_saveable
+        return _offload_policy()
     raise ValueError(f"unknown activation checkpointing policy '{name}'; "
                      f"one of {sorted(POLICIES)} or 'offload'")
+
+
+@functools.cache
+def _offload_policy():
+    """Constructing the offload policy always succeeds; whether the backend
+    supports pinned_host offload only surfaces at compile time. Probe once
+    per process with a tiny checkpointed grad so a missing memory space
+    degrades to dots_saveable here instead of failing inside the user's
+    train step (make_policy is called on every model trace — the cache keeps
+    the probe off the hot path)."""
+    pol = _cp.offload_dot_with_no_batch_dims("device", "pinned_host")
+    try:
+        import jax.numpy as jnp
+
+        f = jax.checkpoint(lambda x: jnp.sin(x @ x), policy=pol)
+        jax.jit(jax.grad(lambda x: f(x).sum())).lower(
+            jax.ShapeDtypeStruct((4, 4), jnp.float32)).compile()
+        return pol
+    except Exception:  # backend without host-offload support
+        logger.warning("activation offload policy unavailable on this "
+                       "backend; falling back to dots_saveable")
+        return _cp.dots_saveable
 
 
 def checkpoint_fn(fn: Callable, policy: str = "full",

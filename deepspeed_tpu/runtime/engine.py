@@ -411,10 +411,13 @@ class DeepSpeedEngine:
         self.timers(TRAIN_BATCH_TIMER).start()
         batch = self._shard_batch(self._reshape_for_gas(batch), with_gas_dim=True)
         if self.flops_profiler is not None and not self.flops_profiler.profiled:
+            # last_step_s is device-synced only under wall_clock_breakdown;
+            # otherwise it measures async dispatch and would inflate TFLOPS
             self.flops_profiler.maybe_profile_step(
                 self._train_step, (self.state, batch), self.global_steps,
                 params=self.num_parameters(),
-                latency_s=self.tput_timer.last_step_s)
+                latency_s=self.tput_timer.last_step_s
+                if self.config.wall_clock_breakdown else None)
         self.state, loss = self._train_step(self.state, batch)
         self.global_steps += 1
         if self.config.wall_clock_breakdown:
