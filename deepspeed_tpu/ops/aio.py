@@ -1,0 +1,131 @@
+"""Async file I/O handle — Python surface of the native aio engine
+(reference csrc/aio/py_lib/deepspeed_py_aio_handle.cpp + the
+``deepspeed.ops.op_builder.AsyncIOBuilder`` wrapper API).
+
+``AsyncIOHandle`` schedules positioned reads/writes of numpy buffers on the
+native thread pool (deepspeed_tpu/csrc/aio.cpp); without the native lib a
+``ThreadPoolExecutor`` fallback keeps the semantics (correct, slower).
+"""
+from __future__ import annotations
+
+import os
+from concurrent.futures import Future, ThreadPoolExecutor
+
+import numpy as np
+
+from .native import load_library
+
+
+class AsyncIOHandle:
+    """Reference aio_handle(block_size, queue_depth, single_submit,
+    overlap_events, num_threads) — here (num_threads, block_size); the
+    other knobs are libaio-specific."""
+
+    def __init__(self, num_threads: int = 8, block_size: int = 1 << 20):
+        self.block_size = int(block_size)
+        self.num_threads = int(num_threads)
+        self._lib = load_library()
+        self._handle = None
+        self._pool: ThreadPoolExecutor | None = None
+        self._futures: dict[int, Future] = {}
+        self._next_id = 1
+        self._keepalive: dict[int, np.ndarray] = {}
+        if self._lib is not None:
+            self._handle = self._lib.dstpu_aio_create(self.num_threads,
+                                                      self.block_size)
+        else:
+            self._pool = ThreadPoolExecutor(max_workers=self.num_threads)
+
+    # -- submission -----------------------------------------------------
+    def _check(self, arr: np.ndarray):
+        if not isinstance(arr, np.ndarray) or not arr.flags.c_contiguous:
+            raise ValueError("aio needs a C-contiguous numpy array")
+
+    def async_pread(self, arr: np.ndarray, path: str, file_offset: int = 0) -> int:
+        """Read len(arr) bytes from path@offset into arr (in place)."""
+        self._check(arr)
+        if self._lib is not None:
+            rid = self._lib.dstpu_aio_read(
+                self._handle, path.encode(), arr.ctypes.data, arr.nbytes,
+                file_offset)
+            if rid < 0:
+                raise OSError(-rid, os.strerror(-rid), path)
+            self._keepalive[rid] = arr
+            return rid
+
+        def work():
+            with open(path, "rb") as f:
+                f.seek(file_offset)
+                data = f.read(arr.nbytes)
+            if len(data) != arr.nbytes:
+                raise OSError(f"short read from {path}")
+            arr.view(np.uint8).reshape(-1)[:] = np.frombuffer(data, np.uint8)
+
+        return self._submit_py(work, arr)
+
+    def async_pwrite(self, arr: np.ndarray, path: str, file_offset: int = 0) -> int:
+        self._check(arr)
+        if self._lib is not None:
+            rid = self._lib.dstpu_aio_write(
+                self._handle, path.encode(), arr.ctypes.data, arr.nbytes,
+                file_offset)
+            if rid < 0:
+                raise OSError(-rid, os.strerror(-rid), path)
+            self._keepalive[rid] = arr
+            return rid
+
+        def work():
+            flags = os.O_WRONLY | os.O_CREAT
+            fd = os.open(path, flags, 0o644)
+            try:
+                os.pwrite(fd, arr.tobytes(), file_offset)
+            finally:
+                os.close(fd)
+
+        return self._submit_py(work, arr)
+
+    def _submit_py(self, work, arr) -> int:
+        rid = self._next_id
+        self._next_id += 1
+        self._futures[rid] = self._pool.submit(work)
+        self._keepalive[rid] = arr
+        return rid
+
+    # -- completion -----------------------------------------------------
+    def wait(self, request_id: int) -> None:
+        """Block until the request completes; raises on I/O error."""
+        try:
+            if self._lib is not None:
+                st = self._lib.dstpu_aio_wait(self._handle, request_id)
+                if st < 0:
+                    raise OSError(-st, os.strerror(-st))
+            else:
+                self._futures.pop(request_id).result()
+        finally:
+            self._keepalive.pop(request_id, None)
+
+    def pending(self) -> int:
+        if self._lib is not None:
+            return self._lib.dstpu_aio_pending(self._handle)
+        return sum(1 for f in self._futures.values() if not f.done())
+
+    # -- convenience ----------------------------------------------------
+    def sync_pread(self, arr: np.ndarray, path: str, file_offset: int = 0):
+        self.wait(self.async_pread(arr, path, file_offset))
+
+    def sync_pwrite(self, arr: np.ndarray, path: str, file_offset: int = 0):
+        self.wait(self.async_pwrite(arr, path, file_offset))
+
+    def close(self):
+        if self._lib is not None and self._handle is not None:
+            self._lib.dstpu_aio_destroy(self._handle)
+            self._handle = None
+        if self._pool is not None:
+            self._pool.shutdown(wait=True)
+            self._pool = None
+
+    def __del__(self):
+        try:
+            self.close()
+        except Exception:
+            pass

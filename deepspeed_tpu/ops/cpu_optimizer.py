@@ -1,0 +1,180 @@
+"""Host-side fused optimizers over flat numpy shards — Python surface of the
+native SIMD kernels (reference deepspeed/ops/adam/cpu_adam.py:13 `DeepSpeedCPUAdam`,
+ops/adagrad, ops/lion backed by csrc/{adam,adagrad,lion}).
+
+These run the optimizer math on the HOST for offloaded (ZeRO-Offload /
+ZeRO-Infinity style) states: fp32 master + moments stay in host RAM or on
+NVMe, only bf16 params travel back to the device. Numpy fallbacks keep
+behavior identical without the native build.
+"""
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+
+import numpy as np
+
+from .native import load_library
+
+
+@dataclass
+class HostOptState:
+    """Per-leaf host state: fp32 master + moment buffers. Buffers may be
+    None while spilled to NVMe; shape/numel always describe the leaf."""
+    master: np.ndarray | None               # fp32, flat
+    mu: np.ndarray | None = None            # fp32, flat
+    nu: np.ndarray | None = None            # fp32, flat
+    shape: tuple = ()
+    numel: int = 0
+    dtype: object = None                    # device param dtype
+
+    def buffers(self) -> dict[str, np.ndarray]:
+        out = {"master": self.master}
+        if self.mu is not None:
+            out["mu"] = self.mu
+        if self.nu is not None:
+            out["nu"] = self.nu
+        return {k: v for k, v in out.items() if v is not None}
+
+    def drop_buffers(self) -> None:
+        self.master = None
+        self.mu = None
+        self.nu = None
+
+
+class CPUOptimizer:
+    """Fused host optimizer; subclasses define slots + the update kernel."""
+
+    SLOTS: tuple[str, ...] = ()
+
+    def __init__(self, lr: float = 1e-3, weight_decay: float = 0.0, **kw):
+        self.lr = float(lr)
+        self.weight_decay = float(weight_decay)
+        self._lib = load_library()
+
+    def init_state(self, param: np.ndarray, dtype=None) -> HostOptState:
+        flat = np.ascontiguousarray(param, np.float32).reshape(-1)
+        st = HostOptState(master=flat, shape=tuple(param.shape),
+                          numel=flat.size, dtype=dtype or param.dtype)
+        if "mu" in self.SLOTS:
+            st.mu = np.zeros_like(flat)
+        if "nu" in self.SLOTS:
+            st.nu = np.zeros_like(flat)
+        return st
+
+    def step(self, st: HostOptState, grad: np.ndarray, step: int,
+             lr: float | None = None) -> None:
+        """In-place update of st.master (+ moments) from a flat fp32 grad."""
+        raise NotImplementedError
+
+
+class CPUAdam(CPUOptimizer):
+    """reference ops/adam/cpu_adam.py:13 (adamw_mode=True default)."""
+
+    SLOTS = ("mu", "nu")
+
+    def __init__(self, lr: float = 1e-3, betas=(0.9, 0.999), eps: float = 1e-8,
+                 weight_decay: float = 0.0, adamw_mode: bool = True,
+                 bias_correction: bool = True, **kw):
+        super().__init__(lr=lr, weight_decay=weight_decay)
+        self.beta1, self.beta2 = float(betas[0]), float(betas[1])
+        self.eps = float(eps)
+        self.adamw_mode = bool(adamw_mode)
+        self.bias_correction = bool(bias_correction)
+
+    def step(self, st, grad, step, lr=None):
+        lr = self.lr if lr is None else float(lr)
+        g = np.ascontiguousarray(grad, np.float32).reshape(-1)
+        n = st.master.size
+        if self._lib is not None:
+            self._lib.dstpu_adam_step(
+                st.master.ctypes.data, st.mu.ctypes.data, st.nu.ctypes.data,
+                g.ctypes.data, n, lr, self.beta1, self.beta2, self.eps,
+                self.weight_decay, step, int(self.adamw_mode),
+                int(self.bias_correction))
+            return
+        # numpy fallback (same math as csrc/cpu_adam.cpp)
+        if not self.adamw_mode and self.weight_decay:
+            g = g + self.weight_decay * st.master
+        st.mu[:] = self.beta1 * st.mu + (1 - self.beta1) * g
+        st.nu[:] = self.beta2 * st.nu + (1 - self.beta2) * g * g
+        bc1 = 1 - self.beta1 ** step if self.bias_correction else 1.0
+        bc2 = 1 - self.beta2 ** step if self.bias_correction else 1.0
+        denom = np.sqrt(st.nu / bc2) + self.eps
+        if self.adamw_mode and self.weight_decay:
+            st.master *= 1 - lr * self.weight_decay
+        st.master -= (lr / bc1) * st.mu / denom
+
+
+class CPUAdagrad(CPUOptimizer):
+    """reference ops/adagrad/cpu_adagrad.py."""
+
+    SLOTS = ("nu",)
+
+    def __init__(self, lr: float = 1e-2, eps: float = 1e-10,
+                 weight_decay: float = 0.0, **kw):
+        super().__init__(lr=lr, weight_decay=weight_decay)
+        self.eps = float(eps)
+
+    def step(self, st, grad, step, lr=None):
+        lr = self.lr if lr is None else float(lr)
+        g = np.ascontiguousarray(grad, np.float32).reshape(-1)
+        if self._lib is not None:
+            self._lib.dstpu_adagrad_step(
+                st.master.ctypes.data, st.nu.ctypes.data, g.ctypes.data,
+                st.master.size, lr, self.eps, self.weight_decay)
+            return
+        if self.weight_decay:
+            g = g + self.weight_decay * st.master
+        st.nu[:] = st.nu + g * g
+        st.master -= lr * g / (np.sqrt(st.nu) + self.eps)
+
+
+class CPULion(CPUOptimizer):
+    """reference ops/lion (csrc/lion): sign update, decoupled decay."""
+
+    SLOTS = ("mu",)
+
+    def __init__(self, lr: float = 1e-4, betas=(0.9, 0.99),
+                 weight_decay: float = 0.0, **kw):
+        super().__init__(lr=lr, weight_decay=weight_decay)
+        self.beta1, self.beta2 = float(betas[0]), float(betas[1])
+
+    def step(self, st, grad, step, lr=None):
+        lr = self.lr if lr is None else float(lr)
+        g = np.ascontiguousarray(grad, np.float32).reshape(-1)
+        if self._lib is not None:
+            self._lib.dstpu_lion_step(
+                st.master.ctypes.data, st.mu.ctypes.data, g.ctypes.data,
+                st.master.size, lr, self.beta1, self.beta2, self.weight_decay)
+            return
+        c = self.beta1 * st.mu + (1 - self.beta1) * g
+        update = np.sign(c)
+        if self.weight_decay:
+            update = update + self.weight_decay * st.master
+        st.master -= lr * update
+        st.mu[:] = self.beta2 * st.mu + (1 - self.beta2) * g
+
+
+CPU_OPTIMIZERS = {
+    "adam": CPUAdam,
+    "adamw": CPUAdam,
+    "adagrad": CPUAdagrad,
+    "lion": CPULion,
+}
+
+
+def build_cpu_optimizer(name: str, params: dict) -> CPUOptimizer:
+    key = name.lower()
+    if key not in CPU_OPTIMIZERS:
+        raise ValueError(
+            f"offloaded optimizer '{name}' unsupported; one of "
+            f"{sorted(set(CPU_OPTIMIZERS))}")
+    kw = dict(params)
+    kw.pop("torch_adam", None)
+    # DeepSpeed config spells it adam_w_mode (ops/optimizers.py maps it the
+    # same way for the device path — the two must stay in lockstep)
+    if "adam_w_mode" in kw:
+        kw["adamw_mode"] = bool(kw.pop("adam_w_mode"))
+    if key == "adam":
+        kw.setdefault("adamw_mode", False)
+    return CPU_OPTIMIZERS[key](**kw)

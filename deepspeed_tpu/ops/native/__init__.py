@@ -1,0 +1,128 @@
+"""Native host ops: JIT builder + ctypes bindings.
+
+The analogue of the reference's op_builder JIT-compilation layer
+(op_builder/builder.py:109 `OpBuilder.load`): first use compiles
+``deepspeed_tpu/csrc/*.cpp`` into one shared library under a content-hashed
+cache path, then binds it with ctypes (this image has no pybind11). Every
+caller must handle ``load_library() is None`` — pure-python/numpy fallbacks
+keep the framework functional without a toolchain.
+"""
+from __future__ import annotations
+
+import ctypes
+import hashlib
+import os
+import shutil
+import subprocess
+import threading
+
+from ...utils.logging import logger
+
+_CSRC = os.path.join(os.path.dirname(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__)))), "csrc")
+_SOURCES = ("aio.cpp", "cpu_adam.cpp")
+_HEADERS = ("threadpool.h",)
+
+_lock = threading.Lock()
+_lib: ctypes.CDLL | None = None
+_build_error: str | None = None
+_attempted = False
+
+
+def _source_hash() -> str:
+    h = hashlib.sha256()
+    for fname in _SOURCES + _HEADERS:
+        with open(os.path.join(_CSRC, fname), "rb") as f:
+            h.update(f.read())
+    return h.hexdigest()[:16]
+
+
+def _cache_dir() -> str:
+    base = os.environ.get("DS_TPU_CACHE",
+                          os.path.join(os.path.expanduser("~"), ".cache",
+                                       "deepspeed_tpu"))
+    return os.path.join(base, "native")
+
+
+def build_library(verbose: bool = False) -> str:
+    """Compile the native library if needed; returns the .so path."""
+    so_path = os.path.join(_cache_dir(), f"libdstpu_{_source_hash()}.so")
+    if os.path.exists(so_path):
+        return so_path
+    cxx = os.environ.get("CXX") or shutil.which("g++") or shutil.which("clang++")
+    if cxx is None:
+        raise RuntimeError("no C++ compiler (g++/clang++) on PATH")
+    os.makedirs(os.path.dirname(so_path), exist_ok=True)
+    tmp = so_path + f".tmp{os.getpid()}"
+    cmd = [cxx, "-O3", "-march=native", "-std=c++17", "-shared", "-fPIC",
+           "-fopenmp", "-Wall"] \
+        + [os.path.join(_CSRC, s) for s in _SOURCES] \
+        + ["-o", tmp, "-lpthread"]
+    if verbose:
+        logger.info(f"building native ops: {' '.join(cmd)}")
+    try:
+        subprocess.run(cmd, check=True, capture_output=True, text=True)
+    except subprocess.CalledProcessError as e:
+        raise RuntimeError(f"native build failed:\n{e.stderr}") from e
+    os.replace(tmp, so_path)  # atomic vs concurrent builders
+    return so_path
+
+
+def _bind(lib: ctypes.CDLL) -> ctypes.CDLL:
+    i64, i32 = ctypes.c_int64, ctypes.c_int
+    f32 = ctypes.c_float
+    p = ctypes.c_void_p
+    s = ctypes.c_char_p
+
+    lib.dstpu_aio_create.argtypes = [i32, i64]
+    lib.dstpu_aio_create.restype = p
+    lib.dstpu_aio_destroy.argtypes = [p]
+    for fn in (lib.dstpu_aio_read, lib.dstpu_aio_write):
+        fn.argtypes = [p, s, p, i64, i64]
+        fn.restype = i64
+    lib.dstpu_aio_wait.argtypes = [p, i64]
+    lib.dstpu_aio_wait.restype = i64
+    lib.dstpu_aio_pending.argtypes = [p]
+    lib.dstpu_aio_pending.restype = i32
+
+    lib.dstpu_adam_step.argtypes = [p, p, p, p, i64, f32, f32, f32, f32, f32,
+                                    i64, i32, i32]
+    lib.dstpu_adam_step_bf16g.argtypes = [p, p, p, p, p, i64, f32, f32, f32,
+                                          f32, f32, i64, i32, i32]
+    lib.dstpu_adagrad_step.argtypes = [p, p, p, i64, f32, f32, f32]
+    lib.dstpu_lion_step.argtypes = [p, p, p, i64, f32, f32, f32, f32]
+    lib.dstpu_f32_to_bf16.argtypes = [p, p, i64]
+    lib.dstpu_bf16_to_f32.argtypes = [p, p, i64]
+    lib.dstpu_num_threads.restype = i32
+    return lib
+
+
+def load_library() -> ctypes.CDLL | None:
+    """Build (once) and load the native library; None if unavailable."""
+    global _lib, _build_error, _attempted
+    if _lib is not None:
+        return _lib
+    with _lock:
+        if _lib is not None or _attempted:
+            return _lib
+        _attempted = True
+        if os.environ.get("DS_TPU_DISABLE_NATIVE"):
+            _build_error = "disabled via DS_TPU_DISABLE_NATIVE"
+            return None
+        try:
+            so_path = build_library()
+            _lib = _bind(ctypes.CDLL(so_path))
+            logger.info(f"native ops loaded: {so_path} "
+                        f"({_lib.dstpu_num_threads()} omp threads)")
+        except Exception as e:
+            _build_error = str(e)
+            logger.warning(f"native ops unavailable ({e}); numpy fallbacks active")
+    return _lib
+
+
+def lib_status() -> tuple[bool, str]:
+    """(available, detail) — surfaced by env_report."""
+    lib = load_library()
+    if lib is not None:
+        return True, f"loaded ({lib.dstpu_num_threads()} omp threads)"
+    return False, _build_error or "not attempted"

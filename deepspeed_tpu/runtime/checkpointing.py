@@ -34,6 +34,27 @@ def _ocp():
     return ocp
 
 
+def _params_treedef_and_keys(params):
+    flat, treedef = jax.tree_util.tree_flatten_with_path(params)
+    return treedef, [jax.tree_util.keystr(p) for p, _ in flat]
+
+
+def _offload_state_as_tree(engine) -> dict:
+    """Materialize host master/moments into param-structured numpy pytrees."""
+    import numpy as np
+
+    g = engine._offload_opt.global_trees()
+    treedef, keys = _params_treedef_and_keys(engine.state.params)
+    out = {"opt_step": np.asarray(engine._offload_opt.step_count, np.int32),
+           "master": jax.tree_util.tree_unflatten(
+               treedef, [g["master"][k] for k in keys])}
+    for slot, name in (("mu", "opt_mu"), ("nu", "opt_nu")):
+        if slot in g:
+            out[name] = jax.tree_util.tree_unflatten(
+                treedef, [g[slot][k] for k in keys])
+    return out
+
+
 def save_checkpoint(engine, save_dir: str, tag: str | None = None,
                     client_state: dict | None = None) -> str:
     ocp = _ocp()
@@ -55,6 +76,11 @@ def save_checkpoint(engine, save_dir: str, tag: str | None = None,
             "hysteresis": state.scaler.hysteresis,
         },
     }
+    if getattr(engine, "_offload_opt", None) is not None:
+        # host-offloaded master/moments are written in the SAME logical
+        # layout as the on-device path, so offload ↔ device checkpoints are
+        # interchangeable (universal-resume across offload modes)
+        tree.update(_offload_state_as_tree(engine))
     tree = {k: v for k, v in tree.items() if v is not None}
 
     ckptr = ocp.PyTreeCheckpointer()
@@ -90,6 +116,9 @@ def load_checkpoint(engine, load_dir: str, tag: str | None = None) -> dict:
 
     state = engine.state
     shardings = engine._state_shardings
+
+    if getattr(engine, "_offload_opt", None) is not None:
+        return _load_checkpoint_offload(engine, path)
 
     # restore targets carry the *current* shardings → reshard-on-load
     # (the universal-checkpoint property).
@@ -154,4 +183,76 @@ def load_checkpoint(engine, load_dir: str, tag: str | None = None) -> dict:
         meta = json.load(f)
     engine.global_steps = meta.get("global_steps", int(engine.state.global_step))
     log_dist(f"loaded checkpoint {path} (step {engine.global_steps})")
+    return meta.get("client_state", {})
+
+
+def _load_checkpoint_offload(engine, path: str) -> dict:
+    """Restore into a host-offloaded engine: params go to device (resharded
+    per the current plan), master/moments restore to host numpy and are
+    handed to the offload optimizer."""
+    import numpy as np
+
+    ocp = _ocp()
+    state = engine.state
+    shardings = engine._state_shardings
+    ckptr = ocp.PyTreeCheckpointer()
+    state_path = os.path.join(path, "state")
+
+    # which entries the checkpoint actually has (fp32 non-offload runs save
+    # no "master"; non-momentum optimizers save no mu/nu)
+    md = ckptr.metadata(state_path)
+    saved = set(md.item_metadata.tree.keys())
+
+    def np_like(x):
+        return np.empty(x.shape, np.float32)
+
+    target = {
+        "params": state.params,
+        "opt_step": np.zeros((), np.int32),
+        "global_step": state.global_step,
+    }
+    restore_args = {
+        "params": jax.tree.map(
+            lambda x, s: ocp.ArrayRestoreArgs(sharding=s, global_shape=x.shape,
+                                              dtype=x.dtype),
+            state.params, shardings.params),
+        "opt_step": ocp.RestoreArgs(restore_type=np.ndarray),
+        "global_step": ocp.ArrayRestoreArgs(
+            sharding=shardings.global_step,
+            global_shape=state.global_step.shape, dtype=state.global_step.dtype),
+    }
+    slots = engine._offload_opt.cpu_opt.SLOTS
+    wanted = [("master", "master")] + [
+        (s, f"opt_{s}") for s in ("mu", "nu") if s in slots]
+    for slot, name in wanted:
+        if name in saved:
+            target[name] = jax.tree.map(np_like, state.params)
+            restore_args[name] = jax.tree.map(
+                lambda x: ocp.RestoreArgs(restore_type=np.ndarray), target[name])
+
+    restored = ckptr.restore(state_path, item=target, restore_args=restore_args)
+
+    def by_key(tree):
+        flat, _ = jax.tree_util.tree_flatten_with_path(tree)
+        return {jax.tree_util.keystr(p): l for p, l in flat}
+
+    step = int(np.asarray(restored["opt_step"]))
+    # no master in the checkpoint (pure-fp32 run): params ARE the master
+    master = by_key(restored["master"]) if "master" in restored else {
+        k: np.asarray(v, np.float32) for k, v in by_key(restored["params"]).items()}
+    engine._offload_opt.load_global_trees(
+        master,
+        by_key(restored["opt_mu"]) if "opt_mu" in restored else None,
+        by_key(restored["opt_nu"]) if "opt_nu" in restored else None,
+        step)
+    engine.state = state._replace(
+        params=restored["params"],
+        opt_state=state.opt_state._replace(
+            step=jnp.asarray(step, jnp.int32)),
+        global_step=restored["global_step"])
+
+    with open(os.path.join(path, "meta.json")) as f:
+        meta = json.load(f)
+    engine.global_steps = meta.get("global_steps", int(engine.state.global_step))
+    log_dist(f"loaded checkpoint {path} (step {engine.global_steps}, host-offload)")
     return meta.get("client_state", {})

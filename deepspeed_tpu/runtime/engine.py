@@ -157,6 +157,24 @@ class DeepSpeedEngine:
 
             self.flops_profiler = FlopsProfiler(config.flops_profiler)
 
+        # host-offloaded optimizer (ZeRO-Offload/-Infinity; reference
+        # stage_1_and_2.py:1190 CPU path + swap_tensor/)
+        self._offload_opt = None
+        off = config.zero_optimization.offload_optimizer
+        if off.device in ("cpu", "nvme"):
+            if self.fp16_enabled:
+                raise ValueError("offload_optimizer requires bf16/fp32 "
+                                 "(dynamic loss scaling is device-side)")
+            from .zero.offload import HostOffloadOptimizer
+
+            self._offload_opt = HostOffloadOptimizer(
+                config.optimizer.type, config.optimizer.params, off,
+                compute_dtype=self.compute_dtype if self.mixed_precision
+                else jnp.float32)
+        elif off.device not in ("none",):
+            raise ValueError(f"offload_optimizer.device '{off.device}' "
+                             f"unsupported (none|cpu|nvme)")
+
         # ---- state bring-up (reference _configure_distributed_model :1137)
         self._init_state(params, sample_batch, rng)
         self._build_programs()
@@ -215,6 +233,29 @@ class DeepSpeedEngine:
         else:
             params = unbox_params(params)
             master0 = jax.device_put(_cast_tree(params, jnp.float32), master_shardings)
+
+        if self._offload_opt is not None:
+            # master + moments move to the host; the device keeps only the
+            # compute-dtype params (ZeRO-Offload memory model)
+            if self.mixed_precision:
+                params0 = jax.jit(lambda m: _cast_tree(m, self.compute_dtype),
+                                  out_shardings=param_shardings)(master0)
+            else:
+                params0 = jax.jit(lambda m: m, out_shardings=param_shardings)(master0)
+            self._offload_opt.init_from_master(master0)
+            del master0
+            self.state = TrainState(
+                params=params0, master=None,
+                opt_state=OptState(step=jnp.zeros((), jnp.int32), mu=None, nu=None),
+                scaler=None, global_step=jnp.zeros((), jnp.int32))
+            self._state_shardings = TrainState(
+                params=param_shardings, master=None,
+                opt_state=OptState(step=NamedSharding(topo.mesh, P()),
+                                   mu=None, nu=None),
+                scaler=None,
+                global_step=NamedSharding(topo.mesh, P()),
+            )
+            return
 
         opt0 = jax.jit(self.optimizer.init,
                        out_shardings=self._opt_shardings_for(master_shardings))(master0)
@@ -315,11 +356,9 @@ class DeepSpeedEngine:
         ss = self._state_shardings
         repl = NamedSharding(topo.mesh, P())
 
-        def train_step(state: TrainState, batch: dict):
-            """Full global-batch step: scan over GAS microbatches, fp32 grad
-            accumulation (data_types.grad_accum_dtype), then one update.
-            This is the compiled analogue of the forward/backward/step loop
-            (reference engine.py:1838/:1977/:2176)."""
+        def gas_grads(state: TrainState, batch: dict):
+            """Scan over GAS microbatches with fp32 grad accumulation
+            (reference engine.py:1838/:1977 forward/backward loop)."""
             def micro(carry, mb):
                 loss_sum, grad_acc = carry
                 loss, grads = self._compute_grads(state, mb)
@@ -332,14 +371,7 @@ class DeepSpeedEngine:
             (loss_sum, grads), _ = jax.lax.scan(
                 micro, (jnp.zeros((), jnp.float32), zero_grads), batch)
             grads = jax.tree.map(lambda g: g / gas, grads)
-            new_state = self._apply_grads(state, grads)
-            return new_state, loss_sum / gas
-
-        self._train_step = jax.jit(
-            train_step,
-            out_shardings=(ss, repl),
-            donate_argnums=(0,),
-        )
+            return loss_sum / gas, grads
 
         def eval_step(state: TrainState, batch: dict):
             return self._loss_with_rules(state.params, batch)
@@ -359,11 +391,57 @@ class DeepSpeedEngine:
         self._accum_fn = jax.jit(accum, out_shardings=self.plan.grad_shardings,
                                  donate_argnums=(0,))
 
+        if self._offload_opt is not None:
+            # host-optimizer path: GAS scan / clipping stay on device, the
+            # parameter update runs in the host SIMD optimizer
+            self._offload_gas_grads = jax.jit(
+                gas_grads, out_shardings=(repl, self.plan.grad_shardings))
+
+            def finalize(grads: Pytree, scale: jax.Array):
+                grads = jax.tree.map(lambda g: g * scale, grads)
+                if cfg.gradient_clipping:
+                    norm = _global_norm(grads)
+                    clip = jnp.minimum(1.0, cfg.gradient_clipping / (norm + 1e-6))
+                    grads = jax.tree.map(lambda g: g * clip, grads)
+                return grads
+
+            self._offload_finalize = jax.jit(
+                finalize, out_shardings=self.plan.grad_shardings,
+                donate_argnums=(0,))
+            self._train_step = None
+            self._apply_step = None
+            return
+
+        def train_step(state: TrainState, batch: dict):
+            """Full global-batch step: GAS scan then one update — the
+            compiled analogue of forward/backward/step (reference
+            engine.py:1838/:1977/:2176)."""
+            loss, grads = gas_grads(state, batch)
+            new_state = self._apply_grads(state, grads)
+            return new_state, loss
+
+        self._train_step = jax.jit(
+            train_step,
+            out_shardings=(ss, repl),
+            donate_argnums=(0,),
+        )
+
         def apply_step(state: TrainState, grads: Pytree, scale: jax.Array):
             grads = jax.tree.map(lambda g: g * scale, grads)
             return self._apply_grads(state, grads)
 
         self._apply_step = jax.jit(apply_step, out_shardings=ss, donate_argnums=(0,))
+
+    def _offload_apply(self, grads: Pytree) -> None:
+        """Host optimizer step + device param refresh."""
+        step_scalar = self.state.opt_state.step
+        lr = float(self.lr_schedule(step_scalar))
+        new_params = self._offload_opt.step_tree(
+            grads, self.plan.param_shardings, lr)
+        self.state = self.state._replace(
+            params=new_params,
+            opt_state=self.state.opt_state._replace(step=step_scalar + 1),
+            global_step=self.state.global_step + 1)
 
     # ------------------------------------------------------------------
     # batch plumbing
@@ -410,15 +488,23 @@ class DeepSpeedEngine:
         self.tput_timer.start()
         self.timers(TRAIN_BATCH_TIMER).start()
         batch = self._shard_batch(self._reshape_for_gas(batch), with_gas_dim=True)
+        profile_target = self._train_step if self._offload_opt is None \
+            else self._offload_gas_grads
         if self.flops_profiler is not None and not self.flops_profiler.profiled:
             # last_step_s is device-synced only under wall_clock_breakdown;
             # otherwise it measures async dispatch and would inflate TFLOPS
             self.flops_profiler.maybe_profile_step(
-                self._train_step, (self.state, batch), self.global_steps,
+                profile_target, (self.state, batch), self.global_steps,
                 params=self.num_parameters(),
                 latency_s=self.tput_timer.last_step_s
                 if self.config.wall_clock_breakdown else None)
-        self.state, loss = self._train_step(self.state, batch)
+        if self._offload_opt is not None:
+            loss, grads = self._offload_gas_grads(self.state, batch)
+            if self.config.gradient_clipping:  # scale=1: only clip matters
+                grads = self._offload_finalize(grads, jnp.ones((), jnp.float32))
+            self._offload_apply(grads)
+        else:
+            self.state, loss = self._train_step(self.state, batch)
         self.global_steps += 1
         if self.config.wall_clock_breakdown:
             self.timers(TRAIN_BATCH_TIMER).stop(sync_val=loss)
@@ -496,7 +582,11 @@ class DeepSpeedEngine:
             return
         self.timers(STEP_GLOBAL_TIMER).start()
         scale = jnp.asarray(1.0 / max(self._accum_count, 1), jnp.float32)
-        self.state = self._apply_step(self.state, self._accum_grads, scale)
+        if self._offload_opt is not None:
+            grads = self._offload_finalize(self._accum_grads, scale)
+            self._offload_apply(grads)
+        else:
+            self.state = self._apply_step(self.state, self._accum_grads, scale)
         self._accum_grads = None
         self._accum_count = 0
         self.global_steps += 1
