@@ -238,12 +238,35 @@ class CheckpointConfig:
 # Top-level config
 # --------------------------------------------------------------------------
 
+@dataclass
+class DataEfficiencyConfig:
+    """Reference: runtime/data_pipeline config surface (data_efficiency
+    section with data_sampling.curriculum_learning + data_routing.random_ltd;
+    legacy top-level curriculum_learning maps in via Config.from_dict)."""
+    enabled: bool = False
+    seed: int = 1234
+    data_sampling: dict = field(default_factory=dict)
+    data_routing: dict = field(default_factory=dict)
+
+    def curriculum_config(self) -> dict | None:
+        cl = self.data_sampling.get("curriculum_learning", {})
+        if self.data_sampling.get("enabled", True) and cl.get("enabled", False):
+            return cl
+        return None
+
+    def random_ltd_config(self) -> dict | None:
+        rl = self.data_routing.get("random_ltd", {})
+        if self.data_routing.get("enabled", True) and rl.get("enabled", False):
+            return rl
+        return None
+
+
 _TOP_LEVEL_IGNORED = (
     # GPU-only / not-applicable sections accepted for config compat:
     "amp", "apex", "cuda_graphs", "communication_data_type", "disable_allgather",
     "sparse_gradients", "prescale_gradients", "gradient_predivide_factor",
     "dump_state", "elasticity", "nebula", "hybrid_engine", "compression_training",
-    "curriculum_learning", "data_efficiency", "aio", "autotuning",
+    "aio", "autotuning",
     "zero_force_ds_cpu_optimizer", "checkpoint_parallel_write_pipeline",
     "memory_breakdown", "use_data_before_expert_parallel_",
 )
@@ -280,6 +303,8 @@ class Config:
     wandb: MonitorBackendConfig = field(default_factory=MonitorBackendConfig)
     data_types: DataTypesConfig = field(default_factory=DataTypesConfig)
     checkpoint: CheckpointConfig = field(default_factory=CheckpointConfig)
+    data_efficiency: DataEfficiencyConfig = field(
+        default_factory=DataEfficiencyConfig)
 
     # ------------------------------------------------------------------
     @classmethod
@@ -289,6 +314,14 @@ class Config:
             if k in _TOP_LEVEL_IGNORED:
                 logger.info(f"config: ignoring section '{k}' (not applicable on TPU)")
                 d.pop(k)
+        # legacy v1 top-level curriculum section (reference config.py
+        # curriculum_params) folds into data_efficiency.data_sampling
+        legacy_cl = d.pop("curriculum_learning", None)
+        if legacy_cl and legacy_cl.get("enabled", False):
+            de = d.setdefault("data_efficiency", {})
+            de.setdefault("enabled", True)
+            ds_sec = de.setdefault("data_sampling", {})
+            ds_sec.setdefault("curriculum_learning", legacy_cl)
         sections = {
             "optimizer": OptimizerConfig,
             "scheduler": SchedulerConfig,
@@ -305,6 +338,7 @@ class Config:
             "wandb": MonitorBackendConfig,
             "data_types": DataTypesConfig,
             "checkpoint": CheckpointConfig,
+            "data_efficiency": DataEfficiencyConfig,
         }
         kwargs: dict[str, Any] = {}
         for key, sub_cls in sections.items():

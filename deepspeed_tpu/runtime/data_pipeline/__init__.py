@@ -1,0 +1,18 @@
+"""Data efficiency pipeline (reference deepspeed/runtime/data_pipeline/):
+curriculum learning scheduler, difficulty-based data sampler, Megatron-format
+mmap indexed dataset, and random-LTD token dropping.
+"""
+from .curriculum_scheduler import CurriculumScheduler  # noqa: F401
+from .data_sampler import (  # noqa: F401
+    CurriculumDataSampler,
+    DistributedBatchSampler,
+)
+from .indexed_dataset import (  # noqa: F401
+    MMapIndexedDataset,
+    MMapIndexedDatasetBuilder,
+)
+from .random_ltd import (  # noqa: F401
+    RandomLTDScheduler,
+    random_ltd_merge,
+    random_ltd_select,
+)

@@ -1,0 +1,93 @@
+"""Curriculum learning scheduler (reference
+runtime/data_pipeline/curriculum_scheduler.py:11 `CurriculumScheduler`).
+
+Maps the global step to a *difficulty* (canonically the sequence length).
+Schedule types match the reference config surface:
+
+- ``fixed_linear``:   min → max linearly over ``total_curriculum_step``
+- ``fixed_root``:     min → max along (step/total)^(1/root_degree)
+- ``fixed_discrete``: explicit ``difficulty`` / ``max_step`` breakpoints
+- ``custom``:         user callable ``step -> difficulty``
+
+Difficulties are quantized to ``difficulty_step`` multiples — on TPU this
+also bounds recompiles: each distinct difficulty is one static shape.
+"""
+from __future__ import annotations
+
+import math
+from typing import Callable
+
+from ...utils.logging import logger
+
+FIXED_LINEAR = "fixed_linear"
+FIXED_ROOT = "fixed_root"
+FIXED_DISCRETE = "fixed_discrete"
+CUSTOM = "custom"
+
+
+class CurriculumScheduler:
+    def __init__(self, config: dict):
+        self.state: dict = {}
+        self.custom_fn: Callable[[int], int] | None = None
+        cfg = dict(config)
+        self.curriculum_type = cfg.get("curriculum_type", "seqlen")
+        self.schedule_type = cfg.get("schedule_type", FIXED_LINEAR)
+        self.min_difficulty = int(cfg.get("min_difficulty", 8))
+        self.max_difficulty = int(cfg.get("max_difficulty", self.min_difficulty))
+        sched = dict(cfg.get("schedule_config", {}))
+
+        if self.schedule_type in (FIXED_LINEAR, FIXED_ROOT):
+            self.total_step = int(sched.get("total_curriculum_step", 1000))
+            self.difficulty_step = int(sched.get("difficulty_step", 8))
+            if self.difficulty_step % 8:
+                logger.warning(
+                    "curriculum difficulty_step not a multiple of 8 — tokens "
+                    "per step won't align to TPU-friendly tile sizes")
+            self.root_degree = int(sched.get("root_degree", 2)) \
+                if self.schedule_type == FIXED_ROOT else 1
+        elif self.schedule_type == FIXED_DISCRETE:
+            self.difficulties = [int(d) for d in sched["difficulty"]]
+            self.max_steps = [int(s) for s in sched["max_step"]]
+            if len(self.difficulties) != len(self.max_steps) + 1:
+                raise ValueError(
+                    "fixed_discrete needs len(difficulty) == len(max_step)+1 "
+                    f"(got {len(self.difficulties)} / {len(self.max_steps)})")
+        elif self.schedule_type == CUSTOM:
+            pass  # set_custom_get_difficulty must be called
+        else:
+            raise ValueError(f"unknown curriculum schedule '{self.schedule_type}'")
+        # custom schedules get their difficulty when the callable arrives
+        self.current_difficulty = (self.min_difficulty
+                                   if self.schedule_type == CUSTOM
+                                   else self.get_difficulty(0))
+
+    # ------------------------------------------------------------------
+    def set_custom_get_difficulty(self, fn: Callable[[int], int]) -> None:
+        self.custom_fn = fn
+        self.current_difficulty = self.get_difficulty(0)
+
+    def get_difficulty(self, global_step: int) -> int:
+        s = self.schedule_type
+        if s == CUSTOM:
+            if self.custom_fn is None:
+                raise ValueError("custom curriculum needs "
+                                 "set_custom_get_difficulty()")
+            return int(self.custom_fn(global_step))
+        if s == FIXED_DISCRETE:
+            for diff, max_step in zip(self.difficulties, self.max_steps):
+                if global_step <= max_step:
+                    return diff
+            return self.difficulties[-1]
+        frac = min(1.0, global_step / max(1, self.total_step))
+        if s == FIXED_ROOT:
+            frac = frac ** (1.0 / self.root_degree)
+        raw = self.min_difficulty + (self.max_difficulty - self.min_difficulty) * frac
+        quant = self.difficulty_step * math.floor(raw / self.difficulty_step)
+        return int(min(self.max_difficulty, max(self.min_difficulty, quant)))
+
+    def update_difficulty(self, global_step: int) -> int:
+        self.current_difficulty = self.get_difficulty(global_step)
+        return self.current_difficulty
+
+    def is_fully_ramped(self, global_step: int) -> bool:
+        return self.get_difficulty(global_step) >= self.max_difficulty

@@ -1,0 +1,108 @@
+"""Data samplers (reference
+runtime/data_pipeline/data_sampling/data_sampler.py:36 `DeepSpeedDataSampler`).
+
+``DistributedBatchSampler`` shards deterministic shuffled epochs across data-
+parallel ranks. ``CurriculumDataSampler`` adds difficulty-aware sampling: each
+sample carries a metric value (e.g. sequence length) and only samples whose
+metric is within the current curriculum difficulty are eligible — the
+cluster-by-difficulty scheme of the reference, with numpy doing the
+bucketing instead of the reference's on-disk metric index files.
+"""
+from __future__ import annotations
+
+from typing import Iterator, Sequence
+
+import numpy as np
+
+from .curriculum_scheduler import CurriculumScheduler
+
+
+class DistributedBatchSampler:
+    """Epoch-shuffled global batches, sliced per DP rank (reference
+    data_sampler.py rank slicing; torch DistributedSampler semantics)."""
+
+    def __init__(self, num_samples: int, global_batch_size: int,
+                 rank: int = 0, world_size: int = 1, shuffle: bool = True,
+                 seed: int = 42, drop_last: bool = True):
+        if global_batch_size % world_size:
+            raise ValueError(f"global batch {global_batch_size} not divisible "
+                             f"by world size {world_size}")
+        self.num_samples = int(num_samples)
+        self.global_batch_size = int(global_batch_size)
+        self.per_rank = self.global_batch_size // world_size
+        self.rank = rank
+        self.world_size = world_size
+        self.shuffle = shuffle
+        self.seed = seed
+        self.drop_last = drop_last
+        self.epoch = 0
+
+    def set_epoch(self, epoch: int) -> None:
+        self.epoch = int(epoch)
+
+    def __len__(self) -> int:
+        if self.drop_last:
+            return self.num_samples // self.global_batch_size
+        return (self.num_samples + self.global_batch_size - 1) // self.global_batch_size
+
+    def __iter__(self) -> Iterator[np.ndarray]:
+        order = np.arange(self.num_samples)
+        if self.shuffle:
+            np.random.default_rng(self.seed + self.epoch).shuffle(order)
+        n_full = self.num_samples // self.global_batch_size
+        for b in range(len(self)):
+            batch = order[b * self.global_batch_size:(b + 1) * self.global_batch_size]
+            if b >= n_full:  # last partial batch (drop_last=False): wrap pad
+                pad = self.global_batch_size - batch.size
+                # tile when the corpus is smaller than the pad
+                fill = np.tile(order, pad // order.size + 1)[:pad]
+                batch = np.concatenate([batch, fill])
+            yield batch[self.rank * self.per_rank:(self.rank + 1) * self.per_rank]
+
+
+class CurriculumDataSampler:
+    """Difficulty-gated sampling (reference DeepSpeedDataSampler): at each
+    step, draw the global batch from samples whose metric ≤ current
+    difficulty; the scheduler ramps difficulty with the global step."""
+
+    def __init__(self, metric_values: Sequence[float],
+                 curriculum: CurriculumScheduler,
+                 global_batch_size: int, rank: int = 0, world_size: int = 1,
+                 seed: int = 42):
+        self.metrics = np.asarray(metric_values)
+        if self.metrics.ndim != 1 or not self.metrics.size:
+            raise ValueError("metric_values must be a non-empty 1-D sequence")
+        self.curriculum = curriculum
+        self.global_batch_size = int(global_batch_size)
+        if self.global_batch_size % world_size:
+            raise ValueError("global batch not divisible by world size")
+        self.per_rank = self.global_batch_size // world_size
+        self.rank = rank
+        self.world_size = world_size
+        self.rng = np.random.default_rng(seed)
+        # ascending difficulty order; eligibility is then a prefix
+        self.order = np.argsort(self.metrics, kind="stable")
+        self.sorted_metrics = self.metrics[self.order]
+
+    def eligible_count(self, difficulty: float) -> int:
+        return int(np.searchsorted(self.sorted_metrics, difficulty, side="right"))
+
+    def sample_batch(self, global_step: int) -> np.ndarray:
+        """Indices for this rank's slice of the step's global batch."""
+        difficulty = self.curriculum.update_difficulty(global_step)
+        n = self.eligible_count(difficulty)
+        if n == 0:
+            # reference raises later; fail actionably here
+            raise ValueError(
+                f"no samples with difficulty <= {difficulty}; lower "
+                f"min_difficulty or check the metric (min metric "
+                f"{self.sorted_metrics[0]})")
+        picks = self.rng.integers(0, n, self.global_batch_size)
+        batch = self.order[picks]
+        return batch[self.rank * self.per_rank:(self.rank + 1) * self.per_rank]
+
+    def __iter__(self) -> Iterator[np.ndarray]:
+        step = 0
+        while True:
+            yield self.sample_batch(step)
+            step += 1

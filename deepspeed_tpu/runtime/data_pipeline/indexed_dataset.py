@@ -1,0 +1,125 @@
+"""Megatron-format mmap indexed dataset (reference
+runtime/data_pipeline/data_sampling/indexed_dataset.py `MMapIndexedDataset`).
+
+Binary layout is byte-compatible with the Megatron/DeepSpeed ``.idx``/``.bin``
+pair, so corpora tokenized by Megatron-LM tooling load directly:
+
+``.idx``: magic ``MMIDIDX\\x00\\x00`` | uint64 version=1 | uint8 dtype-code |
+uint64 n_sequences | uint64 n_docs | int32 sizes[n] | int64 pointers[n] |
+int64 doc_idx[n_docs]
+``.bin``: raw token array back-to-back.
+"""
+from __future__ import annotations
+
+import os
+import struct
+
+import numpy as np
+
+_HDR_MAGIC = b"MMIDIDX\x00\x00"
+
+_DTYPES = {1: np.uint8, 2: np.int8, 3: np.int16, 4: np.int32,
+           5: np.int64, 6: np.float32, 7: np.float64, 8: np.uint16}
+_CODES = {np.dtype(v): k for k, v in _DTYPES.items()}
+
+
+def data_file_path(prefix: str) -> str:
+    return prefix + ".bin"
+
+
+def index_file_path(prefix: str) -> str:
+    return prefix + ".idx"
+
+
+class MMapIndexedDatasetBuilder:
+    def __init__(self, prefix: str, dtype=np.int32):
+        self.prefix = prefix
+        self.dtype = np.dtype(dtype)
+        if self.dtype not in _CODES:
+            raise ValueError(f"unsupported dtype {dtype}")
+        self._bin = open(data_file_path(prefix), "wb")
+        self.sizes: list[int] = []
+        self.doc_idx: list[int] = [0]
+
+    def add_item(self, tokens: np.ndarray) -> None:
+        arr = np.ascontiguousarray(tokens, self.dtype)
+        self._bin.write(arr.tobytes())
+        self.sizes.append(arr.size)
+
+    def end_document(self) -> None:
+        self.doc_idx.append(len(self.sizes))
+
+    def merge_file(self, other_prefix: str) -> None:
+        other = MMapIndexedDataset(other_prefix)
+        offset = len(self.sizes)
+        for i in range(len(other)):
+            self.add_item(other[i])
+        self.doc_idx.extend(offset + d for d in other.doc_idx[1:])
+
+    def finalize(self) -> None:
+        self._bin.close()
+        sizes = np.asarray(self.sizes, np.int32)
+        pointers = np.zeros(len(sizes), np.int64)
+        if len(sizes):
+            np.cumsum(sizes[:-1] * self.dtype.itemsize, out=pointers[1:])
+        with open(index_file_path(self.prefix), "wb") as f:
+            f.write(_HDR_MAGIC)
+            f.write(struct.pack("<Q", 1))
+            f.write(struct.pack("<B", _CODES[self.dtype]))
+            f.write(struct.pack("<Q", len(sizes)))
+            f.write(struct.pack("<Q", len(self.doc_idx)))
+            f.write(sizes.tobytes(order="C"))
+            f.write(pointers.tobytes(order="C"))
+            f.write(np.asarray(self.doc_idx, np.int64).tobytes(order="C"))
+
+
+class MMapIndexedDataset:
+    def __init__(self, prefix: str):
+        idx_path = index_file_path(prefix)
+        with open(idx_path, "rb") as f:
+            magic = f.read(9)
+            if magic != _HDR_MAGIC:
+                raise ValueError(f"{idx_path}: bad magic {magic!r} (not an "
+                                 f"MMapIndexedDataset index)")
+            version, = struct.unpack("<Q", f.read(8))
+            if version != 1:
+                raise ValueError(f"{idx_path}: unsupported version {version}")
+            code, = struct.unpack("<B", f.read(1))
+            self.dtype = np.dtype(_DTYPES[code])
+            n, = struct.unpack("<Q", f.read(8))
+            n_docs, = struct.unpack("<Q", f.read(8))
+            header_end = f.tell()
+        idx = np.memmap(idx_path, mode="r", dtype=np.uint8)
+        off = header_end
+        self.sizes = idx[off:off + 4 * n].view(np.int32)
+        off += 4 * n
+        self.pointers = idx[off:off + 8 * n].view(np.int64)
+        off += 8 * n
+        self.doc_idx = idx[off:off + 8 * n_docs].view(np.int64)
+        self._data = np.memmap(data_file_path(prefix), mode="r",
+                               dtype=self.dtype)
+        self._prefix = prefix
+
+    def __len__(self) -> int:
+        return len(self.sizes)
+
+    def __getitem__(self, i) -> np.ndarray:
+        if isinstance(i, slice):
+            return [self[j] for j in range(*i.indices(len(self)))]
+        if i < 0:
+            i += len(self)
+        start = self.pointers[i] // self.dtype.itemsize
+        return self._data[start:start + self.sizes[i]]
+
+    def get(self, i: int, offset: int = 0, length: int | None = None) -> np.ndarray:
+        seq = self[i]
+        return seq[offset:None if length is None else offset + length]
+
+    @property
+    def supports_prefetch(self) -> bool:
+        return False  # mmap is already lazy
+
+    @staticmethod
+    def exists(prefix: str) -> bool:
+        return (os.path.exists(index_file_path(prefix))
+                and os.path.exists(data_file_path(prefix)))

@@ -1,0 +1,75 @@
+"""Random layerwise token dropping (random-LTD) — reference
+runtime/data_pipeline/data_routing/{scheduler.py:38,basic_layer.py} and
+csrc/random_ltd/ gather/scatter kernels.
+
+A middle band of transformer layers runs on a random token subset; the kept
+count ramps from ``min_value`` to the full sequence over training. On TPU
+the gather/scatter are plain XLA ops (the reference's CUDA kernels exist to
+make them fast — XLA already fuses them), and the kept count is a *static*
+shape per compile: the scheduler quantizes the ramp so training sees a
+bounded number of recompiles.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from .curriculum_scheduler import CurriculumScheduler
+
+
+class RandomLTDScheduler:
+    """Kept-token schedule (reference data_routing/scheduler.py:38):
+    fixed_linear ramp from min_value to max_value over total_steps, in
+    difficulty_step increments. The ramp itself is a CurriculumScheduler
+    over the kept-token count."""
+
+    def __init__(self, config: dict):
+        cfg = dict(config)
+        self.min_value = int(cfg.get("min_value", 128))
+        self.max_value = int(cfg.get("max_value", 512))
+        sched = dict(cfg.get("schedule_config", {}))
+        total_steps = int(sched.get("total_layer_compute_step",
+                                    cfg.get("total_steps", 1000)))
+        self.schedule_type = cfg.get("schedule_type", "fixed_linear")
+        if self.schedule_type != "fixed_linear":
+            raise ValueError("random_ltd supports fixed_linear schedules")
+        self._ramp = CurriculumScheduler({
+            "curriculum_type": "random_ltd_tokens",
+            "min_difficulty": self.min_value,
+            "max_difficulty": self.max_value,
+            "schedule_type": "fixed_linear",
+            "schedule_config": {
+                "total_curriculum_step": total_steps,
+                "difficulty_step": int(sched.get("difficulty_step", 16))}})
+        # which layers drop tokens (reference random_ltd_layer_id)
+        self.layer_ids = cfg.get("random_ltd_layer_id", None)
+
+    def get_seq_len(self, global_step: int) -> int:
+        return self._ramp.get_difficulty(global_step)
+
+    def applies_to(self, layer_idx: int) -> bool:
+        return self.layer_ids is None or layer_idx in self.layer_ids
+
+
+def random_ltd_select(hidden: jax.Array, keep: int, rng: jax.Array
+                      ) -> tuple[jax.Array, jax.Array]:
+    """Pick ``keep`` random token positions per batch row (sorted, so causal
+    order survives) and gather them: [B, S, H] → ([B, keep, H], idx [B, keep]).
+    ``keep`` must be static under jit (the scheduler guarantees it).
+    (reference csrc/random_ltd token_sort_/gather kernels)"""
+    B, S = hidden.shape[0], hidden.shape[1]
+    if not 0 < keep <= S:
+        raise ValueError(f"keep={keep} out of range for seq {S}")
+    noise = jax.random.uniform(rng, (B, S))
+    idx = jnp.sort(jnp.argsort(noise, axis=1)[:, :keep], axis=1)
+    return jnp.take_along_axis(hidden, idx[..., None], axis=1), idx
+
+
+def random_ltd_merge(full: jax.Array, selected: jax.Array,
+                     idx: jax.Array) -> jax.Array:
+    """Scatter processed tokens back into the full sequence; untouched
+    positions keep their input activations (reference basic_layer.py
+    residual-passthrough semantics)."""
+    B = full.shape[0]
+    bidx = jnp.arange(B)[:, None]
+    return full.at[bidx, idx].set(selected)

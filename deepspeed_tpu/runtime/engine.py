@@ -157,6 +157,34 @@ class DeepSpeedEngine:
 
             self.flops_profiler = FlopsProfiler(config.flops_profiler)
 
+        # data efficiency: curriculum learning + random-LTD (reference
+        # runtime/data_pipeline/; engine curriculum hook engine.py:1913)
+        self.curriculum_scheduler = None
+        self.random_ltd_scheduler = None
+        de = config.data_efficiency
+        if de.enabled:
+            cl = de.curriculum_config()
+            if cl is not None:
+                from .data_pipeline import CurriculumScheduler
+
+                self.curriculum_scheduler = CurriculumScheduler(cl)
+                if self.curriculum_scheduler.curriculum_type != "seqlen":
+                    logger.warning(
+                        "engine only auto-applies 'seqlen' curricula to "
+                        "batches; use CurriculumDataSampler for metric "
+                        f"'{self.curriculum_scheduler.curriculum_type}'")
+            rl = de.random_ltd_config()
+            if rl is not None:
+                from .data_pipeline import RandomLTDScheduler
+
+                self.random_ltd_scheduler = RandomLTDScheduler(rl)
+                logger.warning(
+                    "random_ltd: scheduler active, but the engine does not "
+                    "auto-convert model layers — call random_ltd_select/"
+                    "random_ltd_merge in your blocks with "
+                    "engine.random_ltd_scheduler.get_seq_len(step) "
+                    "(the reference likewise requires convert_to_random_ltd)")
+
         # host-offloaded optimizer (ZeRO-Offload/-Infinity; reference
         # stage_1_and_2.py:1190 CPU path + swap_tensor/)
         self._offload_opt = None
@@ -468,6 +496,33 @@ class DeepSpeedEngine:
 
         return jax.tree.map(put, batch)
 
+    def _apply_curriculum(self, batch: dict) -> dict:
+        """Seqlen curriculum: truncate [B, S] leaves to the current
+        difficulty (reference engine.py:1913 curriculum seqlen path). The
+        scheduler quantizes difficulties, so recompiles stay bounded."""
+        cs = self.curriculum_scheduler
+        if cs is None or cs.curriculum_type != "seqlen":
+            return batch
+        seqlen = cs.update_difficulty(self.global_steps)
+        # the sequence length is input_ids' second dim; only axes of exactly
+        # that size are sequence axes (leaves like [B, S, S] masks truncate
+        # on both, label-score leaves [B, K] stay intact)
+        leaves = batch.get("input_ids") if isinstance(batch, dict) else None
+        full_len = leaves.shape[1] if hasattr(leaves, "shape") else max(
+            (x.shape[1] for x in jax.tree.leaves(batch)
+             if hasattr(x, "ndim") and x.ndim >= 2), default=0)
+        if full_len <= seqlen:
+            return batch
+
+        def trunc(x):
+            if not hasattr(x, "ndim") or x.ndim < 2:
+                return x
+            sl = tuple(slice(None) if d == 0 or x.shape[d] != full_len
+                       else slice(seqlen) for d in range(x.ndim))
+            return x[sl]
+
+        return jax.tree.map(trunc, batch)
+
     def _reshape_for_gas(self, batch: dict) -> dict:
         gas = self.config.gradient_accumulation_steps
 
@@ -487,6 +542,7 @@ class DeepSpeedEngine:
         (shape [train_batch_size, ...] per leaf)."""
         self.tput_timer.start()
         self.timers(TRAIN_BATCH_TIMER).start()
+        batch = self._apply_curriculum(batch)
         batch = self._shard_batch(self._reshape_for_gas(batch), with_gas_dim=True)
         profile_target = self._train_step if self._offload_opt is None \
             else self._offload_gas_grads

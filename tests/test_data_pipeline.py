@@ -1,0 +1,191 @@
+"""Data efficiency tests (reference tests/unit/runtime/test_data_efficiency.py,
+tests/unit/runtime/test_data.py analogues)."""
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import deepspeed_tpu as ds
+from deepspeed_tpu.models import build_model
+from deepspeed_tpu.runtime.data_pipeline import (CurriculumDataSampler,
+                                                 CurriculumScheduler,
+                                                 DistributedBatchSampler,
+                                                 MMapIndexedDataset,
+                                                 MMapIndexedDatasetBuilder,
+                                                 RandomLTDScheduler,
+                                                 random_ltd_merge,
+                                                 random_ltd_select)
+
+
+# -- curriculum scheduler ---------------------------------------------------
+def test_fixed_linear_schedule():
+    cs = CurriculumScheduler({"curriculum_type": "seqlen",
+                              "min_difficulty": 8, "max_difficulty": 64,
+                              "schedule_type": "fixed_linear",
+                              "schedule_config": {"total_curriculum_step": 100,
+                                                  "difficulty_step": 8}})
+    assert cs.get_difficulty(0) == 8
+    assert cs.get_difficulty(50) == 8 + (64 - 8) // 2 // 8 * 8  # quantized midpoint
+    assert cs.get_difficulty(100) == 64
+    assert cs.get_difficulty(10_000) == 64
+    # quantization: every value is a multiple of 8
+    assert all(cs.get_difficulty(s) % 8 == 0 for s in range(0, 120, 7))
+    assert cs.is_fully_ramped(100) and not cs.is_fully_ramped(10)
+
+
+def test_fixed_root_faster_early():
+    lin = CurriculumScheduler({"min_difficulty": 8, "max_difficulty": 512,
+                               "schedule_type": "fixed_linear",
+                               "schedule_config": {"total_curriculum_step": 1000,
+                                                   "difficulty_step": 8}})
+    root = CurriculumScheduler({"min_difficulty": 8, "max_difficulty": 512,
+                                "schedule_type": "fixed_root",
+                                "schedule_config": {"total_curriculum_step": 1000,
+                                                    "difficulty_step": 8,
+                                                    "root_degree": 2}})
+    assert root.get_difficulty(100) > lin.get_difficulty(100)
+    assert root.get_difficulty(1000) == lin.get_difficulty(1000) == 512
+
+
+def test_fixed_discrete_and_custom():
+    cs = CurriculumScheduler({"schedule_type": "fixed_discrete",
+                              "min_difficulty": 8, "max_difficulty": 64,
+                              "schedule_config": {"difficulty": [8, 32, 64],
+                                                  "max_step": [10, 20]}})
+    assert [cs.get_difficulty(s) for s in (0, 10, 11, 20, 21, 99)] == \
+        [8, 8, 32, 32, 64, 64]
+    cc = CurriculumScheduler({"schedule_type": "custom"})
+    cc.set_custom_get_difficulty(lambda s: 16 + s)
+    assert cc.get_difficulty(4) == 20
+
+
+# -- samplers ---------------------------------------------------------------
+def test_distributed_batch_sampler_partitions():
+    ranks = [list(DistributedBatchSampler(100, 8, rank=r, world_size=4,
+                                          seed=7)) for r in range(4)]
+    assert len(ranks[0]) == 12  # 100 // 8
+    for step in range(12):
+        allv = np.concatenate([ranks[r][step] for r in range(4)])
+        assert allv.size == 8 and np.unique(allv).size == 8
+    # different epoch → different order
+    s = DistributedBatchSampler(100, 8, rank=0, world_size=1, seed=7)
+    e0 = list(s)
+    s.set_epoch(1)
+    assert not all(np.array_equal(a, b) for a, b in zip(e0, list(s)))
+
+
+def test_curriculum_sampler_respects_difficulty():
+    lengths = np.arange(1, 101)  # sample i has difficulty i+1
+    cs = CurriculumScheduler({"min_difficulty": 10, "max_difficulty": 100,
+                              "schedule_type": "fixed_linear",
+                              "schedule_config": {"total_curriculum_step": 50,
+                                                  "difficulty_step": 10}})
+    samp = CurriculumDataSampler(lengths, cs, global_batch_size=16)
+    early = samp.sample_batch(0)
+    assert np.all(lengths[early] <= 10)
+    late = samp.sample_batch(500)
+    assert np.max(lengths[late]) > 10  # whole corpus eligible
+
+
+# -- indexed dataset --------------------------------------------------------
+def test_mmap_indexed_dataset_roundtrip(tmp_path):
+    prefix = str(tmp_path / "corpus")
+    b = MMapIndexedDatasetBuilder(prefix, dtype=np.uint16)
+    docs = [[1, 2, 3], [40000, 5], [7, 8, 9, 10]]
+    for d in docs[:2]:
+        b.add_item(np.array(d))
+    b.end_document()
+    b.add_item(np.array(docs[2]))
+    b.end_document()
+    b.finalize()
+
+    ds_ = MMapIndexedDataset(prefix)
+    assert len(ds_) == 3
+    for i, d in enumerate(docs):
+        np.testing.assert_array_equal(ds_[i], d)
+    np.testing.assert_array_equal(ds_.get(2, offset=1, length=2), [8, 9])
+    np.testing.assert_array_equal(ds_.doc_idx, [0, 2, 3])
+    assert MMapIndexedDataset.exists(prefix)
+    assert ds_.dtype == np.uint16
+
+
+def test_mmap_rejects_garbage(tmp_path):
+    p = tmp_path / "bad.idx"
+    p.write_bytes(b"NOTANINDEX" * 3)
+    with pytest.raises(ValueError, match="magic"):
+        MMapIndexedDataset(str(tmp_path / "bad"))
+
+
+# -- random-LTD -------------------------------------------------------------
+def test_random_ltd_schedule_and_gather():
+    sched = RandomLTDScheduler({"min_value": 16, "max_value": 64,
+                                "schedule_config": {
+                                    "total_layer_compute_step": 100,
+                                    "difficulty_step": 16}})
+    assert sched.get_seq_len(0) == 16
+    assert sched.get_seq_len(100) == 64
+    x = jnp.arange(2 * 64 * 4, dtype=jnp.float32).reshape(2, 64, 4)
+    keep = sched.get_seq_len(50)
+    sel, idx = random_ltd_select(x, keep, jax.random.PRNGKey(0))
+    assert sel.shape == (2, keep, 4)
+    # gathered tokens match their source positions, order preserved
+    assert np.all(np.diff(np.asarray(idx), axis=1) > 0)
+    np.testing.assert_array_equal(
+        np.asarray(sel[0]), np.asarray(x[0])[np.asarray(idx[0])])
+    merged = random_ltd_merge(x, sel * 2, idx)
+    np.testing.assert_array_equal(
+        np.asarray(merged[0][np.asarray(idx[0])]), np.asarray(sel[0] * 2))
+    untouched = np.setdiff1d(np.arange(64), np.asarray(idx[0]))
+    np.testing.assert_array_equal(np.asarray(merged[0][untouched]),
+                                  np.asarray(x[0][untouched]))
+
+
+def test_random_ltd_select_jittable():
+    f = jax.jit(random_ltd_select, static_argnums=1)
+    sel, idx = f(jnp.ones((1, 32, 8)), 16, jax.random.PRNGKey(1))
+    assert sel.shape == (1, 16, 8)
+
+
+# -- engine integration -----------------------------------------------------
+def test_engine_seqlen_curriculum(tmp_path):
+    engine, *_ = ds.initialize(
+        model=build_model("tiny-gpt2"),
+        config={
+            "train_micro_batch_size_per_gpu": 2,
+            "optimizer": {"type": "AdamW", "params": {"lr": 1e-3}},
+            "zero_optimization": {"stage": 0},
+            "data_efficiency": {
+                "enabled": True,
+                "data_sampling": {
+                    "enabled": True,
+                    "curriculum_learning": {
+                        "enabled": True, "curriculum_type": "seqlen",
+                        "min_difficulty": 16, "max_difficulty": 32,
+                        "schedule_type": "fixed_linear",
+                        "schedule_config": {"total_curriculum_step": 4,
+                                            "difficulty_step": 16}}}},
+        })
+    assert engine.curriculum_scheduler is not None
+    rng = np.random.default_rng(0)
+    gbs = engine.config.train_batch_size
+    batch = {"input_ids": rng.integers(0, 256, (gbs, 32)),
+             "labels": rng.integers(0, 256, (gbs, 32))}
+    for _ in range(5):
+        loss = engine.train_batch(batch)
+    assert np.isfinite(float(loss))
+    assert engine.curriculum_scheduler.current_difficulty == 32
+
+
+def test_legacy_curriculum_section_maps():
+    from deepspeed_tpu.config import Config
+
+    cfg = Config.load({
+        "train_micro_batch_size_per_gpu": 1,
+        "curriculum_learning": {"enabled": True, "curriculum_type": "seqlen",
+                                "min_difficulty": 8, "max_difficulty": 16,
+                                "schedule_type": "fixed_linear",
+                                "schedule_config": {"total_curriculum_step": 10,
+                                                    "difficulty_step": 8}},
+    })
+    assert cfg.data_efficiency.enabled
+    assert cfg.data_efficiency.curriculum_config()["min_difficulty"] == 8
