@@ -36,6 +36,7 @@ class OptState(NamedTuple):
     step: jax.Array           # int32 scalar
     mu: Pytree | None         # first moment / momentum
     nu: Pytree | None         # second moment
+    error: Pytree | None = None  # 1-bit compression error feedback (onebit.py)
 
 
 def _zeros_like(params: Pytree, dtype=None) -> Pytree:
@@ -246,14 +247,24 @@ def build_optimizer(type_name: str, params: dict[str, Any]) -> Optimizer:
     lr = p.pop("lr", 1e-3)
     wd = p.pop("weight_decay", 0.0)
     eps = p.pop("eps", None)
-    # 1-bit/zero-one variants fall back to their dense counterparts; the
-    # compressed-allreduce path is a comm-layer feature on TPU (quantized
-    # collectives), not an optimizer variant. Drop their comm-only knobs.
-    for k in ("freeze_step", "cuda_aware", "comm_backend_name", "var_freeze_step",
-              "var_update_scaler", "local_step_scaler", "local_step_clipper"):
-        p.pop(k, None)
+    if name.replace("_", "") in ("onebitadam", "onebitlamb", "zerooneadam"):
+        # true 1-bit family: compressed-momentum comm happens inside the
+        # engine's shard_map train step (runtime/onebit.py); the classes
+        # also act as exact dense Adam/LAMB wherever compression is off
+        from ..runtime.onebit import build_onebit_optimizer
 
-    if name in ("adam", "adamw", "fusedadam", "onebitadam", "zerooneadam"):
+        kw = dict(params)
+        kw.setdefault("lr", lr)
+        kw.setdefault("weight_decay", wd)
+        if betas:
+            kw["betas"] = betas
+        if eps is not None:
+            kw["eps"] = eps
+        if adam_w_mode is not None:
+            kw["adamw_mode"] = bool(adam_w_mode)
+        return build_onebit_optimizer(name, kw)
+
+    if name in ("adam", "adamw", "fusedadam"):
         mode = adam_w_mode if adam_w_mode is not None else (name != "adam")
         kw: dict[str, Any] = dict(lr=lr, weight_decay=wd, adamw_mode=bool(mode))
         if betas:
@@ -268,7 +279,7 @@ def build_optimizer(type_name: str, params: dict[str, Any]) -> Optimizer:
             kw["betas"] = betas
         kw.update(p)
         return Lion(**kw)
-    if name in ("lamb", "fusedlamb", "onebitlamb"):
+    if name in ("lamb", "fusedlamb"):
         kw = dict(lr=lr, weight_decay=wd)
         if betas:
             kw["betas"] = betas

@@ -68,6 +68,7 @@ def save_checkpoint(engine, save_dir: str, tag: str | None = None,
         "master": state.master,
         "opt_mu": state.opt_state.mu,
         "opt_nu": state.opt_state.nu,
+        "opt_error": state.opt_state.error,
         "opt_step": state.opt_state.step,
         "global_step": state.global_step,
         "scaler": None if state.scaler is None else {
@@ -131,6 +132,7 @@ def load_checkpoint(engine, load_dir: str, tag: str | None = None) -> dict:
         "master": state.master,
         "opt_mu": state.opt_state.mu,
         "opt_nu": state.opt_state.nu,
+        "opt_error": state.opt_state.error,
         "opt_step": state.opt_state.step,
         "global_step": state.global_step,
         "scaler": None if state.scaler is None else {
@@ -140,12 +142,42 @@ def load_checkpoint(engine, load_dir: str, tag: str | None = None) -> dict:
         },
     }
     target = {k: v for k, v in target.items() if v is not None}
+    ckptr = ocp.PyTreeCheckpointer()
+    try:
+        saved = set(ckptr.metadata(os.path.join(path, "state"))
+                    .item_metadata.tree.keys())
+    except Exception:
+        saved = set(target)
+    # Missing-entry policy: opt_error (1-bit feedback) may restore to its
+    # init value — resuming compressed training from a dense checkpoint is
+    # legitimate, and error buffers also reset when the DP size changed.
+    # A missing master is derived from the restored params (fp32 run saved
+    # none). Anything else missing is a real mismatch: fail loudly rather
+    # than silently training from init values.
+    missing = {}
+    for k in list(target):
+        if k in saved:
+            continue
+        if k == "opt_error":
+            logger.warning(f"checkpoint {path} has no opt_error; the 1-bit "
+                           f"error-feedback buffer restarts from zero")
+            missing[k] = jax.jit(
+                lambda t: jax.tree.map(jnp.zeros_like, t),
+                out_shardings=shardings.opt_state.error)(target.pop(k))
+        elif k == "master":
+            target.pop(k)  # derived from params below
+        else:
+            raise ValueError(
+                f"checkpoint {path} is missing '{k}' which the current "
+                f"engine configuration requires (saved keys: {sorted(saved)})")
+    derive_master = "master" not in target and state.master is not None
     repl = jax.sharding.NamedSharding(engine.topology.mesh, jax.sharding.PartitionSpec())
     sharding_tree = {
         "params": shardings.params,
         "master": shardings.master,
         "opt_mu": shardings.opt_state.mu,
         "opt_nu": shardings.opt_state.nu,
+        "opt_error": shardings.opt_state.error,
         "opt_step": repl,
         "global_step": repl,
         "scaler": None if state.scaler is None else {
@@ -158,9 +190,35 @@ def load_checkpoint(engine, load_dir: str, tag: str | None = None) -> dict:
 
     restore_args = jax.tree.map(mk_args, target, sharding_tree)
 
-    ckptr = ocp.PyTreeCheckpointer()
-    restored = ckptr.restore(os.path.join(path, "state"), item=target,
-                             restore_args=restore_args)
+    try:
+        restored = ckptr.restore(os.path.join(path, "state"), item=target,
+                                 restore_args=restore_args)
+    except Exception as e:
+        # per-DP-member error buffers change shape with the DP size; ONLY a
+        # failure that names opt_error resets them — anything else is a real
+        # restore failure and must propagate
+        if "opt_error" not in target or "opt_error" not in str(e):
+            raise
+        logger.warning(f"opt_error restore failed ({e}); resetting the 1-bit "
+                       f"error-feedback buffer (DP size likely changed)")
+        missing["opt_error"] = jax.jit(
+            lambda t: jax.tree.map(jnp.zeros_like, t),
+            out_shardings=shardings.opt_state.error)(target.pop("opt_error"))
+        restore_args.pop("opt_error", None)
+        restored = ckptr.restore(os.path.join(path, "state"), item=target,
+                                 restore_args=restore_args)
+    restored.update(missing)  # zeros for the allowed-absent entries
+    if derive_master:
+        # restore the checkpoint's fp32 params a second time directly into
+        # the master layout — exact, unlike upcasting the bf16-rounded params
+        m = ckptr.restore(
+            os.path.join(path, "state"),
+            item={"params": state.master},
+            restore_args={"params": jax.tree.map(
+                lambda x, s: ocp.ArrayRestoreArgs(
+                    sharding=s, global_shape=x.shape, dtype=jnp.float32),
+                state.master, shardings.master)})
+        restored["master"] = m["params"]
 
     from ..ops.optimizers import OptState
     from .engine import TrainState
@@ -175,7 +233,8 @@ def load_checkpoint(engine, load_dir: str, tag: str | None = None) -> dict:
         params=restored["params"],
         master=restored.get("master"),
         opt_state=OptState(step=restored["opt_step"], mu=restored.get("opt_mu"),
-                           nu=restored.get("opt_nu")),
+                           nu=restored.get("opt_nu"),
+                           error=restored.get("opt_error")),
         scaler=scaler,
         global_step=restored["global_step"],
     )

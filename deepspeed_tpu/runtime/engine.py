@@ -285,8 +285,9 @@ class DeepSpeedEngine:
             )
             return
 
-        opt0 = jax.jit(self.optimizer.init,
-                       out_shardings=self._opt_shardings_for(master_shardings))(master0)
+        opt_sh = self._opt_shardings_for(master_shardings)
+        opt0 = jax.jit(self.optimizer.init, out_shardings=opt_sh)(master0)
+        opt0, opt_sh = self._fixup_onebit_error(opt0, opt_sh)
 
         if self.mixed_precision:
             params0 = jax.jit(lambda m: _cast_tree(m, self.compute_dtype),
@@ -302,11 +303,35 @@ class DeepSpeedEngine:
         self._state_shardings = TrainState(
             params=param_shardings,
             master=master_shardings if master is not None else None,
-            opt_state=self._opt_shardings_for(master_shardings),
+            opt_state=opt_sh,
             scaler=None if scaler is None else jax.tree.map(
                 lambda _: NamedSharding(topo.mesh, P()), scaler),
             global_step=NamedSharding(topo.mesh, P()),
         )
+
+    def _fixup_onebit_error(self, opt0, opt_shardings):
+        """1-bit error feedback is per-DP-member state. When the compressed
+        path is active, restack it with a leading DP dim sharded over the DP
+        axes (so checkpoints carry every member's error); when a 1-bit
+        optimizer runs in its dense fallback, drop the buffer entirely — it
+        would be a params-sized dead weight in HBM and checkpoints."""
+        from .onebit import OneBitAdam
+
+        if not isinstance(self.optimizer, OneBitAdam) or opt0.error is None:
+            return opt0, opt_shardings
+        topo = self.topology
+        if not self._use_onebit_comm():
+            return (opt0._replace(error=None),
+                    opt_shardings._replace(error=None))
+        dp_axes = tuple(a for a in BATCH_AXES if topo.size(a) > 1)
+        dp = topo.dp_world_size
+        err_sh = jax.tree.map(
+            lambda _: NamedSharding(topo.mesh, P(dp_axes)), opt0.error)
+        err0 = jax.jit(
+            lambda t: jax.tree.map(
+                lambda e: jnp.zeros((dp,) + e.shape, jnp.float32), t),
+            out_shardings=err_sh)(opt0.error)
+        return opt0._replace(error=err0), opt_shardings._replace(error=err_sh)
 
     def _opt_shardings_for(self, master_shardings):
         # OptState moments mirror master shardings; absent moments stay None.
@@ -316,6 +341,7 @@ class DeepSpeedEngine:
             step=repl,
             mu=None if probe.mu is None else master_shardings,
             nu=None if probe.nu is None else master_shardings,
+            error=None if probe.error is None else master_shardings,
         )
 
     # ------------------------------------------------------------------
@@ -384,22 +410,31 @@ class DeepSpeedEngine:
         ss = self._state_shardings
         repl = NamedSharding(topo.mesh, P())
 
-        def gas_grads(state: TrainState, batch: dict):
-            """Scan over GAS microbatches with fp32 grad accumulation
-            (reference engine.py:1838/:1977 forward/backward loop)."""
-            def micro(carry, mb):
-                loss_sum, grad_acc = carry
-                loss, grads = self._compute_grads(state, mb)
-                grad_acc = jax.tree.map(jnp.add, grad_acc, grads)
-                return (loss_sum + loss, grad_acc), None
+        def make_gas_grads(compute, constrain: bool):
+            """GAS scan factory: fp32 grad accumulation over microbatches
+            (reference engine.py:1838/:1977 forward/backward loop).
+            ``compute(state, mb) -> (loss, grads)``; constrain=False inside
+            shard_map regions where sharding constraints are illegal."""
+            def gas_grads(state: TrainState, batch: dict):
+                def micro(carry, mb):
+                    loss_sum, grad_acc = carry
+                    loss, grads = compute(state, mb)
+                    grad_acc = jax.tree.map(jnp.add, grad_acc, grads)
+                    return (loss_sum + loss, grad_acc), None
 
-            zero_grads = jax.tree.map(
-                lambda p: jnp.zeros(p.shape, jnp.float32), state.params)
-            zero_grads = jax.lax.with_sharding_constraint(zero_grads, self.plan.grad_shardings)
-            (loss_sum, grads), _ = jax.lax.scan(
-                micro, (jnp.zeros((), jnp.float32), zero_grads), batch)
-            grads = jax.tree.map(lambda g: g / gas, grads)
-            return loss_sum / gas, grads
+                zero_grads = jax.tree.map(
+                    lambda p: jnp.zeros(p.shape, jnp.float32), state.params)
+                if constrain:
+                    zero_grads = jax.lax.with_sharding_constraint(
+                        zero_grads, self.plan.grad_shardings)
+                (loss_sum, grads), _ = jax.lax.scan(
+                    micro, (jnp.zeros((), jnp.float32), zero_grads), batch)
+                grads = jax.tree.map(lambda g: g / gas, grads)
+                return loss_sum / gas, grads
+
+            return gas_grads
+
+        gas_grads = make_gas_grads(self._compute_grads, constrain=True)
 
         def eval_step(state: TrainState, batch: dict):
             return self._loss_with_rules(state.params, batch)
@@ -440,6 +475,16 @@ class DeepSpeedEngine:
             self._apply_step = None
             return
 
+        def apply_step(state: TrainState, grads: Pytree, scale: jax.Array):
+            grads = jax.tree.map(lambda g: g * scale, grads)
+            return self._apply_grads(state, grads)
+
+        self._apply_step = jax.jit(apply_step, out_shardings=ss, donate_argnums=(0,))
+
+        if self._use_onebit_comm():
+            self._build_onebit_programs(repl, make_gas_grads)
+            return
+
         def train_step(state: TrainState, batch: dict):
             """Full global-batch step: GAS scan then one update — the
             compiled analogue of forward/backward/step (reference
@@ -454,11 +499,110 @@ class DeepSpeedEngine:
             donate_argnums=(0,),
         )
 
-        def apply_step(state: TrainState, grads: Pytree, scale: jax.Array):
-            grads = jax.tree.map(lambda g: g * scale, grads)
-            return self._apply_grads(state, grads)
+    def _use_onebit_comm(self) -> bool:
+        """1-bit compressed gradient comm applies when the optimizer is a
+        1-bit variant AND the layout allows per-device local grads: pure
+        data parallelism (replicated params = ZeRO stage 0), >1 DP member,
+        no host offload, no fp16 scaler (reference onebit optimizers are
+        likewise DP-comm features; runtime/fp16/onebit/adam.py:14)."""
+        from .onebit import OneBitAdam
 
-        self._apply_step = jax.jit(apply_step, out_shardings=ss, donate_argnums=(0,))
+        if not isinstance(self.optimizer, OneBitAdam):
+            return False
+        # 'expert' excluded too: MoE params shard over the expert axis, which
+        # breaks the replicated-params assumption of the compressed step
+        ok = (self.topology.dp_world_size > 1
+              and self.config.zero_optimization.stage == 0
+              and self._offload_opt is None
+              and not self.fp16_enabled
+              and all(self.topology.size(a) <= 1
+                      for a in ("tensor", "seq", "pipe", "expert")))
+        if not ok and not getattr(self, "_onebit_warned", False):
+            self._onebit_warned = True
+            logger.warning(
+                "1-bit optimizer configured but the layout doesn't support "
+                "compressed comm (needs ZeRO stage 0, dp>1, bf16/fp32, no "
+                "offload, no tp/sp/pp/ep) — running its exact dense update")
+        return ok
+
+    def _build_onebit_programs(self, repl, make_gas_grads):
+        """Train step with per-device local grads (shard_map over the DP
+        axes) feeding the 1-bit optimizer's compressed momentum averaging
+        (runtime/onebit.py). Warmup steps inside are exact dense Adam via
+        psum, so the program is one compile for both phases. The error-
+        feedback buffers are genuinely per-device state: they carry a
+        leading DP dimension sharded over the DP axes, so checkpoints
+        save/restore every member's compensation error (the imperative
+        forward/backward/step path stays dense, like the reference's
+        warmup regime)."""
+        from jax import shard_map
+
+        cfg = self.config
+        topo = self.topology
+        dp_axes = tuple(a for a in BATCH_AXES if topo.size(a) > 1)
+        if cfg.gradient_clipping:
+            logger.warning("gradient_clipping is ignored on the 1-bit "
+                           "compressed path (error feedback and clipping "
+                           "don't compose; the reference behaves the same)")
+        # logical-axis constraints on manual (shard_map) axes are illegal;
+        # drop rules that map onto the DP axes
+        safe_rules = [(name, ax) for name, ax in self._rules
+                      if not (isinstance(ax, str) and ax in dp_axes)
+                      and not (isinstance(ax, (tuple, list))
+                               and any(a in dp_axes for a in ax))]
+
+        def local_loss(p, mb):
+            with nn.logical_axis_rules(safe_rules):
+                return self._raw_loss_fn(p, mb)
+
+        def local_compute(state, mb):
+            loss, grads = jax.value_and_grad(
+                lambda p: local_loss(p, mb))(state.params)
+            return loss, _cast_tree(grads, jnp.float32)
+
+        gas_local = make_gas_grads(local_compute, constrain=False)
+
+        def inner(state: TrainState, batch: dict):
+            master = state.master if state.master is not None else state.params
+            loss_local, local_grads = gas_local(state, batch)
+            lr = self.lr_schedule(state.opt_state.step)
+            # error arrives [1, ...] (this member's slice of the stacked
+            # per-device buffer)
+            opt_in = state.opt_state._replace(
+                error=jax.tree.map(lambda e: e[0], state.opt_state.error))
+            new_master, new_opt = self.optimizer.local_update(
+                local_grads, opt_in, master, dp_axes, lr=lr)
+            new_opt = new_opt._replace(
+                error=jax.tree.map(lambda e: e[None], new_opt.error))
+            if self.mixed_precision:
+                new_params = _cast_tree(new_master, self.compute_dtype)
+                master_out = new_master
+            else:
+                new_params, master_out = new_master, None
+            loss = jax.lax.pmean(loss_local, dp_axes)
+            new_state = TrainState(params=new_params, master=master_out,
+                                   opt_state=new_opt, scaler=None,
+                                   global_step=state.global_step + 1)
+            return new_state, loss
+
+        state_spec = jax.tree.map(lambda _: P(), self.state)
+        err_spec = jax.tree.map(lambda _: P(dp_axes), self.state.opt_state.error)
+        state_spec = state_spec._replace(
+            opt_state=state_spec.opt_state._replace(error=err_spec))
+
+        def train_step(state, batch):
+            bspec = jax.tree.map(lambda _: P(None, dp_axes), batch)
+            # only the DP axes go manual; the rest stay auto so the model's
+            # internal sharding constraints (seq/tensor rules) remain legal
+            return shard_map(inner, mesh=topo.mesh,
+                             in_specs=(state_spec, bspec),
+                             out_specs=(state_spec, P()),
+                             axis_names=set(dp_axes),
+                             check_vma=False)(state, batch)
+
+        self._train_step = jax.jit(train_step,
+                                   out_shardings=(self._state_shardings, repl),
+                                   donate_argnums=(0,))
 
     def _offload_apply(self, grads: Pytree) -> None:
         """Host optimizer step + device param refresh."""

@@ -1,0 +1,252 @@
+"""1-bit optimizers: communication-compressed Adam / LAMB / 0/1-Adam.
+
+TPU-native analogues of the reference's 1-bit family
+(runtime/fp16/onebit/adam.py:14 `OnebitAdam`, lamb.py `OnebitLamb`,
+zoadam.py `ZeroOneAdam`) over the compressed-collective layer
+(runtime/comm/compressed.py `compressed_all_reduce` — the analogue of the
+reference's NCCL/MPI compressed backends, runtime/comm/nccl.py:16).
+
+Algorithm (1-bit Adam, NeurIPS'21): Adam's variance stabilizes early, so
+after ``freeze_step`` warmup steps the variance is FROZEN and only the
+momentum needs communicating — and momentum tolerates aggressive 1-bit
+(sign + scale) compression when both sides carry error feedback. Volume
+drops from 32 bits to ~1 bit per element on every DP boundary.
+
+SPMD shape: unlike the reference (optimizer calls torch.distributed
+explicitly), the compression must live INSIDE the jitted train step: these
+optimizers expose ``local_update`` which takes *per-device local* grads
+inside a ``shard_map`` region over the DP axes. The engine builds that
+region (engine._build_programs) when a 1-bit optimizer is configured; the
+warmup branch does a plain ``psum`` mean (exact dense Adam), the compressed
+branch runs sign-compressed momentum averaging with persistent error
+feedback carried in ``OptState.error``.
+"""
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Any, Sequence
+
+import jax
+import jax.numpy as jnp
+
+from ..ops.optimizers import OptState, Optimizer, _zeros_like
+from .comm.compressed import compressed_all_reduce
+
+Pytree = Any
+
+
+def _psum_mean(tree: Pytree, axis_name) -> Pytree:
+    size = jax.lax.psum(1, axis_name)
+    return jax.tree.map(lambda g: jax.lax.psum(g, axis_name) / size, tree)
+
+
+@dataclass(frozen=True)
+class OneBitAdam(Optimizer):
+    """reference runtime/fp16/onebit/adam.py:14."""
+
+    betas: tuple[float, float] = (0.9, 0.999)
+    eps: float = 1e-8
+    freeze_step: int = 100
+    adamw_mode: bool = True
+
+    def init(self, params: Pytree) -> OptState:
+        return OptState(step=jnp.zeros((), jnp.int32),
+                        mu=_zeros_like(params, jnp.float32),
+                        nu=_zeros_like(params, jnp.float32),
+                        error=_zeros_like(params, jnp.float32))
+
+    # dense fallback (single-device / no compression): exact Adam
+    def update(self, grads, state, params, lr=None):
+        return self._apply(grads, state, params, lr, frozen=False)
+
+    def _l2_grads(self, grads, params):
+        """Classic (non-decoupled) L2 decay folds into the gradient BEFORE
+        the momentum update, matching FusedAdam and the reference."""
+        if self.adamw_mode or not self.weight_decay:
+            return grads
+        return jax.tree.map(
+            lambda g, p: g + self.weight_decay * p.astype(jnp.float32),
+            grads, params)
+
+    def _bias_corrections(self, step, nu_frozen: bool):
+        """When nu is frozen its true bias factor stays at 1-b2^freeze, so
+        correcting with a still-growing bc2 would inflate the effective lr
+        ~sqrt(1/bc2_freeze)x over the compressed phase (the reference
+        sidesteps this by skipping bias correction entirely). The dense
+        path (nu live) keeps exact Adam corrections."""
+        b1, b2 = self.betas
+        fstep = jnp.float32(step)
+        bc1 = 1 - b1 ** fstep
+        if nu_frozen:
+            fstep = jnp.minimum(fstep, jnp.float32(self.freeze_step))
+        bc2 = 1 - b2 ** fstep
+        return bc1, bc2
+
+    def _param_step(self, params, mu, nu, lr, bc1, bc2):
+        def new_p(p, m, v):
+            upd = (m / bc1) / (jnp.sqrt(v / bc2) + self.eps)
+            if self.adamw_mode and self.weight_decay:
+                upd = upd + self.weight_decay * p.astype(jnp.float32)
+            return (p.astype(jnp.float32) - lr * upd).astype(p.dtype)
+
+        return jax.tree.map(new_p, params, mu, nu)
+
+    def _apply(self, grads, state, params, lr, frozen):
+        b1, b2 = self.betas
+        lr = self.lr if lr is None else lr
+        step = state.step + 1
+        grads = self._l2_grads(grads, params)
+        mu = jax.tree.map(lambda g, m: b1 * m + (1 - b1) * g, grads, state.mu)
+        if frozen:
+            nu = state.nu
+        else:
+            nu = jax.tree.map(lambda g, v: b2 * v + (1 - b2) * g * g,
+                              grads, state.nu)
+        bc1, bc2 = self._bias_corrections(step, nu_frozen=frozen)
+        params_out = self._param_step(params, mu, nu, lr, bc1, bc2)
+        return params_out, OptState(step=step, mu=mu, nu=nu, error=state.error)
+
+    def _apply_from_mu(self, mu_avg, state, params, lr, error):
+        """Param update from an externally-averaged momentum (compressed
+        phase: nu frozen, mu replaced by the allreduced estimate)."""
+        lr = self.lr if lr is None else lr
+        step = state.step + 1
+        bc1, bc2 = self._bias_corrections(step, nu_frozen=True)
+        params_out = self._param_step(params, mu_avg, state.nu, lr, bc1, bc2)
+        return params_out, OptState(step=step, mu=mu_avg, nu=state.nu,
+                                    error=error)
+
+    def _compress_momentum(self, local_grads, state, params, axis_name):
+        """Local momentum advance + sign-compressed allreduce with error
+        feedback; the shared core of every compressed branch."""
+        b1 = self.betas[0]
+        local_grads = self._l2_grads(local_grads, params)
+        mu_local = jax.tree.map(lambda g, m: b1 * m + (1 - b1) * g,
+                                local_grads, state.mu)
+        pairs = jax.tree.map(
+            lambda m, e: compressed_all_reduce(m, e, axis_name),
+            mu_local, state.error)
+        mu_avg = jax.tree.map(lambda pr: pr[0], pairs,
+                              is_leaf=lambda x: isinstance(x, tuple))
+        error = jax.tree.map(lambda pr: pr[1], pairs,
+                             is_leaf=lambda x: isinstance(x, tuple))
+        return mu_avg, error
+
+    def local_update(self, local_grads: Pytree, state: OptState, params: Pytree,
+                     axis_name: str | Sequence[str], lr=None
+                     ) -> tuple[Pytree, OptState]:
+        """Inside shard_map over the DP axes: warmup = dense Adam on the
+        psum-mean grad; after freeze_step = 1-bit compressed momentum
+        averaging with error feedback, variance frozen."""
+
+        def warmup(_):
+            grads = _psum_mean(local_grads, axis_name)
+            return self._apply(grads, state, params, lr, frozen=False)
+
+        def compressed(_):
+            mu_avg, error = self._compress_momentum(local_grads, state,
+                                                    params, axis_name)
+            return self._apply_from_mu(mu_avg, state, params, lr, error)
+
+        return jax.lax.cond(state.step < self.freeze_step, warmup,
+                            compressed, None)
+
+
+@dataclass(frozen=True)
+class ZeroOneAdam(OneBitAdam):
+    """0/1 Adam (reference onebit/zoadam.py): like 1-bit Adam but the
+    variance keeps updating on an interval schedule after the freeze point
+    (var_update_scaler) instead of freezing forever, and compressed sync
+    happens on a growing interval (local steps between syncs). The interval
+    structure maps poorly onto a single compiled step, so this variant keeps
+    per-step compressed sync and periodic variance refresh."""
+
+    var_update_scaler: int = 16
+
+    def local_update(self, local_grads, state, params, axis_name, lr=None):
+        def warmup(_):
+            grads = _psum_mean(local_grads, axis_name)
+            return self._apply(grads, state, params, lr, frozen=False)
+
+        def compressed(_):
+            b2 = self.betas[1]
+            mu_avg, error = self._compress_momentum(local_grads, state,
+                                                    params, axis_name)
+            # periodic variance refresh from the momentum estimate
+            refresh = (state.step % self.var_update_scaler) == 0
+            nu = jax.tree.map(
+                lambda v, m: jnp.where(refresh, b2 * v + (1 - b2) * m * m, v),
+                state.nu, mu_avg)
+            new_params, new_state = self._apply_from_mu(
+                mu_avg, state._replace(nu=nu), params, lr, error)
+            return new_params, new_state
+
+        return jax.lax.cond(state.step < self.freeze_step, warmup,
+                            compressed, None)
+
+
+@dataclass(frozen=True)
+class OneBitLamb(OneBitAdam):
+    """reference onebit/lamb.py: 1-bit Adam plus LAMB's layerwise trust
+    ratio. During the compressed phase the trust ratio is computed from the
+    frozen variance and the averaged momentum (the reference similarly
+    reuses warmup-phase scaling factors)."""
+
+    max_coeff: float = 10.0
+    min_coeff: float = 0.01
+
+    def _apply(self, grads, state, params, lr, frozen):
+        b1, b2 = self.betas
+        lr = self.lr if lr is None else lr
+        step = state.step + 1
+        mu = jax.tree.map(lambda g, m: b1 * m + (1 - b1) * g, grads, state.mu)
+        nu = state.nu if frozen else jax.tree.map(
+            lambda g, v: b2 * v + (1 - b2) * g * g, grads, state.nu)
+        return self._lamb_step(mu, nu, state, params, lr, step)
+
+    def _apply_from_mu(self, mu_avg, state, params, lr, error):
+        lr = self.lr if lr is None else lr
+        step = state.step + 1
+        params_out, st = self._lamb_step(mu_avg, state.nu, state, params, lr, step)
+        return params_out, st._replace(error=error)
+
+    def _lamb_step(self, mu, nu, state, params, lr, step):
+        def new_p(p, m, v):
+            upd = m / (jnp.sqrt(v) + self.eps)
+            if self.weight_decay:
+                upd = upd + self.weight_decay * p.astype(jnp.float32)
+            w_norm = jnp.linalg.norm(p.astype(jnp.float32).reshape(-1))
+            u_norm = jnp.linalg.norm(upd.reshape(-1))
+            ratio = jnp.where(
+                (w_norm > 0) & (u_norm > 0),
+                jnp.clip(w_norm / u_norm, self.min_coeff, self.max_coeff), 1.0)
+            return (p.astype(jnp.float32) - lr * ratio * upd).astype(p.dtype)
+
+        params_out = jax.tree.map(new_p, params, mu, nu)
+        return params_out, OptState(step=step, mu=mu, nu=nu, error=state.error)
+
+
+ONEBIT_OPTIMIZERS = {
+    "onebitadam": OneBitAdam,
+    "onebitlamb": OneBitLamb,
+    "zerooneadam": ZeroOneAdam,
+}
+
+
+def build_onebit_optimizer(type_name: str, params: dict) -> OneBitAdam:
+    name = type_name.lower().replace("_", "")
+    cls = ONEBIT_OPTIMIZERS[name]
+    kw = dict(params)
+    kw.pop("cuda_aware", None)
+    kw.pop("comm_backend_name", None)
+    for src, dst in (("var_freeze_step", "freeze_step"),):
+        if src in kw and "freeze_step" not in kw:
+            kw[dst] = kw.pop(src)
+        else:
+            kw.pop(src, None)
+    kw.pop("local_step_scaler", None)
+    kw.pop("local_step_clipper", None)
+    if "betas" in kw:
+        kw["betas"] = tuple(kw["betas"])
+    known = {f for f in cls.__dataclass_fields__}
+    return cls(**{k: v for k, v in kw.items() if k in known})
