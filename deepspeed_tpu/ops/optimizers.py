@@ -264,6 +264,12 @@ def build_optimizer(type_name: str, params: dict[str, Any]) -> Optimizer:
             kw["adamw_mode"] = bool(adam_w_mode)
         return build_onebit_optimizer(name, kw)
 
+    # 1-bit comm-only knobs may linger in a config whose type was switched
+    # to a dense optimizer; they don't change dense behavior — drop them
+    for k in ("freeze_step", "cuda_aware", "comm_backend_name", "var_freeze_step",
+              "var_update_scaler", "local_step_scaler", "local_step_clipper"):
+        p.pop(k, None)
+
     if name in ("adam", "adamw", "fusedadam"):
         mode = adam_w_mode if adam_w_mode is not None else (name != "adam")
         kw: dict[str, Any] = dict(lr=lr, weight_decay=wd, adamw_mode=bool(mode))

@@ -191,8 +191,11 @@ def load_checkpoint(engine, load_dir: str, tag: str | None = None) -> dict:
     restore_args = jax.tree.map(mk_args, target, sharding_tree)
 
     try:
+        # partial_restore: the checkpoint may carry entries this engine
+        # doesn't use (e.g. a 1-bit error buffer loaded into a dense run)
         restored = ckptr.restore(os.path.join(path, "state"), item=target,
-                                 restore_args=restore_args)
+                                 restore_args=restore_args,
+                                 partial_restore=True)
     except Exception as e:
         # per-DP-member error buffers change shape with the DP size; ONLY a
         # failure that names opt_error resets them — anything else is a real
@@ -206,7 +209,8 @@ def load_checkpoint(engine, load_dir: str, tag: str | None = None) -> dict:
             out_shardings=shardings.opt_state.error)(target.pop("opt_error"))
         restore_args.pop("opt_error", None)
         restored = ckptr.restore(os.path.join(path, "state"), item=target,
-                                 restore_args=restore_args)
+                                 restore_args=restore_args,
+                                 partial_restore=True)
     restored.update(missing)  # zeros for the allowed-absent entries
     if derive_master:
         # restore the checkpoint's fp32 params a second time directly into
@@ -217,7 +221,8 @@ def load_checkpoint(engine, load_dir: str, tag: str | None = None) -> dict:
             restore_args={"params": jax.tree.map(
                 lambda x, s: ocp.ArrayRestoreArgs(
                     sharding=s, global_shape=x.shape, dtype=jnp.float32),
-                state.master, shardings.master)})
+                state.master, shardings.master)},
+            partial_restore=True)
         restored["master"] = m["params"]
 
     from ..ops.optimizers import OptState

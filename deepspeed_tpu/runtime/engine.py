@@ -91,7 +91,12 @@ class DeepSpeedEngine:
                  activation_rules: list | None = None):
         self.config = config
         self.model = model
-        self.topology = topology or MeshTopology(config.mesh)
+        if topology is not None and config.zero_optimization.mics_shard_size > 0:
+            raise ValueError(
+                "mics_shard_size requires the engine to build the mesh (the "
+                "MiCS transform re-specs the fsdp/data axes) — pass the mesh "
+                "via config['mesh'] instead of a prebuilt topology")
+        self.topology = topology or self._build_topology(config)
         config.resolve_batch_terms(self.topology.dp_world_size)
 
         # activation checkpointing: flip the model zoo's remat switch from the
@@ -221,6 +226,37 @@ class DeepSpeedEngine:
             f"global_bs={config.train_batch_size} mesh={self.topology.axis_sizes}")
 
     # ------------------------------------------------------------------
+    @staticmethod
+    def _build_topology(config: Config) -> MeshTopology:
+        """Mesh construction with the MiCS transform (reference
+        runtime/zero/mics.py:64 `MiCS_Init`): ``mics_shard_size=p`` shards
+        ZeRO state over sub-groups of p devices and replicates across the
+        groups. Under GSPMD that IS a mesh re-spec — the fsdp axis shrinks
+        to p (it sits innermost of the DP axes in AXIS_ORDER, i.e. on
+        ICI-adjacent devices) and the group count multiplies the data axis,
+        so gathers ride ICI within a group while gradient reduction spans
+        groups hierarchically. The reference needs bespoke hierarchical
+        allgather code for this; XLA derives it from the sharding."""
+        topo = MeshTopology(config.mesh)
+        mics = config.zero_optimization.mics_shard_size
+        if mics is None or mics <= 0:
+            return topo
+        if config.zero_optimization.stage < 1:
+            raise ValueError("mics_shard_size needs ZeRO stage >= 1")
+        fs = topo.size("fsdp")
+        if fs == mics:
+            return topo
+        if fs % mics:
+            raise ValueError(f"mics_shard_size {mics} must divide the fsdp "
+                             f"axis ({fs})")
+        sizes = dict(topo.axis_sizes)
+        sizes["fsdp"] = mics
+        sizes["data"] = sizes.get("data", 1) * (fs // mics)
+        logger.info(f"MiCS: fsdp {fs} -> shard groups of {mics}, "
+                    f"{fs // mics}x replication folded into data "
+                    f"(mesh now {sizes})")
+        return MeshTopology(sizes)
+
     def _init_state(self, params, sample_batch, rng):
         cfg = self.config
         topo = self.topology
@@ -286,8 +322,8 @@ class DeepSpeedEngine:
             return
 
         opt_sh = self._opt_shardings_for(master_shardings)
-        opt0 = jax.jit(self.optimizer.init, out_shardings=opt_sh)(master0)
-        opt0, opt_sh = self._fixup_onebit_error(opt0, opt_sh)
+        opt_init_fn, opt_sh = self._wrap_opt_init(opt_sh)
+        opt0 = jax.jit(opt_init_fn, out_shardings=opt_sh)(master0)
 
         if self.mixed_precision:
             params0 = jax.jit(lambda m: _cast_tree(m, self.compute_dtype),
@@ -309,29 +345,38 @@ class DeepSpeedEngine:
             global_step=NamedSharding(topo.mesh, P()),
         )
 
-    def _fixup_onebit_error(self, opt0, opt_shardings):
+    def _wrap_opt_init(self, opt_shardings):
         """1-bit error feedback is per-DP-member state. When the compressed
-        path is active, restack it with a leading DP dim sharded over the DP
-        axes (so checkpoints carry every member's error); when a 1-bit
-        optimizer runs in its dense fallback, drop the buffer entirely — it
-        would be a params-sized dead weight in HBM and checkpoints."""
+        path is active, the init stacks it with a leading DP dim sharded
+        over the DP axes (so checkpoints carry every member's error); in
+        the dense fallback the buffer is dropped INSIDE the jitted init, so
+        XLA dead-code-eliminates it and no transient params-sized zeros
+        ever materialize."""
         from .onebit import OneBitAdam
 
-        if not isinstance(self.optimizer, OneBitAdam) or opt0.error is None:
-            return opt0, opt_shardings
+        if not isinstance(self.optimizer, OneBitAdam) \
+                or opt_shardings.error is None:
+            return self.optimizer.init, opt_shardings
         topo = self.topology
         if not self._use_onebit_comm():
-            return (opt0._replace(error=None),
-                    opt_shardings._replace(error=None))
+            def init_dense(m):
+                return self.optimizer.init(m)._replace(error=None)
+
+            return init_dense, opt_shardings._replace(error=None)
+
         dp_axes = tuple(a for a in BATCH_AXES if topo.size(a) > 1)
         dp = topo.dp_world_size
-        err_sh = jax.tree.map(
-            lambda _: NamedSharding(topo.mesh, P(dp_axes)), opt0.error)
-        err0 = jax.jit(
-            lambda t: jax.tree.map(
-                lambda e: jnp.zeros((dp,) + e.shape, jnp.float32), t),
-            out_shardings=err_sh)(opt0.error)
-        return opt0._replace(error=err0), opt_shardings._replace(error=err_sh)
+
+        def init_stacked(m):
+            o = self.optimizer.init(m)
+            err = jax.tree.map(
+                lambda e: jnp.zeros((dp,) + e.shape, jnp.float32), o.error)
+            return o._replace(error=err)
+
+        is_sh = lambda x: isinstance(x, NamedSharding)
+        err_sh = jax.tree.map(lambda _: NamedSharding(topo.mesh, P(dp_axes)),
+                              opt_shardings.error, is_leaf=is_sh)
+        return init_stacked, opt_shardings._replace(error=err_sh)
 
     def _opt_shardings_for(self, master_shardings):
         # OptState moments mirror master shardings; absent moments stay None.

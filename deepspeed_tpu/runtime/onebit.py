@@ -195,6 +195,12 @@ class OneBitLamb(OneBitAdam):
     max_coeff: float = 10.0
     min_coeff: float = 0.01
 
+    def _l2_grads(self, grads, params):
+        # LAMB folds decay into the trust-ratio update (_lamb_step) in both
+        # modes; folding it into the grads too would double-apply it in the
+        # compressed phase
+        return grads
+
     def _apply(self, grads, state, params, lr, frozen):
         b1, b2 = self.betas
         lr = self.lr if lr is None else lr

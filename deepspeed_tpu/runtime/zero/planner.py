@@ -170,6 +170,13 @@ def build_plan(topology: MeshTopology, zero_config: ZeroConfig,
     bare arrays / ShapeDtypeStructs (fsdp heuristic only).
     """
     stage = zero_config.stage
+    if zero_config.zero_hpz_partition_size > 1:
+        logger.info(
+            "hpZ: secondary intra-node param partitions are an explicit "
+            "cache in the reference (stage3.py:155); under GSPMD the fsdp "
+            "axis already sits on ICI-adjacent devices and XLA schedules "
+            "hierarchical gathers itself — for an explicit ICI-domain "
+            "shard, use mics_shard_size instead")
     rules = dict(DEFAULT_LOGICAL_RULES)
     if logical_rules:
         rules.update(logical_rules)

@@ -135,6 +135,62 @@ def test_engine_onebit_falls_back_on_zero_stage(caplog):
     assert np.isfinite(float(engine.train_batch(batch)))
 
 
+def test_onebit_checkpoint_into_dense_engine(tmp_path):
+    """A 1-bit checkpoint (has opt_error) restores into a dense AdamW
+    engine — the extra entry is simply not restored (partial restore)."""
+    eng, *_ = ds.initialize(
+        model=build_model("tiny-gpt2"),
+        config={"train_micro_batch_size_per_gpu": 2,
+                "optimizer": {"type": "OneBitAdam",
+                              "params": {"lr": 2e-3, "freeze_step": 1}},
+                "zero_optimization": {"stage": 0}})
+    rng = np.random.default_rng(0)
+    gbs = eng.config.train_batch_size
+    ids = rng.integers(0, 256, (gbs, 32))
+    batch = {"input_ids": ids, "labels": ids}
+    for _ in range(2):
+        eng.train_batch(batch)
+    eng.save_checkpoint(str(tmp_path / "ck"))
+
+    dense, *_ = ds.initialize(
+        model=build_model("tiny-gpt2"),
+        config={"train_micro_batch_size_per_gpu": 2,
+                "optimizer": {"type": "AdamW", "params": {"lr": 2e-3}},
+                "zero_optimization": {"stage": 0}})
+    dense.load_checkpoint(str(tmp_path / "ck"))
+    assert dense.state.opt_state.error is None
+    assert np.isfinite(float(dense.train_batch(batch)))
+
+
+def test_fp32_checkpoint_into_bf16_engine(tmp_path):
+    """fp32 checkpoints (no master on disk) restore into a bf16 engine; the
+    master comes from the checkpoint's fp32 params exactly."""
+    fp32, *_ = ds.initialize(
+        model=build_model("tiny-gpt2"),
+        config={"train_micro_batch_size_per_gpu": 2,
+                "optimizer": {"type": "AdamW", "params": {"lr": 2e-3}},
+                "bf16": {"enabled": False},
+                "zero_optimization": {"stage": 1}})
+    rng = np.random.default_rng(0)
+    gbs = fp32.config.train_batch_size
+    ids = rng.integers(0, 256, (gbs, 32))
+    batch = {"input_ids": ids, "labels": ids}
+    fp32.train_batch(batch)
+    fp32.save_checkpoint(str(tmp_path / "ck32"))
+    saved_param = np.asarray(jax.tree.leaves(fp32.state.params)[0], np.float32)
+
+    bf16, *_ = ds.initialize(
+        model=build_model("tiny-gpt2"),
+        config={"train_micro_batch_size_per_gpu": 2,
+                "optimizer": {"type": "AdamW", "params": {"lr": 2e-3}},
+                "zero_optimization": {"stage": 1}})
+    bf16.load_checkpoint(str(tmp_path / "ck32"))
+    # master must be the EXACT fp32 values, not bf16-rounded
+    m = np.asarray(jax.tree.leaves(bf16.state.master)[0])
+    np.testing.assert_array_equal(m, saved_param)
+    assert np.isfinite(float(bf16.train_batch(batch)))
+
+
 def test_onebit_checkpoint_roundtrip(tmp_path):
     def mk():
         e, *_ = ds.initialize(
