@@ -1,0 +1,27 @@
+"""Compression: quantization-aware training, pruning, layer reduction.
+
+TPU-native analogue of the reference compression package
+(deepspeed/compression/compress.py:100 `init_compression`, :148
+`redundancy_clean`, basic_layer.py, scheduler.py — the XTC / ZeroQuant
+training recipes).
+
+The reference swaps nn.Modules for quantized/pruned variants. Flax params
+are immutable pytrees, so compression composes at the FUNCTION level
+instead: ``CompressionManager.transform_params(params, step)`` applies
+fake-quantization (straight-through estimator) and pruning masks to the
+matched leaves, and the engine runs the loss on the transformed params —
+same training dynamics, no module surgery. ``redundancy_clean`` bakes the
+masks/quantization in permanently and applies layer reduction.
+"""
+from .basic_ops import (  # noqa: F401
+    fake_quantize,
+    head_prune_mask,
+    magnitude_prune_mask,
+    row_prune_mask,
+)
+from .compress import (  # noqa: F401
+    CompressionManager,
+    init_compression,
+    redundancy_clean,
+)
+from .config import CompressionConfig  # noqa: F401

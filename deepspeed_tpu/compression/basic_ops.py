@@ -1,0 +1,94 @@
+"""Compression primitives (reference deepspeed/compression/basic_layer.py +
+utils.py): fake quantization with straight-through gradients and pruning
+masks. Pure jax functions — XLA fuses them into the surrounding matmuls.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+def _ste(w: jax.Array, q: jax.Array) -> jax.Array:
+    """Straight-through estimator: forward q, gradient of identity."""
+    return w + jax.lax.stop_gradient(q - w)
+
+
+def fake_quantize(w: jax.Array, bits: int = 8, symmetric: bool = True,
+                  num_groups: int = 1) -> jax.Array:
+    """Quantize-dequantize with per-group scales (reference
+    basic_layer.py QuantAct/LinearLayer_Compress quantize_weight;
+    ZeroQuant's group-wise quantization). STE gradients for QAT."""
+    if bits >= 32:
+        return w
+    orig_shape = w.shape
+    flat = w.reshape(num_groups, -1)
+    if symmetric:
+        scale = jnp.max(jnp.abs(flat), axis=1, keepdims=True)
+        qmax = 2.0 ** (bits - 1) - 1
+        scale = jnp.where(scale == 0, 1.0, scale) / qmax
+        q = jnp.round(flat / scale) * scale
+    else:
+        mn = jnp.min(flat, axis=1, keepdims=True)
+        mx = jnp.max(flat, axis=1, keepdims=True)
+        qmax = 2.0 ** bits - 1
+        scale = jnp.where(mx > mn, (mx - mn), 1.0) / qmax
+        q = (jnp.round((flat - mn) / scale) * scale) + mn
+    return _ste(flat, q).reshape(orig_shape)
+
+
+def quantize_activation(x: jax.Array, bits: int = 8,
+                        symmetric: bool = False) -> jax.Array:
+    """Dynamic per-tensor activation fake-quant (reference QuantAct)."""
+    return fake_quantize(x, bits=bits, symmetric=symmetric, num_groups=1)
+
+
+def magnitude_prune_mask(w: jax.Array, dense_ratio: float) -> jax.Array:
+    """Unstructured magnitude mask keeping the top ``dense_ratio`` fraction
+    (reference sparse_pruning, method 'l1')."""
+    if dense_ratio >= 1.0:
+        return jnp.ones_like(w, dtype=jnp.bool_)
+    k = max(1, int(round(w.size * dense_ratio)))
+    thresh = jnp.sort(jnp.abs(w).reshape(-1))[-k]
+    return jnp.abs(w) >= thresh
+
+
+def row_prune_mask(w: jax.Array, dense_ratio: float, axis: int = 0) -> jax.Array:
+    """Structured row mask by L1 row norm (reference row_pruning)."""
+    if dense_ratio >= 1.0:
+        return jnp.ones_like(w, dtype=jnp.bool_)
+    reduce_axes = tuple(i for i in range(w.ndim) if i != axis)
+    norms = jnp.sum(jnp.abs(w), axis=reduce_axes)
+    k = max(1, int(round(norms.size * dense_ratio)))
+    thresh = jnp.sort(norms)[-k]
+    keep = norms >= thresh
+    shape = [1] * w.ndim
+    shape[axis] = w.shape[axis]
+    return jnp.broadcast_to(keep.reshape(shape), w.shape)
+
+
+def head_prune_mask(w: jax.Array, dense_ratio: float,
+                    num_heads: int) -> jax.Array:
+    """Attention-head mask by per-head L1 norm (reference head_pruning).
+    Works on [..., heads, head_dim] projections or 2-D [in, heads*dim]
+    (heads partition the OUTPUT columns, flax kernel convention)."""
+    if dense_ratio >= 1.0:
+        return jnp.ones_like(w, dtype=jnp.bool_)
+    if w.ndim == 2:
+        if w.shape[1] % num_heads:
+            raise ValueError(f"output dim {w.shape[1]} not divisible by "
+                             f"num_heads {num_heads}")
+        # [in, heads, dim]: per-head norm over (in, dim)
+        per_head = w.reshape(w.shape[0], num_heads, -1)
+        norms = jnp.sum(jnp.abs(per_head), axis=(0, 2))
+    else:
+        norms = jnp.sum(jnp.abs(jnp.moveaxis(w, -2, 0).reshape(num_heads, -1)),
+                        axis=1)
+    k = max(1, int(round(num_heads * dense_ratio)))
+    thresh = jnp.sort(norms)[-k]
+    keep = norms >= thresh  # [heads]
+    if w.ndim == 2:
+        col_keep = jnp.repeat(keep, w.shape[1] // num_heads)  # [heads*dim]
+        return jnp.broadcast_to(col_keep[None, :], w.shape)
+    shape = [1] * w.ndim
+    shape[-2] = num_heads
+    return jnp.broadcast_to(keep.reshape(shape), w.shape)

@@ -1,0 +1,177 @@
+"""Compression manager (reference deepspeed/compression/compress.py:100
+`init_compression`, :148 `redundancy_clean`, scheduler.py).
+
+JAX shape: ``transform_params(params, step)`` is pure and jit-friendly —
+the engine composes it in front of the loss so QAT/pruning gradients flow
+through the straight-through estimators. Pruning masks are derived from the
+CURRENT weights each step (dynamic magnitude pruning, matching the
+reference's per-step mask recomputation before redundancy_clean fixes
+them)."""
+from __future__ import annotations
+
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+
+from ..utils.logging import logger
+from .basic_ops import (fake_quantize, head_prune_mask, magnitude_prune_mask,
+                        row_prune_mask)
+from .config import CompressionConfig, TechniqueGroup
+
+Pytree = Any
+
+
+def _leaf_transform(w, groups: list[TechniqueGroup], step):
+    for g in groups:
+        p = g.params
+        if g.technique == "weight_quantization":
+            if w.ndim < 2:
+                continue  # biases/norm scales stay fp (reference quantizes
+                          # Linear weights only)
+            qg = int(p.get("quantize_groups", 1))
+            if w.size % qg:
+                qg = 1  # group count must divide the leaf; fall back
+            q = fake_quantize(
+                w, bits=int(p.get("start_bits", p.get("bits", 8))),
+                symmetric=p.get("quantization_type", "symmetric") == "symmetric",
+                num_groups=qg)
+        elif g.technique == "sparse_pruning":
+            q = w * magnitude_prune_mask(
+                w, float(p.get("dense_ratio", 0.5))).astype(w.dtype)
+        elif g.technique == "row_pruning":
+            q = w * row_prune_mask(
+                w, float(p.get("dense_ratio", 0.5))).astype(w.dtype)
+        elif g.technique == "head_pruning":
+            q = w * head_prune_mask(
+                w, float(p.get("dense_ratio", 0.5)),
+                num_heads=int(p["num_heads"])).astype(w.dtype)
+        elif g.technique == "channel_pruning":
+            q = w * row_prune_mask(
+                w, float(p.get("dense_ratio", 0.5)), axis=w.ndim - 1).astype(w.dtype)
+        else:  # activation_quantization handled at the model level
+            continue
+        # schedule gating is dynamic so one compiled step serves all phases
+        active = jnp.asarray(step) >= g.schedule_offset
+        if g.schedule_offset_end is not None:
+            active = active & (jnp.asarray(step) < g.schedule_offset_end)
+        w = jnp.where(active, q, w)
+    return w
+
+
+class CompressionManager:
+    def __init__(self, config: CompressionConfig):
+        self.config = config
+        self._match_cache: dict[str, list[TechniqueGroup]] = {}
+
+    def _groups_for(self, keypath: str) -> list[TechniqueGroup]:
+        if keypath not in self._match_cache:
+            self._match_cache[keypath] = [
+                g for g in self.config.groups
+                if g.technique != "activation_quantization" and g.matches(keypath)]
+        return self._match_cache[keypath]
+
+    # -- QAT path -------------------------------------------------------
+    def transform_params(self, params: Pytree, step) -> Pytree:
+        """Apply fake-quant + masks to matched leaves (jit-friendly;
+        ``step`` may be a traced scalar)."""
+        flat, treedef = jax.tree_util.tree_flatten_with_path(params)
+        out = []
+        for path, leaf in flat:
+            groups = self._groups_for(jax.tree_util.keystr(path))
+            out.append(_leaf_transform(leaf, groups, step) if groups else leaf)
+        return jax.tree_util.tree_unflatten(treedef, out)
+
+    # -- make-permanent (reference redundancy_clean) --------------------
+    def clean_params(self, params: Pytree, step: int | None = None) -> Pytree:
+        """Bake the transforms in (masks/quant become the stored values) and
+        apply layer reduction."""
+        step = step if step is not None else 1 << 30  # everything active
+        params = jax.tree.map(lambda x: x, self.transform_params(params, step))
+        lr = self.config.layer_reduction
+        if lr.enabled:
+            params = apply_layer_reduction(params, lr)
+        return params
+
+
+def apply_layer_reduction(params: Pytree, lr) -> Pytree:
+    """Keep a subset of transformer blocks and renumber them (reference
+    compress.py student_initialization / layer_reduction): teacher_layer
+    lists which source blocks initialize the kept student blocks."""
+    if not isinstance(params, dict):
+        raise ValueError("layer reduction expects a dict param tree")
+    prefix = lr.module_name_prefix
+    layer_keys = sorted((k for k in params if k.startswith(prefix)),
+                        key=lambda k: int(k[len(prefix):]))
+    n = len(layer_keys)
+    teacher = lr.teacher_layer or list(range(lr.keep_number_layer or n))
+    if lr.keep_number_layer is not None and len(teacher) != lr.keep_number_layer:
+        raise ValueError(f"teacher_layer {teacher} inconsistent with "
+                         f"keep_number_layer {lr.keep_number_layer}")
+    bad = [t for t in teacher if t >= n]
+    if bad:
+        raise ValueError(f"teacher_layer indices {bad} out of range ({n} layers)")
+    out = {k: v for k, v in params.items() if not k.startswith(prefix)}
+    for student_idx, teacher_idx in enumerate(teacher):
+        out[f"{prefix}{student_idx}"] = params[f"{prefix}{teacher_idx}"]
+    logger.info(f"layer reduction: {n} -> {len(teacher)} blocks "
+                f"(teachers {teacher})")
+    return out
+
+
+def init_compression(engine_or_params, config: dict | CompressionConfig,
+                     mpu=None) -> CompressionManager:
+    """Attach compression (reference compress.py:100). With an engine, the
+    loss is rewired so every forward sees the compressed params; with a raw
+    param tree, the returned manager is used manually."""
+    cfg = config if isinstance(config, CompressionConfig) else \
+        CompressionConfig.from_dict(
+            (config or {}).get("compression_training", config))
+    mgr = CompressionManager(cfg)
+    engine = engine_or_params
+    if hasattr(engine, "_build_programs"):
+        # the engine applies transform_params inside its grad computation
+        # (engine._compute_grads) so the schedule step stays traced and STE
+        # gradients reach the raw weights
+        engine.compression_manager = mgr
+        engine._build_programs()  # recompile with the compression transform
+        logger.info(f"compression attached: {len(cfg.groups)} technique "
+                    f"group(s), layer_reduction={cfg.layer_reduction.enabled}")
+    return mgr
+
+
+def redundancy_clean(engine_or_params, config: dict | CompressionConfig
+                     ) -> Pytree:
+    """Make compression permanent (reference compress.py:148). Given an
+    engine, the cleaned params are INSTALLED into its state (params and the
+    fp32 master, so the optimizer continues from the baked weights) and
+    also returned. Layer reduction changes the tree structure, so with an
+    engine it must be applied to the returned tree of a structure-preserving
+    clean and a new engine built from it."""
+    cfg = config if isinstance(config, CompressionConfig) else \
+        CompressionConfig.from_dict(
+            (config or {}).get("compression_training", config))
+    mgr = CompressionManager(cfg)
+    engine = engine_or_params
+    if hasattr(engine, "state"):
+        if cfg.layer_reduction.enabled:
+            raise ValueError(
+                "layer_reduction changes the parameter structure; apply "
+                "redundancy_clean to a params tree and build a new engine "
+                "from the result")
+        if getattr(engine, "_offload_opt", None) is not None:
+            raise NotImplementedError(
+                "redundancy_clean on a host-offloaded engine is not wired; "
+                "clean engine.params manually and re-initialize")
+        cleaned = mgr.clean_params(engine.state.params)
+        new_params = jax.device_put(cleaned, engine.plan.param_shardings)
+        new_master = None
+        if engine.state.master is not None:
+            new_master = jax.jit(
+                lambda t: jax.tree.map(lambda x: x.astype(jnp.float32), t),
+                out_shardings=engine.plan.master_shardings)(new_params)
+        engine.state = engine.state._replace(
+            params=new_params,
+            master=new_master if new_master is not None else engine.state.master)
+        return cleaned
+    return mgr.clean_params(engine_or_params)

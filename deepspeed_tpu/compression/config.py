@@ -1,0 +1,96 @@
+"""Compression config parsing (reference deepspeed/compression/config.py:
+``compression_training`` section with shared-parameters + per-group
+``modules`` pattern lists; constants.py names)."""
+from __future__ import annotations
+
+import fnmatch
+import re
+from dataclasses import dataclass, field
+from typing import Any
+
+from ..utils.logging import logger
+
+
+@dataclass
+class TechniqueGroup:
+    """One technique instance applied to a set of module patterns."""
+    technique: str                 # weight_quantization | sparse_pruning | ...
+    modules: list[str] = field(default_factory=lambda: ["*"])
+    params: dict = field(default_factory=dict)
+    schedule_offset: int = 0
+    schedule_offset_end: int | None = None
+
+    def matches(self, keypath: str) -> bool:
+        norm = keypath.strip("/").replace("']['", "/").strip("[']")
+        for pat in self.modules:
+            if pat == "*" or fnmatch.fnmatch(norm, pat) \
+                    or fnmatch.fnmatch(norm, f"*{pat}*"):
+                return True
+            try:  # reference module patterns may be regexes; globs with
+                  # metacharacters (e.g. '*attn') are not valid regex
+                if re.search(pat, norm):
+                    return True
+            except re.error:
+                pass
+        return False
+
+    def active(self, step: int) -> bool:
+        if step < self.schedule_offset:
+            return False
+        if self.schedule_offset_end is not None and step >= self.schedule_offset_end:
+            return False
+        return True
+
+
+@dataclass
+class LayerReductionConfig:
+    enabled: bool = False
+    keep_number_layer: int | None = None
+    teacher_layer: list[int] = field(default_factory=list)
+    module_name_prefix: str = "layer_"
+    other_module_name: list[str] = field(default_factory=list)
+
+
+@dataclass
+class CompressionConfig:
+    enabled: bool = False
+    groups: list[TechniqueGroup] = field(default_factory=list)
+    layer_reduction: LayerReductionConfig = field(
+        default_factory=LayerReductionConfig)
+
+    TECHNIQUES = ("weight_quantization", "activation_quantization",
+                  "sparse_pruning", "row_pruning", "head_pruning",
+                  "channel_pruning")
+
+    @classmethod
+    def from_dict(cls, d: dict[str, Any] | None) -> "CompressionConfig":
+        d = dict(d or {})
+        cfg = cls()
+        lr = d.pop("layer_reduction", None)
+        if lr:
+            cfg.layer_reduction = LayerReductionConfig(
+                enabled=lr.get("enabled", False),
+                keep_number_layer=lr.get("keep_number_layer"),
+                teacher_layer=list(lr.get("teacher_layer", [])),
+                module_name_prefix=lr.get("module_name_prefix", "layer_"),
+                other_module_name=list(lr.get("other_module_name", [])))
+        for tech in cls.TECHNIQUES:
+            sec = d.pop(tech, None)
+            if not sec or not sec.get("enabled", True):
+                continue
+            shared = dict(sec.get("shared_parameters", {}))
+            offset = int(shared.get("schedule_offset", 0))
+            offset_end = shared.get("schedule_offset_end")
+            for gname, g in sec.get("different_groups", {}).items():
+                gp = dict(g.get("params", {}))
+                cfg.groups.append(TechniqueGroup(
+                    technique=tech,
+                    modules=list(g.get("modules", ["*"])),
+                    params=gp,
+                    schedule_offset=int(g.get("schedule_offset", offset)),
+                    schedule_offset_end=(int(offset_end)
+                                         if offset_end is not None else None)))
+        if d:
+            logger.warning(f"compression: ignoring unknown sections {sorted(d)}")
+        cfg.enabled = bool(cfg.groups) or cfg.layer_reduction.enabled
+        return cfg

@@ -397,7 +397,13 @@ class DeepSpeedEngine:
     def _compute_grads(self, state: TrainState, batch: dict) -> tuple[jax.Array, Pytree]:
         """One microbatch forward+backward; grads constrained per plan
         (stage ≥2 → reduce-scatter; else all-reduce)."""
+        mgr = getattr(self, "compression_manager", None)
+
         def scaled_loss(p):
+            if mgr is not None:
+                # QAT/pruning transform inside the grad so STE gradients
+                # reach the raw weights; step traced → schedule stays live
+                p = mgr.transform_params(p, state.opt_state.step)
             loss = self._loss_with_rules(p, batch)
             if state.scaler is not None:
                 loss = loss * state.scaler.scale
@@ -482,7 +488,11 @@ class DeepSpeedEngine:
         gas_grads = make_gas_grads(self._compute_grads, constrain=True)
 
         def eval_step(state: TrainState, batch: dict):
-            return self._loss_with_rules(state.params, batch)
+            p = state.params
+            mgr = getattr(self, "compression_manager", None)
+            if mgr is not None:  # eval must see the model that will deploy
+                p = mgr.transform_params(p, state.opt_state.step)
+            return self._loss_with_rules(p, batch)
 
         self._eval_step = jax.jit(eval_step, out_shardings=repl)
 

@@ -1,0 +1,158 @@
+"""Compression tests (reference tests/unit/compression/test_compression.py
+analogue)."""
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import deepspeed_tpu as ds
+from deepspeed_tpu.compression import (CompressionConfig, CompressionManager,
+                                       fake_quantize, head_prune_mask,
+                                       init_compression, magnitude_prune_mask,
+                                       redundancy_clean, row_prune_mask)
+from deepspeed_tpu.models import build_model
+
+
+# -- primitives -------------------------------------------------------------
+def test_fake_quantize_levels_and_error():
+    rng = np.random.default_rng(0)
+    w = jnp.asarray(rng.standard_normal((64, 64)), jnp.float32)
+    for bits in (8, 4):
+        q = fake_quantize(w, bits=bits, symmetric=True, num_groups=4)
+        # per-group level count bounded by 2^bits
+        levels = len(np.unique(np.asarray(q).reshape(4, -1)[0]))
+        assert levels <= 2 ** bits
+        err = float(jnp.abs(q - w).max())
+        scale = float(jnp.abs(w).max()) / (2 ** (bits - 1) - 1)
+        assert err <= scale  # rounding error bounded by one step
+    # asymmetric handles shifted ranges better
+    w_shift = w + 5.0
+    qa = fake_quantize(w_shift, bits=4, symmetric=False)
+    qs = fake_quantize(w_shift, bits=4, symmetric=True)
+    assert float(jnp.abs(qa - w_shift).mean()) < float(jnp.abs(qs - w_shift).mean())
+
+
+def test_fake_quantize_ste_gradient():
+    w = jnp.linspace(-1, 1, 32)
+    g = jax.grad(lambda x: jnp.sum(fake_quantize(x, bits=4) * 3.0))(w)
+    np.testing.assert_allclose(np.asarray(g), 3.0)  # identity through STE
+
+
+def test_prune_masks():
+    rng = np.random.default_rng(1)
+    w = jnp.asarray(rng.standard_normal((16, 32)), jnp.float32)
+    m = magnitude_prune_mask(w, 0.25)
+    assert np.asarray(m).mean() == pytest.approx(0.25, abs=0.01)
+    # kept entries are the largest-magnitude ones
+    assert float(jnp.abs(w)[m].min()) >= float(jnp.abs(w)[~m].max())
+
+    rm = row_prune_mask(w, 0.5)
+    kept_rows = np.asarray(rm)[:, 0]
+    assert kept_rows.sum() == 8
+    assert np.all(np.asarray(rm) == kept_rows[:, None])  # whole rows
+
+    hm = np.asarray(head_prune_mask(w, 0.5, num_heads=4))
+    # heads partition the OUTPUT columns: [in, heads, dim]
+    per_head = hm.reshape(16, 4, 8)
+    head_kept = per_head.all(axis=(0, 2))
+    head_dropped = (~per_head).all(axis=(0, 2))
+    assert head_kept.sum() == 2 and head_dropped.sum() == 2
+    # the kept heads are the larger-norm ones
+    norms = np.abs(np.asarray(w).reshape(16, 4, 8)).sum(axis=(0, 2))
+    assert set(np.argsort(norms)[-2:]) == set(np.where(head_kept)[0])
+
+
+# -- config + manager -------------------------------------------------------
+def comp_config(offset=0):
+    return {"compression_training": {
+        "weight_quantization": {
+            "shared_parameters": {"schedule_offset": offset},
+            "different_groups": {
+                "wq1": {"params": {"start_bits": 8, "target_bits": 8,
+                                   "quantize_groups": 1},
+                        "modules": ["attn", "ffn"]}}},
+        "sparse_pruning": {
+            "shared_parameters": {"schedule_offset": offset},
+            "different_groups": {
+                "sp1": {"params": {"dense_ratio": 0.5},
+                        "modules": ["ffn"]}}},
+    }}
+
+
+def test_config_parses_groups():
+    cfg = CompressionConfig.from_dict(comp_config()["compression_training"])
+    assert cfg.enabled and len(cfg.groups) == 2
+    assert cfg.groups[0].matches("['layer_0']['attn']['wq']")
+    assert not cfg.groups[1].matches("['layer_0']['attn']['wq']")
+    assert cfg.groups[1].matches("['layer_0']['ffn']['w_up']")
+
+
+def test_transform_respects_schedule():
+    cfg = CompressionConfig.from_dict(comp_config(offset=10)["compression_training"])
+    mgr = CompressionManager(cfg)
+    rng = np.random.default_rng(2)
+    w = jnp.asarray(rng.standard_normal((8, 8)), jnp.float32)
+    params = {"layer_0": {"ffn": {"w_up": w}}}
+    before = mgr.transform_params(params, step=5)
+    np.testing.assert_array_equal(np.asarray(before["layer_0"]["ffn"]["w_up"]),
+                                  np.asarray(w))  # inactive before offset
+    after = np.asarray(mgr.transform_params(params, step=10)["layer_0"]["ffn"]["w_up"])
+    assert (after == 0).mean() == pytest.approx(0.5, abs=0.02)  # pruned half
+
+
+def test_layer_reduction():
+    cfg = CompressionConfig.from_dict({
+        "layer_reduction": {"enabled": True, "keep_number_layer": 2,
+                            "teacher_layer": [0, 3]}})
+    mgr = CompressionManager(cfg)
+    params = {f"layer_{i}": {"w": jnp.full((2,), float(i))} for i in range(4)}
+    params["embed"] = jnp.zeros((3,))
+    out = mgr.clean_params(params)
+    assert sorted(k for k in out if k.startswith("layer_")) == \
+        ["layer_0", "layer_1"]
+    np.testing.assert_array_equal(np.asarray(out["layer_1"]["w"]), 3.0)
+    assert "embed" in out
+    with pytest.raises(ValueError, match="out of range"):
+        CompressionManager(CompressionConfig.from_dict({
+            "layer_reduction": {"enabled": True,
+                                "teacher_layer": [0, 9]}})).clean_params(params)
+
+
+# -- engine QAT -------------------------------------------------------------
+def test_engine_qat_end_to_end():
+    engine, *_ = ds.initialize(
+        model=build_model("tiny-gpt2"),
+        config={"train_micro_batch_size_per_gpu": 2,
+                "optimizer": {"type": "AdamW", "params": {"lr": 2e-3}},
+                "zero_optimization": {"stage": 1}})
+    mgr = init_compression(engine, comp_config())
+    assert engine.compression_manager is mgr
+    rng = np.random.default_rng(0)
+    gbs = engine.config.train_batch_size
+    ids = rng.integers(0, 256, (gbs, 32))
+    batch = {"input_ids": ids, "labels": ids}
+    losses = [float(engine.train_batch(batch)) for _ in range(5)]
+    assert losses[-1] < losses[0] - 0.2  # QAT still learns
+
+    cleaned = redundancy_clean(engine, comp_config())
+    # ffn weights are half-pruned permanently
+    w = np.asarray(cleaned["layer_0"]["ffn"]["w_up"], np.float32)
+    assert (w == 0).mean() == pytest.approx(0.5, abs=0.02)
+    # ... and INSTALLED into the engine (params + master)
+    w_eng = np.asarray(engine.state.params["layer_0"]["ffn"]["w_up"], np.float32)
+    assert (w_eng == 0).mean() == pytest.approx(0.5, abs=0.02)
+    w_master = np.asarray(engine.state.master["layer_0"]["ffn"]["w_up"])
+    assert (w_master == 0).mean() == pytest.approx(0.5, abs=0.02)
+
+    # glob-with-metachar patterns must not crash matching
+    cfgx = comp_config()
+    cfgx["compression_training"]["sparse_pruning"]["different_groups"]["sp1"][
+        "modules"] = ["*ffn"]
+    from deepspeed_tpu.compression import CompressionConfig as CC
+    g = CC.from_dict(cfgx["compression_training"]).groups[-1]
+    assert not g.matches("['layer_0']['attn']['wq']")
+
+    # engine + layer_reduction is rejected (structure change)
+    with pytest.raises(ValueError, match="structure"):
+        redundancy_clean(engine, {"compression_training": {
+            "layer_reduction": {"enabled": True, "teacher_layer": [0]}}})
