@@ -14,7 +14,7 @@ same training dynamics, no module surgery. ``redundancy_clean`` bakes the
 masks/quantization in permanently and applies layer reduction.
 """
 from .basic_ops import (  # noqa: F401
-    fake_quantize,
+    group_fake_quantize,
     head_prune_mask,
     magnitude_prune_mask,
     row_prune_mask,

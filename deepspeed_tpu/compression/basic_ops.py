@@ -13,11 +13,13 @@ def _ste(w: jax.Array, q: jax.Array) -> jax.Array:
     return w + jax.lax.stop_gradient(q - w)
 
 
-def fake_quantize(w: jax.Array, bits: int = 8, symmetric: bool = True,
-                  num_groups: int = 1) -> jax.Array:
-    """Quantize-dequantize with per-group scales (reference
-    basic_layer.py QuantAct/LinearLayer_Compress quantize_weight;
-    ZeroQuant's group-wise quantization). STE gradients for QAT."""
+def group_fake_quantize(w: jax.Array, bits: int = 8, symmetric: bool = True,
+                        num_groups: int = 1) -> jax.Array:
+    """Quantize-dequantize with per-group scales and ARBITRARY bit widths
+    (reference basic_layer.py QuantAct/LinearLayer_Compress quantize_weight;
+    ZeroQuant's group-wise quantization). STE gradients for QAT. Distinct
+    from ops/quantizer.fake_quantize, which covers the packed-storage 4/8-bit
+    formats with block (not group-count) semantics."""
     if bits >= 32:
         return w
     orig_shape = w.shape
@@ -39,7 +41,7 @@ def fake_quantize(w: jax.Array, bits: int = 8, symmetric: bool = True,
 def quantize_activation(x: jax.Array, bits: int = 8,
                         symmetric: bool = False) -> jax.Array:
     """Dynamic per-tensor activation fake-quant (reference QuantAct)."""
-    return fake_quantize(x, bits=bits, symmetric=symmetric, num_groups=1)
+    return group_fake_quantize(x, bits=bits, symmetric=symmetric, num_groups=1)
 
 
 def magnitude_prune_mask(w: jax.Array, dense_ratio: float) -> jax.Array:

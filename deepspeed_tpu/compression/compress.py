@@ -15,8 +15,8 @@ import jax
 import jax.numpy as jnp
 
 from ..utils.logging import logger
-from .basic_ops import (fake_quantize, head_prune_mask, magnitude_prune_mask,
-                        row_prune_mask)
+from .basic_ops import (group_fake_quantize, head_prune_mask,
+                        magnitude_prune_mask, row_prune_mask)
 from .config import CompressionConfig, TechniqueGroup
 
 Pytree = Any
@@ -25,14 +25,16 @@ Pytree = Any
 def _leaf_transform(w, groups: list[TechniqueGroup], step):
     for g in groups:
         p = g.params
+        if w.ndim < 2:
+            # biases / norm scales stay untouched by every weight technique
+            # (the reference compresses Linear weights only; substring
+            # patterns like 'ffn' would otherwise also hit ln_ffn scales)
+            continue
         if g.technique == "weight_quantization":
-            if w.ndim < 2:
-                continue  # biases/norm scales stay fp (reference quantizes
-                          # Linear weights only)
             qg = int(p.get("quantize_groups", 1))
             if w.size % qg:
                 qg = 1  # group count must divide the leaf; fall back
-            q = fake_quantize(
+            q = group_fake_quantize(
                 w, bits=int(p.get("start_bits", p.get("bits", 8))),
                 symmetric=p.get("quantization_type", "symmetric") == "symmetric",
                 num_groups=qg)
@@ -40,15 +42,18 @@ def _leaf_transform(w, groups: list[TechniqueGroup], step):
             q = w * magnitude_prune_mask(
                 w, float(p.get("dense_ratio", 0.5))).astype(w.dtype)
         elif g.technique == "row_pruning":
+            # reference rows = OUTPUT neurons of torch [out, in] weights;
+            # flax kernels are [in, out] → output dim is the LAST axis
             q = w * row_prune_mask(
-                w, float(p.get("dense_ratio", 0.5))).astype(w.dtype)
+                w, float(p.get("dense_ratio", 0.5)), axis=w.ndim - 1).astype(w.dtype)
         elif g.technique == "head_pruning":
             q = w * head_prune_mask(
                 w, float(p.get("dense_ratio", 0.5)),
                 num_heads=int(p["num_heads"])).astype(w.dtype)
         elif g.technique == "channel_pruning":
+            # channels = INPUT features → first axis of flax kernels
             q = w * row_prune_mask(
-                w, float(p.get("dense_ratio", 0.5)), axis=w.ndim - 1).astype(w.dtype)
+                w, float(p.get("dense_ratio", 0.5)), axis=0).astype(w.dtype)
         else:  # activation_quantization handled at the model level
             continue
         # schedule gating is dynamic so one compiled step serves all phases
@@ -87,7 +92,7 @@ class CompressionManager:
         """Bake the transforms in (masks/quant become the stored values) and
         apply layer reduction."""
         step = step if step is not None else 1 << 30  # everything active
-        params = jax.tree.map(lambda x: x, self.transform_params(params, step))
+        params = self.transform_params(params, step)
         lr = self.config.layer_reduction
         if lr.enabled:
             params = apply_layer_reduction(params, lr)

@@ -34,13 +34,6 @@ class TechniqueGroup:
                 pass
         return False
 
-    def active(self, step: int) -> bool:
-        if step < self.schedule_offset:
-            return False
-        if self.schedule_offset_end is not None and step >= self.schedule_offset_end:
-            return False
-        return True
-
 
 @dataclass
 class LayerReductionConfig:
@@ -82,11 +75,21 @@ class CompressionConfig:
             offset = int(shared.get("schedule_offset", 0))
             offset_end = shared.get("schedule_offset_end")
             for gname, g in sec.get("different_groups", {}).items():
-                gp = dict(g.get("params", {}))
+                unknown = set(g) - {"params", "modules", "schedule_offset",
+                                    "related_modules"}
+                if unknown:
+                    raise ValueError(
+                        f"compression group '{tech}.{gname}': unknown keys "
+                        f"{sorted(unknown)} (a typo like 'module' would "
+                        f"silently compress everything)")
+                if "modules" not in g:
+                    logger.warning(f"compression group '{tech}.{gname}' has "
+                                   f"no 'modules' list — applying to ALL "
+                                   f"matching-rank weights")
                 cfg.groups.append(TechniqueGroup(
                     technique=tech,
                     modules=list(g.get("modules", ["*"])),
-                    params=gp,
+                    params=dict(g.get("params", {})),
                     schedule_offset=int(g.get("schedule_offset", offset)),
                     schedule_offset_end=(int(offset_end)
                                          if offset_end is not None else None)))

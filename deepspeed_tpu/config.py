@@ -239,6 +239,19 @@ class CheckpointConfig:
 # --------------------------------------------------------------------------
 
 @dataclass
+class HybridEngineConfig:
+    """Reference: hybrid_engine section (runtime/hybrid_engine.py:32) — the
+    RLHF train+generate engine flip."""
+    enabled: bool = False
+    max_out_tokens: int = 512
+    inference_tp_size: int = 1
+    release_inference_cache: bool = False
+
+    # GPU-memory knobs with no TPU meaning; accepted + logged, not fields
+    _IGNORED_KEYS = ("pin_parameters", "tp_gather_partition_size")
+
+
+@dataclass
 class DataEfficiencyConfig:
     """Reference: runtime/data_pipeline config surface (data_efficiency
     section with data_sampling.curriculum_learning + data_routing.random_ltd;
@@ -265,7 +278,7 @@ _TOP_LEVEL_IGNORED = (
     # GPU-only / not-applicable sections accepted for config compat:
     "amp", "apex", "cuda_graphs", "communication_data_type", "disable_allgather",
     "sparse_gradients", "prescale_gradients", "gradient_predivide_factor",
-    "dump_state", "elasticity", "nebula", "hybrid_engine", "compression_training",
+    "dump_state", "elasticity", "nebula", "compression_training",
     "aio", "autotuning",
     "zero_force_ds_cpu_optimizer", "checkpoint_parallel_write_pipeline",
     "memory_breakdown", "use_data_before_expert_parallel_",
@@ -305,6 +318,8 @@ class Config:
     checkpoint: CheckpointConfig = field(default_factory=CheckpointConfig)
     data_efficiency: DataEfficiencyConfig = field(
         default_factory=DataEfficiencyConfig)
+    hybrid_engine: HybridEngineConfig = field(
+        default_factory=HybridEngineConfig)
 
     # ------------------------------------------------------------------
     @classmethod
@@ -339,6 +354,7 @@ class Config:
             "data_types": DataTypesConfig,
             "checkpoint": CheckpointConfig,
             "data_efficiency": DataEfficiencyConfig,
+            "hybrid_engine": HybridEngineConfig,
         }
         kwargs: dict[str, Any] = {}
         for key, sub_cls in sections.items():

@@ -1,0 +1,11 @@
+"""Optimized LoRA linear layers (reference deepspeed/linear/:
+optimized_linear.py:18 `OptimizedLinear`, config.py `LoRAConfig` /
+`QuantizationConfig`, quantization.py `QuantizedParameter`).
+"""
+from .config import LoRAConfig, QuantizationConfig  # noqa: F401
+from .optimized_linear import (  # noqa: F401
+    LoRAOptimizedLinear,
+    OptimizedLinear,
+    lora_merge,
+    lora_param_filter,
+)

@@ -1,0 +1,26 @@
+"""Configs for the optimized linear layer (reference
+deepspeed/linear/config.py `LoRAConfig` / `QuantizationConfig`)."""
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+
+@dataclass
+class LoRAConfig:
+    """reference config.py LoRAConfig: lora_r rank, lora_alpha scale,
+    base_weight_sharding = how many ways the frozen base weight shards
+    (over the fsdp axis here)."""
+    lora_r: int = 64
+    lora_alpha: float = 16.0
+    base_weight_sharding: int = 1
+
+
+@dataclass
+class QuantizationConfig:
+    """reference config.py QuantizationConfig: q_bits storage width for the
+    frozen base weight (ops/quantizer.py handles 4/6/8-bit int and fp
+    formats), group_size = quantization block."""
+    q_bits: int = 8
+    mantissa_bits: int = 3
+    group_size: int = 512
+    fp_quantize: bool = False  # fp8/fp6 codes instead of int affine

@@ -1,0 +1,189 @@
+"""LoRA-optimized linear layer (reference
+deepspeed/linear/optimized_linear.py:18 `OptimizedLinear` — a drop-in Linear
+whose frozen base weight is sharded + quantized and whose trainable state is
+a pair of low-rank adapters).
+
+Flax/TPU shape of the same idea:
+- the base kernel is a regular param that the layer FREEZES with
+  ``stop_gradient`` (optimizer updates become zero for it; pair with
+  ``lora_param_filter`` masks to also drop its optimizer state);
+- quantized storage uses ops/quantizer.py blockwise int4/int8/fp formats and
+  dequantizes on the fly inside the matmul (the reference's
+  QuantizedParameter does the same on CUDA);
+- ``base_weight_sharding`` maps to sharding the kernel over the ``fsdp``
+  axis — expressed through flax partitioning metadata so the ZeRO planner
+  places it (the reference hand-rolls an all-gather, linear/optimized_linear.py
+  forward).
+"""
+from __future__ import annotations
+
+from typing import Any, Callable
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+from ..ops.quantizer import dequantize, fake_quantize, fp_quantize, quantize
+from .config import LoRAConfig, QuantizationConfig
+
+
+class OptimizedLinear(nn.Module):
+    """Factory matching the reference's class-level dispatch
+    (optimized_linear.py:18 __new__): plain Linear without a LoRA config,
+    LoRAOptimizedLinear with one."""
+
+    output_dim: int
+    lora_config: LoRAConfig | None = None
+    quantization_config: QuantizationConfig | None = None
+    use_bias: bool = False
+    dtype: Any = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, x):
+        if self.lora_config is None:
+            return nn.Dense(self.output_dim, use_bias=self.use_bias,
+                            dtype=self.dtype, name="linear")(x)
+        return LoRAOptimizedLinear(
+            output_dim=self.output_dim, lora_config=self.lora_config,
+            quantization_config=self.quantization_config,
+            use_bias=self.use_bias, dtype=self.dtype, name="lora_linear")(x)
+
+
+class LoRAOptimizedLinear(nn.Module):
+    output_dim: int
+    lora_config: LoRAConfig = None  # type: ignore[assignment]
+    quantization_config: QuantizationConfig | None = None
+    use_bias: bool = False
+    dtype: Any = jnp.bfloat16
+    kernel_init: Callable = nn.initializers.lecun_normal()
+
+    @nn.compact
+    def __call__(self, x):
+        cfg = self.lora_config
+        in_dim = x.shape[-1]
+        if cfg.base_weight_sharding > 1:
+            # partitioning metadata routes the frozen base weight onto the
+            # fsdp axis; the ZeRO planner/XLA insert the gather (reference
+            # hand-rolls an all-gather in forward)
+            init = nn.with_partitioning(self.kernel_init, ("fsdp", None))
+        else:
+            init = self.kernel_init
+        base = self.param("base_weight", init,
+                          (in_dim, self.output_dim), jnp.float32)
+        base = jax.lax.stop_gradient(base)  # frozen (reference: requires_grad=False)
+
+        q = self.quantization_config
+        if q is not None:
+            # QAT-style storage emulation under jit: the matmul consumes the
+            # dequantized codes, so accuracy matches the quantized deploy
+            # path (true packed storage is applied by `quantize_base_params`
+            # at save/serve time).
+            if q.fp_quantize:
+                base = fp_dequant_passthrough(base, q)
+            else:
+                base = fake_quantize(base, bits=q.q_bits,
+                                     block_size=q.group_size)
+            base = jax.lax.stop_gradient(base)
+
+        # low-rank adapters (trainable); reference init: a ~ N, b = 0 so the
+        # layer starts exactly at the base behavior
+        lora_a = self.param("lora_a", nn.initializers.lecun_normal(),
+                            (in_dim, cfg.lora_r), jnp.float32)
+        lora_b = self.param("lora_b", nn.initializers.zeros,
+                            (cfg.lora_r, self.output_dim), jnp.float32)
+        # α/r travels WITH the params (frozen scalar) so lora_merge always
+        # fuses with the exact training scale
+        scale = jax.lax.stop_gradient(self.param(
+            "lora_scale",
+            lambda _: jnp.asarray(cfg.lora_alpha / cfg.lora_r, jnp.float32)))
+
+        y = x @ base.astype(self.dtype)
+        y = y + scale.astype(self.dtype) * (
+            (x @ lora_a.astype(self.dtype)) @ lora_b.astype(self.dtype))
+        if self.use_bias:
+            bias = self.param("bias", nn.initializers.zeros,
+                              (self.output_dim,), jnp.float32)
+            y = y + bias.astype(self.dtype)
+        return y
+
+
+def fp_dequant_passthrough(w: jax.Array, q: QuantizationConfig) -> jax.Array:
+    qt = fp_quantize(w, bits=q.q_bits, block_size=q.group_size)
+    from ..ops.quantizer import fp_dequantize
+
+    return fp_dequantize(qt).astype(w.dtype)
+
+
+# ---------------------------------------------------------------------------
+def lora_param_filter(path_key: str) -> bool:
+    """True for trainable LoRA params — use with optax.masked / the engine's
+    frozen-param support to drop optimizer state for the frozen base
+    (reference: only lora_a/lora_b have requires_grad)."""
+    if "lora_scale" in path_key:
+        return False  # frozen scale constant
+    return "lora_a" in path_key or "lora_b" in path_key or "bias" in path_key
+
+
+def lora_merge(params: Any, alpha_over_r: float | None = None) -> Any:
+    """Fold adapters into the base weight (the reference hybrid-engine
+    fuse_lora step, runtime/hybrid_engine.py:138): base += (α/r)·a@b, and
+    the adapters reset (a stays, b zeroes) so training can continue. The
+    scale comes from the layer's stored ``lora_scale`` (the exact training
+    value) unless overridden."""
+
+    def merge(tree):
+        if isinstance(tree, dict) and {"base_weight", "lora_a", "lora_b"} <= set(tree):
+            a, b = tree["lora_a"], tree["lora_b"]
+            if alpha_over_r is not None:
+                scale = alpha_over_r
+            elif "lora_scale" in tree:
+                scale = tree["lora_scale"]
+            else:
+                scale = 16.0 / a.shape[-1]  # LoRAConfig defaults
+            new = dict(tree)
+            new["base_weight"] = tree["base_weight"] + scale * (a @ b)
+            new["lora_b"] = jnp.zeros_like(b)
+            return new
+        if isinstance(tree, dict):
+            return {k: merge(v) for k, v in tree.items()}
+        return tree
+
+    return merge(params)
+
+
+def quantize_base_params(params: Any, q: QuantizationConfig) -> Any:
+    """Pack every frozen base_weight into true quantized storage
+    (QuantizedTensor pytree nodes) for serving/checkpoint size — the
+    reference QuantizedParameter's storage form."""
+
+    def walk(tree):
+        if isinstance(tree, dict):
+            out = {}
+            for k, v in tree.items():
+                if k == "base_weight":
+                    out[k] = (fp_quantize(v, bits=q.q_bits, block_size=q.group_size)
+                              if q.fp_quantize else
+                              quantize(v, bits=q.q_bits, block_size=q.group_size))
+                else:
+                    out[k] = walk(v)
+            return out
+        return tree
+
+    return walk(params)
+
+
+def dequantize_base_params(params: Any) -> Any:
+    """Inverse of quantize_base_params. int storage carries int8 codes (or
+    nibble-packed int4 with bits==4); everything else is an fp format."""
+    from ..ops.quantizer import QuantizedTensor, fp_dequantize
+
+    def walk(tree):
+        if isinstance(tree, QuantizedTensor):
+            is_int = tree.data.dtype == jnp.int8 or (
+                tree.bits == 4 and tree.data.dtype == jnp.uint8)
+            return dequantize(tree) if is_int else fp_dequantize(tree)
+        if isinstance(tree, dict):
+            return {k: walk(v) for k, v in tree.items()}
+        return tree
+
+    return walk(params)

@@ -899,7 +899,12 @@ def initialize(model: nn.Module | None = None,
     ``(engine, optimizer, dataloader, lr_scheduler)`` for signature parity —
     dataloader is None unless you use ``runtime.data.DataLoader``."""
     cfg = Config.load(config)
-    engine = DeepSpeedEngine(config=cfg, model=model, loss_fn=loss_fn, params=params,
-                             topology=topology, sample_batch=sample_batch, rng=rng,
-                             **kwargs)
+    engine_cls = DeepSpeedEngine
+    if cfg.hybrid_engine.enabled:
+        from .hybrid_engine import DeepSpeedHybridEngine
+
+        engine_cls = DeepSpeedHybridEngine
+    engine = engine_cls(config=cfg, model=model, loss_fn=loss_fn, params=params,
+                        topology=topology, sample_batch=sample_batch, rng=rng,
+                        **kwargs)
     return engine, engine.optimizer, None, engine.lr_schedule

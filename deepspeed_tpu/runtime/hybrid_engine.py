@@ -1,0 +1,109 @@
+"""Hybrid engine: ZeRO training + shared-weight generation for RLHF
+(reference deepspeed/runtime/hybrid_engine.py:32 `DeepSpeedHybridEngine`).
+
+The reference flips each module between a ZeRO-3-sharded training form and
+an injected-kernel inference form, gathering weights and fusing LoRA before
+`generate` (:174, containers :280, LoRA fuse/unfuse :138-160). Here the
+same flip is a program/sharding change, not a module change:
+
+- training programs keep the ZeRO plan;
+- `generate()` hands the CURRENT training params (LoRA-fused on the fly
+  when adapters are present) to a jitted KV-cache decode program built on
+  the same mesh (inference/engine.py). No persistent second weight copy:
+  the fused/gathered form lives only for the call.
+"""
+from __future__ import annotations
+
+import time
+from typing import Any
+
+import jax
+
+from ..utils.logging import logger
+from .engine import DeepSpeedEngine
+
+Pytree = Any
+
+
+def _has_lora(params: Pytree) -> bool:
+    found = False
+
+    def visit(path, leaf):
+        nonlocal found
+        if "lora_a" in jax.tree_util.keystr(path):
+            found = True
+        return leaf
+
+    jax.tree_util.tree_map_with_path(visit, params)
+    return found
+
+
+class DeepSpeedHybridEngine(DeepSpeedEngine):
+    def __init__(self, *args, **kwargs):
+        super().__init__(*args, **kwargs)
+        self._infer = None
+        self._lora_present: bool | None = None
+        # generation latency bookkeeping (reference hybrid_engine
+        # _generate_latency / inference timers)
+        self.generate_time = 0.0
+        self.generate_calls = 0
+
+    # -- inference program bring-up (lazy; reference :280) ---------------
+    def _ensure_inference(self):
+        if self._infer is not None:
+            return
+        from ..inference.engine import InferenceEngine
+
+        self._infer = InferenceEngine(
+            self.model, params=self.state.params,
+            config={"dtype": self.compute_dtype,
+                    "max_seq_len": getattr(self.model.config, "max_seq_len", 2048)},
+            topology=self.topology)
+        # no persistent second weight copy: generate() hands in the live
+        # (possibly LoRA-fused) params per call and clears the reference
+        self._infer.params = None
+        logger.info("hybrid engine: inference programs attached "
+                    "(shared mesh, shared weights)")
+
+    def _generation_params(self) -> Pytree:
+        """Current training weights, LoRA-fused for the duration of the call
+        (reference fuse_lora :138; the unfused originals stay in
+        self.state, so 'unfuse' is free)."""
+        params = self.state.params
+        if self._lora_present is None:
+            self._lora_present = _has_lora(params)
+        if self._lora_present:
+            from ..linear import lora_merge
+
+            params = lora_merge(params)
+        return params
+
+    # -- RLHF API --------------------------------------------------------
+    def generate(self, input_ids, max_new_tokens: int = 32, **kw) -> jax.Array:
+        """Generation with the live training weights (reference :174)."""
+        self._ensure_inference()
+        t0 = time.perf_counter()
+        self._infer.params = self._generation_params()
+        try:
+            out = self._infer.generate(input_ids,
+                                       max_new_tokens=max_new_tokens, **kw)
+            out.block_until_ready()
+        finally:
+            self._infer.params = None  # drop the fused copy immediately
+        self.generate_time += time.perf_counter() - t0
+        self.generate_calls += 1
+        return out
+
+    def eval(self):
+        """Mode markers for API parity (reference eval/train flip); programs
+        are immutable here, so these only gate bookkeeping."""
+        self._in_eval = True
+        return self
+
+    def train(self, mode: bool = True):
+        self._in_eval = not mode
+        return self
+
+    @property
+    def generate_latency(self) -> float:
+        return self.generate_time / max(1, self.generate_calls)

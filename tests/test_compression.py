@@ -7,7 +7,7 @@ import pytest
 
 import deepspeed_tpu as ds
 from deepspeed_tpu.compression import (CompressionConfig, CompressionManager,
-                                       fake_quantize, head_prune_mask,
+                                       group_fake_quantize, head_prune_mask,
                                        init_compression, magnitude_prune_mask,
                                        redundancy_clean, row_prune_mask)
 from deepspeed_tpu.models import build_model
@@ -18,7 +18,7 @@ def test_fake_quantize_levels_and_error():
     rng = np.random.default_rng(0)
     w = jnp.asarray(rng.standard_normal((64, 64)), jnp.float32)
     for bits in (8, 4):
-        q = fake_quantize(w, bits=bits, symmetric=True, num_groups=4)
+        q = group_fake_quantize(w, bits=bits, symmetric=True, num_groups=4)
         # per-group level count bounded by 2^bits
         levels = len(np.unique(np.asarray(q).reshape(4, -1)[0]))
         assert levels <= 2 ** bits
@@ -27,14 +27,14 @@ def test_fake_quantize_levels_and_error():
         assert err <= scale  # rounding error bounded by one step
     # asymmetric handles shifted ranges better
     w_shift = w + 5.0
-    qa = fake_quantize(w_shift, bits=4, symmetric=False)
-    qs = fake_quantize(w_shift, bits=4, symmetric=True)
+    qa = group_fake_quantize(w_shift, bits=4, symmetric=False)
+    qs = group_fake_quantize(w_shift, bits=4, symmetric=True)
     assert float(jnp.abs(qa - w_shift).mean()) < float(jnp.abs(qs - w_shift).mean())
 
 
 def test_fake_quantize_ste_gradient():
     w = jnp.linspace(-1, 1, 32)
-    g = jax.grad(lambda x: jnp.sum(fake_quantize(x, bits=4) * 3.0))(w)
+    g = jax.grad(lambda x: jnp.sum(group_fake_quantize(x, bits=4) * 3.0))(w)
     np.testing.assert_allclose(np.asarray(g), 3.0)  # identity through STE
 
 

@@ -1,0 +1,129 @@
+"""OptimizedLinear / LoRA tests (reference tests/unit/linear/test_linear.py,
+test_quant_param.py analogues)."""
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from deepspeed_tpu.linear import (LoRAConfig, LoRAOptimizedLinear,
+                                  OptimizedLinear, QuantizationConfig,
+                                  lora_merge, lora_param_filter)
+from deepspeed_tpu.linear.optimized_linear import (dequantize_base_params,
+                                                   quantize_base_params)
+
+
+def _init(module, x):
+    return module.init(jax.random.PRNGKey(0), x)["params"]
+
+
+def test_plain_linear_without_lora():
+    m = OptimizedLinear(output_dim=8)
+    x = jnp.ones((2, 4), jnp.bfloat16)
+    p = _init(m, x)
+    assert "linear" in p
+    assert m.apply({"params": p}, x).shape == (2, 8)
+
+
+def test_lora_starts_at_base_behavior():
+    """b init to zero → LoRA layer output equals frozen-base matmul."""
+    cfg = LoRAConfig(lora_r=4, lora_alpha=8)
+    m = OptimizedLinear(output_dim=8, lora_config=cfg)
+    x = jnp.asarray(np.random.default_rng(0).standard_normal((2, 16)),
+                    jnp.bfloat16)
+    p = _init(m, x)
+    lp = p["lora_linear"]
+    assert lp["lora_b"].shape == (4, 8) and np.all(np.asarray(lp["lora_b"]) == 0)
+    y = m.apply({"params": p}, x)
+    base_y = x @ np.asarray(lp["base_weight"]).astype(jnp.bfloat16)
+    np.testing.assert_allclose(np.asarray(y, np.float32),
+                               np.asarray(base_y, np.float32), rtol=1e-2)
+
+
+def test_base_frozen_lora_trains():
+    cfg = LoRAConfig(lora_r=4, lora_alpha=8)
+    m = OptimizedLinear(output_dim=8, lora_config=cfg)
+    x = jnp.asarray(np.random.default_rng(1).standard_normal((4, 16)),
+                    jnp.bfloat16)
+    p = _init(m, x)
+
+    def loss(params):
+        return jnp.sum(m.apply({"params": params}, x).astype(jnp.float32) ** 2)
+
+    g = jax.grad(loss)(p)
+    gl = g["lora_linear"]
+    assert np.all(np.asarray(gl["base_weight"]) == 0)      # frozen
+    assert np.abs(np.asarray(gl["lora_a"])).sum() == 0     # b=0 → a grad 0 at init
+    assert np.abs(np.asarray(gl["lora_b"])).sum() > 0      # b learns immediately
+
+
+def test_quantized_base_path():
+    cfg = LoRAConfig(lora_r=4)
+    q = QuantizationConfig(q_bits=8, group_size=64)
+    m = OptimizedLinear(output_dim=8, lora_config=cfg, quantization_config=q)
+    x = jnp.asarray(np.random.default_rng(2).standard_normal((2, 16)),
+                    jnp.bfloat16)
+    p = _init(m, x)
+    y = m.apply({"params": p}, x)
+    assert np.isfinite(np.asarray(y, np.float32)).all()
+    # quantization changes the forward slightly vs unquantized base
+    m0 = OptimizedLinear(output_dim=8, lora_config=cfg)
+    y0 = m0.apply({"params": p}, x)
+    assert not np.array_equal(np.asarray(y), np.asarray(y0))
+    np.testing.assert_allclose(np.asarray(y, np.float32),
+                               np.asarray(y0, np.float32), atol=0.2)
+
+
+@pytest.mark.parametrize("q", [
+    QuantizationConfig(q_bits=8, group_size=64),
+    QuantizationConfig(q_bits=4, group_size=64),
+    QuantizationConfig(q_bits=8, group_size=64, fp_quantize=True),
+])
+def test_quantize_base_params_storage_roundtrip(q):
+    rng = np.random.default_rng(3)
+    params = {"layer": {"lora_linear": {
+        "base_weight": jnp.asarray(rng.standard_normal((32, 16)), jnp.float32),
+        "lora_a": jnp.ones((32, 4)), "lora_b": jnp.zeros((4, 16))}}}
+    packed = quantize_base_params(params, q)
+    qt = packed["layer"]["lora_linear"]["base_weight"]
+    assert qt.nbytes < params["layer"]["lora_linear"]["base_weight"].nbytes
+    restored = dequantize_base_params(packed)
+    w0 = np.asarray(params["layer"]["lora_linear"]["base_weight"])
+    w1 = np.asarray(restored["layer"]["lora_linear"]["base_weight"], np.float32)
+    tol = 0.03 if q.q_bits == 8 and not q.fp_quantize else 0.45
+    assert np.abs(w0 - w1).max() < tol
+    # adapters untouched
+    np.testing.assert_array_equal(
+        np.asarray(restored["layer"]["lora_linear"]["lora_a"]), 1.0)
+
+
+def test_lora_merge_folds_adapters():
+    rng = np.random.default_rng(4)
+    r, alpha = 4, 8.0
+    tree = {"lora_linear": {
+        "base_weight": jnp.asarray(rng.standard_normal((16, 8)), jnp.float32),
+        "lora_a": jnp.asarray(rng.standard_normal((16, r)), jnp.float32),
+        "lora_b": jnp.asarray(rng.standard_normal((r, 8)), jnp.float32),
+        "lora_scale": jnp.asarray(alpha / r, jnp.float32)}}
+    merged = lora_merge(tree)  # scale read from the stored lora_scale
+    lin = tree["lora_linear"]
+    expect = np.asarray(lin["base_weight"]) + (alpha / r) * (
+        np.asarray(lin["lora_a"]) @ np.asarray(lin["lora_b"]))
+    np.testing.assert_allclose(
+        np.asarray(merged["lora_linear"]["base_weight"]), expect,
+        rtol=1e-5, atol=1e-6)
+    assert np.all(np.asarray(merged["lora_linear"]["lora_b"]) == 0)
+    # merged forward == pre-merge forward
+    cfg = LoRAConfig(lora_r=r, lora_alpha=alpha)
+    m = OptimizedLinear(output_dim=8, lora_config=cfg)
+    x = jnp.asarray(rng.standard_normal((2, 16)), jnp.bfloat16)
+    y_before = m.apply({"params": tree}, x)
+    y_after = m.apply({"params": merged}, x)
+    # bf16 compute: one fp32-merged matmul vs two bf16 matmuls → ~1% drift
+    np.testing.assert_allclose(np.asarray(y_before, np.float32),
+                               np.asarray(y_after, np.float32),
+                               rtol=0.05, atol=0.1)
+
+
+def test_lora_param_filter():
+    assert lora_param_filter("['layer']['lora_linear']['lora_a']")
+    assert not lora_param_filter("['layer']['lora_linear']['base_weight']")
