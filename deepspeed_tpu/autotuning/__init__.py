@@ -1,0 +1,17 @@
+"""Autotuning (reference deepspeed/autotuning/autotuner.py:42 `Autotuner`).
+
+The reference launches real training experiments per candidate (ZeRO stage ×
+micro-batch × ...) and needs a scheduler + resource manager because each
+trial costs GPU-hours and can OOM. On TPU the XLA AOT pipeline gives most of
+the answer without running: compiling a candidate train step yields its
+exact peak memory (``compiled.memory_analysis()``) and FLOPs/bytes
+(``cost_analysis()``), so infeasible configs are eliminated and survivors
+ranked by a roofline model — with an optional measured mode that runs the
+few top candidates for wall-clock truth.
+"""
+from .autotuner import (  # noqa: F401
+    Autotuner,
+    CandidateResult,
+    autotune,
+)
+from .tuner import GridSearchTuner, ModelBasedTuner, RandomTuner  # noqa: F401

@@ -1,0 +1,78 @@
+"""Search strategies (reference deepspeed/autotuning/tuner/:
+GridSearchTuner / RandomTuner index_based_tuner.py:27,11; ModelBasedTuner +
+XGBoostCostModel model_based_tuner.py:19, cost_model.py:14).
+
+A tuner proposes which candidates to evaluate next given results so far.
+The model-based tuner fits a least-squares cost model on the evaluated
+points' features instead of XGBoost (no heavyweight dependency; the feature
+space is tiny)."""
+from __future__ import annotations
+
+import random
+from typing import Any, Callable, Sequence
+
+import numpy as np
+
+
+class BaseTuner:
+    def __init__(self, candidates: Sequence[dict], seed: int = 0):
+        self.candidates = list(candidates)
+        self.seed = seed
+
+    def order(self, results: list[tuple[dict, float]] | None = None
+              ) -> list[dict]:
+        """Full evaluation order (may depend on results seen so far)."""
+        raise NotImplementedError
+
+
+class GridSearchTuner(BaseTuner):
+    """Exhaustive, in declaration order (reference index_based_tuner.py:27)."""
+
+    def order(self, results=None):
+        return list(self.candidates)
+
+
+class RandomTuner(BaseTuner):
+    """Uniform shuffle (reference index_based_tuner.py:11)."""
+
+    def order(self, results=None):
+        out = list(self.candidates)
+        random.Random(self.seed).shuffle(out)
+        return out
+
+
+class ModelBasedTuner(BaseTuner):
+    """Cost-model-guided (reference model_based_tuner.py:19): evaluate a
+    warmup subset, fit cost ~ features, then visit remaining candidates in
+    predicted-best order."""
+
+    def __init__(self, candidates, featurize: Callable[[dict], Sequence[float]],
+                 warmup: int = 3, seed: int = 0):
+        super().__init__(candidates, seed)
+        self.featurize = featurize
+        self.warmup = warmup
+
+    def _fit(self, results: list[tuple[dict, float]]):
+        X = np.array([[1.0, *self.featurize(c)] for c, _ in results])
+        y = np.array([v for _, v in results])
+        coef, *_ = np.linalg.lstsq(X, y, rcond=None)
+        return coef
+
+    def order(self, results=None):
+        results = results or []
+        if len(results) < self.warmup or len(results) < 2:
+            return RandomTuner(self.candidates, self.seed).order()
+        coef = self._fit(results)
+        seen = {id(c) for c, _ in results}
+
+        def predict(c):
+            return float(np.dot([1.0, *self.featurize(c)], coef))
+
+        rest = [c for c in self.candidates if id(c) not in seen]
+        done = [c for c, _ in results]
+        # ascending: predicted-FASTEST first (predict estimates step time)
+        return done + sorted(rest, key=predict)
+
+
+TUNERS = {"gridsearch": GridSearchTuner, "random": RandomTuner,
+          "model_based": ModelBasedTuner}

@@ -70,7 +70,7 @@ class InferenceEngine:
     def __init__(self, model: TransformerLM, params: Pytree | None = None,
                  config: InferenceConfig | dict | None = None,
                  topology: MeshTopology | None = None,
-                 rng: jax.Array | None = None):
+                 rng: jax.Array | None = None, materialize: bool = True):
         self.model = model
         self.config = InferenceConfig.load(config)
         mcfg = model.config
@@ -84,7 +84,8 @@ class InferenceEngine:
         from .weights import load_tp_params
 
         self.params, self.plan = load_tp_params(model, params, rng, topology,
-                                                self.config.dtype)
+                                                self.config.dtype,
+                                                materialize=materialize)
 
         self._decode_fns: dict[tuple, Any] = {}
         self._fwd = jax.jit(self._forward_impl)

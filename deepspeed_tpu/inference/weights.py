@@ -16,9 +16,11 @@ Pytree = Any
 
 
 def load_tp_params(model, params: Pytree | None, rng: jax.Array | None,
-                   topology, dtype) -> tuple[Pytree, Any]:
+                   topology, dtype, materialize: bool = True) -> tuple[Pytree, Any]:
     """Returns (sharded_params, plan). ``params=None`` → fresh init directly
-    into the sharded layout."""
+    into the sharded layout. ``materialize=False`` builds the plan only
+    (callers that supply weights per forward, e.g. the hybrid engine,
+    avoid an up-front cast+reshard copy)."""
     ids0 = jnp.zeros((1, 8), jnp.int32)
     rng = rng if rng is not None else jax.random.PRNGKey(0)
     if params is None:
@@ -26,6 +28,8 @@ def load_tp_params(model, params: Pytree | None, rng: jax.Array | None,
     else:
         abstract = params
     plan = build_plan(topology, ZeroConfig(stage=0), abstract)
+    if not materialize:
+        return None, plan
 
     def cast(t):
         return jax.tree.map(

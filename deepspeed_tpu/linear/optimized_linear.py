@@ -41,12 +41,46 @@ class OptimizedLinear(nn.Module):
     @nn.compact
     def __call__(self, x):
         if self.lora_config is None:
+            if self.quantization_config is not None:
+                return QuantizedLinear(
+                    output_dim=self.output_dim,
+                    quantization_config=self.quantization_config,
+                    use_bias=self.use_bias, dtype=self.dtype,
+                    name="quantized_linear")(x)
             return nn.Dense(self.output_dim, use_bias=self.use_bias,
                             dtype=self.dtype, name="linear")(x)
         return LoRAOptimizedLinear(
             output_dim=self.output_dim, lora_config=self.lora_config,
             quantization_config=self.quantization_config,
             use_bias=self.use_bias, dtype=self.dtype, name="lora_linear")(x)
+
+
+class QuantizedLinear(nn.Module):
+    """Quantization-only variant (reference quantization.py
+    QuantizedLinear): trainable kernel consumed through the fake-quant STE,
+    so training matches the quantized deploy numerics."""
+
+    output_dim: int
+    quantization_config: QuantizationConfig = None  # type: ignore[assignment]
+    use_bias: bool = False
+    dtype: Any = jnp.bfloat16
+    kernel_init: Callable = nn.initializers.lecun_normal()
+
+    @nn.compact
+    def __call__(self, x):
+        q = self.quantization_config
+        w = self.param("kernel", self.kernel_init,
+                       (x.shape[-1], self.output_dim), jnp.float32)
+        if q.fp_quantize:
+            wq = w + jax.lax.stop_gradient(fp_dequant_passthrough(w, q) - w)
+        else:
+            wq = fake_quantize(w, bits=q.q_bits, block_size=q.group_size)
+        y = x @ wq.astype(self.dtype)
+        if self.use_bias:
+            bias = self.param("bias", nn.initializers.zeros,
+                              (self.output_dim,), jnp.float32)
+            y = y + bias.astype(self.dtype)
+        return y
 
 
 class LoRAOptimizedLinear(nn.Module):

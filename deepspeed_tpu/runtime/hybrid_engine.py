@@ -41,6 +41,13 @@ def _has_lora(params: Pytree) -> bool:
 class DeepSpeedHybridEngine(DeepSpeedEngine):
     def __init__(self, *args, **kwargs):
         super().__init__(*args, **kwargs)
+        he = self.config.hybrid_engine
+        if he.inference_tp_size not in (1, self.topology.size("tensor")):
+            logger.warning(
+                f"hybrid_engine.inference_tp_size={he.inference_tp_size} is "
+                f"advisory here: generation runs on the TRAINING mesh "
+                f"(tensor={self.topology.size('tensor')}); set mesh.tensor "
+                f"to change it")
         self._infer = None
         self._lora_present: bool | None = None
         # generation latency bookkeeping (reference hybrid_engine
@@ -54,14 +61,13 @@ class DeepSpeedHybridEngine(DeepSpeedEngine):
             return
         from ..inference.engine import InferenceEngine
 
+        # materialize=False: plan only — no up-front cast/reshard copy;
+        # generate() hands in the live params per call
         self._infer = InferenceEngine(
             self.model, params=self.state.params,
             config={"dtype": self.compute_dtype,
                     "max_seq_len": getattr(self.model.config, "max_seq_len", 2048)},
-            topology=self.topology)
-        # no persistent second weight copy: generate() hands in the live
-        # (possibly LoRA-fused) params per call and clears the reference
-        self._infer.params = None
+            topology=self.topology, materialize=False)
         logger.info("hybrid engine: inference programs attached "
                     "(shared mesh, shared weights)")
 
@@ -76,11 +82,25 @@ class DeepSpeedHybridEngine(DeepSpeedEngine):
             from ..linear import lora_merge
 
             params = lora_merge(params)
+        want = self._infer.config.dtype
+        leaf0 = jax.tree.leaves(params)[0]
+        if leaf0.dtype != want:
+            import jax.numpy as jnp
+
+            params = jax.tree.map(
+                lambda x: x.astype(want)
+                if jnp.issubdtype(x.dtype, jnp.floating) else x, params)
         return params
 
     # -- RLHF API --------------------------------------------------------
     def generate(self, input_ids, max_new_tokens: int = 32, **kw) -> jax.Array:
-        """Generation with the live training weights (reference :174)."""
+        """Generation with the live training weights (reference :174).
+        ``hybrid_engine.max_out_tokens`` caps the generation length."""
+        cap = self.config.hybrid_engine.max_out_tokens
+        if max_new_tokens > cap:
+            logger.warning(f"max_new_tokens {max_new_tokens} capped to "
+                           f"hybrid_engine.max_out_tokens={cap}")
+            max_new_tokens = cap
         self._ensure_inference()
         t0 = time.perf_counter()
         self._infer.params = self._generation_params()
@@ -90,6 +110,8 @@ class DeepSpeedHybridEngine(DeepSpeedEngine):
             out.block_until_ready()
         finally:
             self._infer.params = None  # drop the fused copy immediately
+        if self.config.hybrid_engine.release_inference_cache:
+            self._infer._decode_fns.clear()
         self.generate_time += time.perf_counter() - t0
         self.generate_calls += 1
         return out

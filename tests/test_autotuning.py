@@ -1,0 +1,81 @@
+"""Autotuner tests (reference tests/unit/autotuning/test_autotuning.py
+analogue)."""
+import numpy as np
+import pytest
+
+import deepspeed_tpu as ds
+from deepspeed_tpu.autotuning import (Autotuner, GridSearchTuner,
+                                      ModelBasedTuner, RandomTuner, autotune)
+from deepspeed_tpu.models import build_model
+
+BASE = {"optimizer": {"type": "AdamW", "params": {"lr": 1e-3}}}
+
+
+def test_tuner_orders():
+    cands = [{"i": i} for i in range(6)]
+    assert GridSearchTuner(cands).order() == cands
+    r = RandomTuner(cands, seed=1).order()
+    assert sorted(r, key=lambda c: c["i"]) == cands and r != cands
+
+    mb = ModelBasedTuner(cands, featurize=lambda c: (float(c["i"]),), warmup=2)
+    # cost grows with i → predicted-FASTEST (lowest i) must come first
+    results = [(cands[5], 5.0), (cands[4], 4.0), (cands[3], 3.0)]
+    order = mb.order(results)
+    remaining = [c["i"] for c in order[3:]]
+    assert remaining == [0, 1, 2]
+
+
+def test_candidates_span_space():
+    at = Autotuner(build_model("tiny-gpt2"), BASE, max_micro_batch=4)
+    cands = at.candidates()
+    stages = {c["zero_optimization"]["stage"] for c in cands}
+    mbs = {c["train_micro_batch_size_per_gpu"] for c in cands}
+    assert stages == {0, 1, 2, 3} and mbs == {1, 2, 4}
+
+
+def test_evaluate_static_feasible():
+    at = Autotuner(build_model("tiny-gpt2"), BASE, max_micro_batch=2)
+    r = at.evaluate({"zero_optimization": {"stage": 1},
+                     "train_micro_batch_size_per_gpu": 2})
+    assert r.feasible, r.error
+    assert r.peak_bytes > 0 and r.flops > 0
+    assert np.isfinite(r.predicted_s) and r.predicted_s > 0
+
+
+def test_evaluate_detects_oom_without_running():
+    at = Autotuner(build_model("tiny-gpt2"), BASE,
+                   hbm_budget_bytes=1 << 20)  # 1 MB: nothing fits
+    r = at.evaluate({"zero_optimization": {"stage": 0},
+                     "train_micro_batch_size_per_gpu": 1})
+    assert not r.feasible
+    assert "peak" in (r.error or "")
+
+
+def test_tune_picks_feasible_best():
+    at = Autotuner(build_model("tiny-gpt2"), BASE, max_micro_batch=2,
+                   stages=(0, 2))
+    best = at.tune()
+    assert best.feasible
+    assert len(at.results) == 4
+    # best is optimal per-sample among feasible
+    per_sample = [r.predicted_s / r.overrides["train_micro_batch_size_per_gpu"]
+                  for r in at.results if r.feasible]
+    assert best.predicted_s / best.overrides["train_micro_batch_size_per_gpu"] \
+        == pytest.approx(min(per_sample))
+
+
+def test_autotune_returns_runnable_config():
+    cfg = autotune(build_model("tiny-gpt2"), BASE, max_micro_batch=2,
+                   stages=(1,))
+    engine, *_ = ds.initialize(model=build_model("tiny-gpt2"), config=cfg)
+    rng = np.random.default_rng(0)
+    gbs = engine.config.train_batch_size
+    loss = engine.train_batch({"input_ids": rng.integers(0, 256, (gbs, 32))})
+    assert np.isfinite(float(loss))
+
+
+def test_measured_mode():
+    at = Autotuner(build_model("tiny-gpt2"), BASE, max_micro_batch=1,
+                   stages=(0, 1))
+    best = at.tune(measure_top_k=1)
+    assert best.measured_s is not None and best.measured_s > 0

@@ -24,6 +24,25 @@ def test_plain_linear_without_lora():
     assert m.apply({"params": p}, x).shape == (2, 8)
 
 
+def test_quant_only_dispatch():
+    """quantization_config without LoRA routes to QuantizedLinear (the
+    reference dispatches the same way), not a silent full-precision Dense."""
+    q = QuantizationConfig(q_bits=4, group_size=64)
+    m = OptimizedLinear(output_dim=8, quantization_config=q)
+    x = jnp.asarray(np.random.default_rng(5).standard_normal((2, 16)),
+                    jnp.bfloat16)
+    p = _init(m, x)
+    assert "quantized_linear" in p
+    y = m.apply({"params": p}, x)
+    y_fp = x @ np.asarray(p["quantized_linear"]["kernel"]).astype(jnp.bfloat16)
+    # 4-bit quantization must actually perturb the output
+    assert not np.array_equal(np.asarray(y), np.asarray(y_fp))
+    # kernel still trains (STE)
+    g = jax.grad(lambda pp: jnp.sum(
+        m.apply({"params": pp}, x).astype(jnp.float32)))(p)
+    assert np.abs(np.asarray(g["quantized_linear"]["kernel"])).sum() > 0
+
+
 def test_lora_starts_at_base_behavior():
     """b init to zero → LoRA layer output equals frozen-base matmul."""
     cfg = LoRAConfig(lora_r=4, lora_alpha=8)
