@@ -1,0 +1,20 @@
+"""Offline checkpoint tooling (reference deepspeed/checkpoint/ +
+deepspeed/utils/zero_to_fp32.py).
+
+Checkpoints here are orbax/tensorstore global logical arrays, so the
+reference's reshape machinery (reshape_meg_2d.py, reshape_3d_utils.py) has
+no role — resharding happens at load. What remains useful offline:
+
+- ``zero_to_fp32``: consolidate a checkpoint into one framework-agnostic
+  fp32 numpy state dict (.npz) — the reference's
+  utils/zero_to_fp32.py `convert_zero_checkpoint_to_fp32_state_dict`;
+- ``ds_to_universal``: explode a checkpoint into per-parameter "atom"
+  files (.npy + index) — reference checkpoint/ds_to_universal.py:469;
+- ``UniversalCheckpoint``: read atoms back as a param tree.
+"""
+from .universal import (  # noqa: F401
+    UniversalCheckpoint,
+    ds_to_universal,
+    get_fp32_state_dict_from_zero_checkpoint,
+    zero_to_fp32,
+)

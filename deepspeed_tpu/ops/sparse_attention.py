@@ -1,0 +1,273 @@
+"""Block-sparse attention (reference deepspeed/ops/sparse_attention/:
+sparsity_config.py SparsityConfig variants, matmul.py/softmax.py Triton
+block-sparse kernels, sparse_self_attention.py `SparseSelfAttention`).
+
+The layout machinery ports 1:1 — each config emits a per-head block layout
+``[heads, nq_blocks, nk_blocks]`` of which key blocks each query block
+attends. The compute maps differently: the reference needs hand-written
+Triton SDD/DSD matmuls; here the layout expands to a block mask consumed by
+the fused XLA attention (additive -inf mask folds into the softmax), which
+the TPU fuses well at the sequence lengths the reference targets. A
+Pallas grid-pruned kernel (skipping masked blocks like the causal
+block-skip in ops/pallas/flash_attention.py) is the optimization path for
+very long sequences.
+"""
+from __future__ import annotations
+
+import random
+from dataclasses import dataclass, field
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+# ---------------------------------------------------------------------------
+# Sparsity configs (reference sparsity_config.py)
+# ---------------------------------------------------------------------------
+@dataclass
+class SparsityConfig:
+    """Base (reference :28): block size + head layout sharing."""
+    num_heads: int
+    block: int = 16
+    different_layout_per_head: bool = False
+
+    def setup_layout(self, seq_len: int) -> np.ndarray:
+        if seq_len % self.block:
+            raise ValueError(f"seq_len {seq_len} not divisible by block "
+                             f"{self.block}")
+        n = seq_len // self.block
+        heads = self.num_heads if self.different_layout_per_head else 1
+        return np.zeros((heads, n, n), dtype=np.int64)
+
+    def expand(self, layout: np.ndarray) -> np.ndarray:
+        if layout.shape[0] == 1 and self.num_heads > 1:
+            layout = np.broadcast_to(
+                layout, (self.num_heads, *layout.shape[1:]))
+        return layout
+
+    def make_layout(self, seq_len: int) -> np.ndarray:
+        raise NotImplementedError
+
+
+@dataclass
+class DenseSparsityConfig(SparsityConfig):
+    """All-ones layout (reference :148) — degenerates to full attention."""
+
+    def make_layout(self, seq_len: int) -> np.ndarray:
+        layout = self.setup_layout(seq_len)
+        layout[:] = 1
+        return self.expand(layout)
+
+
+@dataclass
+class FixedSparsityConfig(SparsityConfig):
+    """Fixed local+global pattern (reference :168, the Sparse Transformers
+    pattern): local windows of ``num_local_blocks``; the last
+    ``num_global_blocks`` of each window attend/are-attended globally."""
+    num_local_blocks: int = 4
+    num_global_blocks: int = 1
+    attention: str = "bidirectional"  # or "unidirectional"
+    horizontal_global_attention: bool = False
+
+    def make_layout(self, seq_len: int) -> np.ndarray:
+        layout = self.setup_layout(seq_len)
+        h, n, _ = layout.shape
+        L, G = self.num_local_blocks, self.num_global_blocks
+        for i in range(n):
+            w = i // L
+            # local window
+            lo, hi = w * L, min(n, (w + 1) * L)
+            if self.attention == "unidirectional":
+                hi = min(hi, i + 1)
+            layout[:, i, lo:hi] = 1
+            # global columns: last G blocks of every preceding window
+            for ww in range(0, n // L + 1):
+                g_lo = min(n, (ww + 1) * L - G)
+                g_hi = min(n, (ww + 1) * L)
+                if self.attention == "unidirectional" and g_lo > i:
+                    continue
+                layout[:, i, g_lo:min(g_hi, i + 1 if self.attention ==
+                                      "unidirectional" else g_hi)] = 1
+        if self.horizontal_global_attention:
+            for ww in range(0, n // L + 1):
+                g_lo = min(n, (ww + 1) * L - G)
+                g_hi = min(n, (ww + 1) * L)
+                layout[:, g_lo:g_hi, :] = 1
+                if self.attention == "unidirectional":
+                    for r in range(g_lo, g_hi):
+                        layout[:, r, r + 1:] = 0
+        return self.expand(layout)
+
+
+@dataclass
+class BigBirdSparsityConfig(SparsityConfig):
+    """random + sliding-window + global blocks (reference :462)."""
+    num_random_blocks: int = 1
+    num_sliding_window_blocks: int = 3
+    num_global_blocks: int = 1
+    attention: str = "bidirectional"
+    seed: int = 0
+
+    def make_layout(self, seq_len: int) -> np.ndarray:
+        layout = self.setup_layout(seq_len)
+        h, n, _ = layout.shape
+        rng = random.Random(self.seed)
+        half = self.num_sliding_window_blocks // 2
+        for head in range(h):
+            for i in range(n):
+                # sliding window
+                layout[head, i, max(0, i - half):min(n, i + half + 1)] = 1
+                # random blocks
+                limit = i + 1 if self.attention == "unidirectional" else n
+                if limit > 0:
+                    for _ in range(self.num_random_blocks):
+                        layout[head, i, rng.randrange(limit)] = 1
+        # global: first blocks row+column
+        g = self.num_global_blocks
+        layout[:, :g, :] = 1
+        layout[:, :, :g] = 1
+        if self.attention == "unidirectional":
+            layout &= np.tril(np.ones((n, n), dtype=np.int64))[None]
+        return self.expand(layout)
+
+
+@dataclass
+class BSLongformerSparsityConfig(SparsityConfig):
+    """sliding window + selected global rows/cols (reference :618)."""
+    num_sliding_window_blocks: int = 3
+    global_block_indices: list[int] = field(default_factory=lambda: [0])
+    global_block_end_indices: list[int] | None = None
+    attention: str = "bidirectional"
+
+    def make_layout(self, seq_len: int) -> np.ndarray:
+        layout = self.setup_layout(seq_len)
+        h, n, _ = layout.shape
+        half = self.num_sliding_window_blocks // 2
+        for i in range(n):
+            layout[:, i, max(0, i - half):min(n, i + half + 1)] = 1
+        if self.global_block_end_indices is None:
+            spans = [(i, i + 1) for i in self.global_block_indices]
+        else:
+            spans = list(zip(self.global_block_indices,
+                             self.global_block_end_indices))
+        for lo, hi in spans:
+            lo, hi = min(lo, n), min(hi, n)
+            layout[:, lo:hi, :] = 1
+            layout[:, :, lo:hi] = 1
+        if self.attention == "unidirectional":
+            layout &= np.tril(np.ones((n, n), dtype=np.int64))[None]
+        return self.expand(layout)
+
+
+@dataclass
+class VariableSparsityConfig(SparsityConfig):
+    """per-config local windows + custom global indices (reference :262)."""
+    num_random_blocks: int = 0
+    local_window_blocks: list[int] = field(default_factory=lambda: [4])
+    global_block_indices: list[int] = field(default_factory=lambda: [0])
+    global_block_end_indices: list[int] | None = None
+    attention: str = "bidirectional"
+    seed: int = 0
+
+    def make_layout(self, seq_len: int) -> np.ndarray:
+        layout = self.setup_layout(seq_len)
+        h, n, _ = layout.shape
+        # variable-size local windows, cycling the last size
+        i = 0
+        sizes = list(self.local_window_blocks)
+        while i < n:
+            size = sizes.pop(0) if sizes else self.local_window_blocks[-1]
+            lo, hi = i, min(n, i + size)
+            layout[:, lo:hi, lo:hi] = 1
+            i = hi
+        rng = random.Random(self.seed)
+        for head in range(h):
+            for r in range(n):
+                for _ in range(self.num_random_blocks):
+                    layout[head, r, rng.randrange(n)] = 1
+        if self.global_block_end_indices is None:
+            spans = [(g, g + 1) for g in self.global_block_indices]
+        else:
+            spans = list(zip(self.global_block_indices,
+                             self.global_block_end_indices))
+        for lo, hi in spans:
+            lo, hi = min(lo, n), min(hi, n)
+            layout[:, lo:hi, :] = 1
+            layout[:, :, lo:hi] = 1
+        if self.attention == "unidirectional":
+            layout &= np.tril(np.ones((n, n), dtype=np.int64))[None]
+        return self.expand(layout)
+
+
+SPARSITY_CONFIGS = {
+    "dense": DenseSparsityConfig,
+    "fixed": FixedSparsityConfig,
+    "bigbird": BigBirdSparsityConfig,
+    "bslongformer": BSLongformerSparsityConfig,
+    "variable": VariableSparsityConfig,
+}
+
+
+# ---------------------------------------------------------------------------
+# Attention over a block layout
+# ---------------------------------------------------------------------------
+def layout_to_mask(layout: np.ndarray, block: int) -> jax.Array:
+    """[H, nq, nk] block layout → [H, S, S] boolean attend-mask."""
+    m = jnp.asarray(layout, jnp.bool_)
+    return jnp.repeat(jnp.repeat(m, block, axis=1), block, axis=2)
+
+
+def block_sparse_attention(q, k, v, layout: np.ndarray, block: int,
+                           scale: float | None = None,
+                           causal: bool = False) -> jax.Array:
+    """Attention restricted to the layout's visible blocks.
+
+    q/k/v: [B, S, H, D]. The layout handles BLOCK-level visibility;
+    ``causal=True`` additionally applies the token-level triangular mask
+    inside visible blocks (the reference's Triton softmax does the same —
+    unidirectional layouts are block-granular). Fully-masked rows (possible
+    in exotic layouts) produce zeros rather than NaNs.
+    """
+    from .attention import dot_product_attention
+
+    B, S, H, D = q.shape
+    if scale is not None and abs(scale - D ** -0.5) > 1e-12:
+        q = q * (scale * D ** 0.5)  # fold a custom scale into q
+    mask = layout_to_mask(layout, block)           # [H, S, S]
+    if causal:
+        mask = mask & jnp.tril(jnp.ones((S, S), jnp.bool_))[None]
+    # delegate to the shared attention core (fp32 softmax, GQA, finite
+    # masking — masked logits use finfo.min, so even all-masked rows stay
+    # NaN-free in fwd AND bwd); zero those rows' outputs afterwards
+    out = dot_product_attention(q, k, v, causal=False, mask=mask[None],
+                                impl="xla")
+    row_any = mask.any(axis=-1)                    # [H, S]
+    return jnp.where(row_any.T[None, :, :, None], out, 0.0)
+
+
+class SparseSelfAttention:
+    """Module-level wrapper (reference sparse_self_attention.py
+    `SparseSelfAttention`): holds the config, builds/caches the layout per
+    sequence length, applies block-sparse attention."""
+
+    def __init__(self, sparsity_config: SparsityConfig,
+                 scale: float | None = None):
+        self.config = sparsity_config
+        self.scale = scale
+        self._layouts: dict[int, np.ndarray] = {}
+
+    def get_layout(self, seq_len: int) -> np.ndarray:
+        if seq_len not in self._layouts:
+            self._layouts[seq_len] = self.config.make_layout(seq_len)
+        return self._layouts[seq_len]
+
+    def __call__(self, q, k, v) -> jax.Array:
+        layout = self.get_layout(q.shape[1])
+        causal = getattr(self.config, "attention", "") == "unidirectional"
+        return block_sparse_attention(q, k, v, layout, self.config.block,
+                                      scale=self.scale, causal=causal)
+
+    def sparsity(self, seq_len: int) -> float:
+        layout = self.get_layout(seq_len)
+        return 1.0 - float(layout.mean())

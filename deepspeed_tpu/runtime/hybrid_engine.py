@@ -83,13 +83,13 @@ class DeepSpeedHybridEngine(DeepSpeedEngine):
 
             params = lora_merge(params)
         want = self._infer.config.dtype
-        leaf0 = jax.tree.leaves(params)[0]
-        if leaf0.dtype != want:
-            import jax.numpy as jnp
+        import jax.numpy as jnp
 
-            params = jax.tree.map(
-                lambda x: x.astype(want)
-                if jnp.issubdtype(x.dtype, jnp.floating) else x, params)
+        # unconditional: no-op for matching leaves, and mixed trees (fp32
+        # LoRA scales over bf16 kernels) must not dodge the cast
+        params = jax.tree.map(
+            lambda x: x.astype(want)
+            if jnp.issubdtype(x.dtype, jnp.floating) else x, params)
         return params
 
     # -- RLHF API --------------------------------------------------------

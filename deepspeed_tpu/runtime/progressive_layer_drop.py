@@ -1,0 +1,64 @@
+"""Progressive layer dropping (reference runtime/progressive_layer_drop.py:10
+`ProgressiveLayerDrop`, from the PLD paper): the keep probability θ(t)
+anneals from 1 toward a floor ``theta`` with rate ``gamma``, and deeper
+layers drop more often (stochastic-depth ramp across depth).
+
+On TPU, dropping is a jit-friendly per-layer Bernoulli gate:
+``pld_keep_mask(rng, num_layers, theta_t)`` gives the per-layer keep
+decisions for one step; a model applies layer l as
+``x = where(keep[l], x + f_l(x), x)`` (identity-bypass, scaled at eval).
+"""
+from __future__ import annotations
+
+import math
+
+import jax
+import jax.numpy as jnp
+
+
+class ProgressiveLayerDrop:
+    def __init__(self, theta: float = 0.5, gamma: float = 0.001):
+        self.theta = float(theta)
+        self.gamma = float(gamma)
+        self.current_theta = 1.0
+
+    def get_theta(self, global_step: int | jax.Array):
+        """θ(t) = (1-θ̄)·e^(−γt) + θ̄ (reference get_theta)."""
+        if isinstance(global_step, jax.Array):
+            return (1.0 - self.theta) * jnp.exp(
+                -self.gamma * global_step.astype(jnp.float32)) + self.theta
+        return (1.0 - self.theta) * math.exp(
+            -self.gamma * float(global_step)) + self.theta
+
+    def update_state(self, global_step: int) -> float:
+        self.current_theta = float(self.get_theta(global_step))
+        return self.current_theta
+
+    def get_state(self) -> dict:
+        return {"progressive_layer_drop": True, "pld_theta": self.current_theta}
+
+    # instance alias for API parity; the computation is stateless
+    layer_keep_probs = staticmethod(
+        lambda num_layers, theta_t: layer_keep_probs(num_layers, theta_t))
+
+
+def layer_keep_probs(num_layers: int,
+                     theta_t: float | jax.Array) -> jax.Array:
+    """Per-layer keep probability: depth-linear ramp 1 → θ(t)
+    (stochastic depth; layer 0 ≈ always kept)."""
+    depth_frac = jnp.arange(num_layers, dtype=jnp.float32) / max(
+        1, num_layers - 1)
+    return 1.0 - depth_frac * (1.0 - theta_t)
+
+
+def pld_keep_mask(rng: jax.Array, num_layers: int,
+                  theta_t: float | jax.Array) -> jax.Array:
+    """One step's Bernoulli keep decisions, [num_layers] bool (jit-safe)."""
+    return jax.random.uniform(rng, (num_layers,)) < layer_keep_probs(
+        num_layers, theta_t)
+
+
+def apply_pld_layer(keep: jax.Array, x: jax.Array,
+                    layer_out: jax.Array) -> jax.Array:
+    """Residual-bypass application: keep → layer output, drop → identity."""
+    return jnp.where(keep, layer_out, x)

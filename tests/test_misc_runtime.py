@@ -1,0 +1,157 @@
+"""Universal checkpoint tools + eigenvalue + PLD + TiledLinear tests
+(reference tests/unit/checkpoint/test_universal_checkpoint.py,
+runtime eigenvalue/PLD/tiling unit tests analogues)."""
+import json
+import os
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import deepspeed_tpu as ds
+from deepspeed_tpu.checkpoint import (UniversalCheckpoint, ds_to_universal,
+                                      get_fp32_state_dict_from_zero_checkpoint,
+                                      zero_to_fp32)
+from deepspeed_tpu.models import build_model
+from deepspeed_tpu.runtime.eigenvalue import Eigenvalue
+from deepspeed_tpu.runtime.progressive_layer_drop import (ProgressiveLayerDrop,
+                                                          apply_pld_layer,
+                                                          pld_keep_mask)
+from deepspeed_tpu.runtime.tiling import TiledLinear
+
+
+# -- offline checkpoint tools ----------------------------------------------
+@pytest.fixture(scope="module")
+def saved_ckpt(tmp_path_factory):
+    d = tmp_path_factory.mktemp("ckpt")
+    engine, *_ = ds.initialize(
+        model=build_model("tiny-gpt2"),
+        config={"train_micro_batch_size_per_gpu": 2,
+                "optimizer": {"type": "AdamW", "params": {"lr": 1e-3}},
+                "zero_optimization": {"stage": 2}})
+    rng = np.random.default_rng(0)
+    gbs = engine.config.train_batch_size
+    engine.train_batch({"input_ids": rng.integers(0, 256, (gbs, 32))})
+    engine.save_checkpoint(str(d))
+    return str(d), engine
+
+
+def test_zero_to_fp32(saved_ckpt, tmp_path):
+    ckpt_dir, engine = saved_ckpt
+    out = str(tmp_path / "consolidated.npz")
+    zero_to_fp32(ckpt_dir, out)
+    loaded = np.load(out)
+    names = list(loaded.files)
+    assert any("embed" in n for n in names)
+    total = sum(loaded[n].size for n in names)
+    assert total == engine.num_parameters()
+    assert all(loaded[n].dtype == np.float32 for n in names)
+    # values match the engine's fp32 master
+    sd = get_fp32_state_dict_from_zero_checkpoint(ckpt_dir)
+    master_embed = np.asarray(engine.state.master["embed"])
+    np.testing.assert_allclose(sd["embed"], master_embed, rtol=1e-6)
+
+
+def test_ds_to_universal_and_reader(saved_ckpt, tmp_path):
+    ckpt_dir, engine = saved_ckpt
+    out_dir = str(tmp_path / "universal")
+    ds_to_universal(ckpt_dir, out_dir)
+    assert os.path.exists(os.path.join(out_dir, "universal_index.json"))
+    uc = UniversalCheckpoint(out_dir)
+    assert any(k.startswith("master.") for k in uc.keys())
+    assert any(k.startswith("opt_mu.") for k in uc.keys())
+    tree = uc.load_section("master")
+    np.testing.assert_allclose(tree["embed"],
+                               np.asarray(engine.state.master["embed"]),
+                               rtol=1e-6)
+    # index metadata carries the training step
+    assert uc.meta.get("global_steps") == 1
+
+
+def test_universal_cli(saved_ckpt, tmp_path):
+    from deepspeed_tpu.checkpoint.universal import main
+
+    ckpt_dir, _ = saved_ckpt
+    out = str(tmp_path / "w.npz")
+    assert main(["zero_to_fp32", ckpt_dir, out]) == 0
+    assert os.path.exists(out)
+    assert main(["bogus"]) == 2
+
+
+# -- eigenvalue -------------------------------------------------------------
+def test_power_iteration_quadratic():
+    """H of 0.5*x^T A x is A: dominant eigenvalue recovered."""
+    A = jnp.diag(jnp.asarray([5.0, 2.0, 1.0]))
+
+    def loss(p):
+        x = p["x"]
+        return 0.5 * x @ A @ x
+
+    eig, vec = Eigenvalue(max_iter=200, tol=1e-4).power_iteration(
+        loss, {"x": jnp.ones(3)})
+    assert eig == pytest.approx(5.0, rel=1e-2)
+    v = np.abs(np.asarray(vec["x"]))
+    assert v[0] == pytest.approx(1.0, abs=0.05)  # aligned with e_0
+
+
+def test_per_block_eigenvalues():
+    def loss(p):
+        return 0.5 * (10.0 * jnp.sum(p["layer_0"]["w"] ** 2)
+                      + 1.0 * jnp.sum(p["layer_1"]["w"] ** 2))
+
+    params = {"layer_0": {"w": jnp.ones(4)}, "layer_1": {"w": jnp.ones(4)}}
+    eigs = Eigenvalue(max_iter=100).compute_eigenvalue(loss, params)
+    assert eigs["layer_0"] == pytest.approx(10.0, rel=1e-2)
+    assert eigs["layer_1"] == pytest.approx(1.0, rel=1e-2)
+
+
+# -- progressive layer drop -------------------------------------------------
+def test_pld_theta_schedule():
+    pld = ProgressiveLayerDrop(theta=0.5, gamma=0.01)
+    assert pld.get_theta(0) == pytest.approx(1.0)
+    assert pld.get_theta(10_000) == pytest.approx(0.5, abs=1e-3)
+    mid = pld.get_theta(100)
+    assert 0.5 < mid < 1.0
+    pld.update_state(100)
+    assert pld.get_state()["pld_theta"] == pytest.approx(mid)
+
+
+def test_pld_keep_mask_depth_ramp():
+    rng = jax.random.PRNGKey(0)
+    # theta=1 → everything kept
+    assert bool(pld_keep_mask(rng, 8, 1.0).all())
+    # low theta → deeper layers dropped more often (statistically)
+    keeps = np.stack([np.asarray(pld_keep_mask(jax.random.PRNGKey(i), 8, 0.2))
+                      for i in range(400)])
+    rates = keeps.mean(axis=0)
+    assert rates[0] > 0.95 and rates[-1] < 0.4
+    assert rates[0] > rates[-1]
+    x = jnp.ones((2, 3))
+    out = apply_pld_layer(jnp.asarray(False), x, x * 7)
+    np.testing.assert_array_equal(np.asarray(out), 1.0)
+
+
+# -- tiled linear -----------------------------------------------------------
+def test_tiled_linear_matches_dense():
+    rng = np.random.default_rng(0)
+    x = jnp.asarray(rng.standard_normal((4, 30)), jnp.float32)
+    kernel = jnp.asarray(rng.standard_normal((30, 17)), jnp.float32)
+    bias = jnp.asarray(rng.standard_normal(17), jnp.float32)
+    m = TiledLinear(features=17, in_splits=3, out_splits=2)
+    params = TiledLinear.params_from_dense(kernel, bias, 3, 2)
+    y = m.apply({"params": params}, x)
+    np.testing.assert_allclose(np.asarray(y), np.asarray(x @ kernel + bias),
+                               rtol=1e-5, atol=1e-5)
+    # uneven splits covered: 30/3=10 even, 17/2 → 9+8
+    assert params["tile_0_0"].shape == (10, 9)
+    assert params["tile_0_1"].shape == (10, 8)
+
+
+def test_tiled_linear_trains():
+    m = TiledLinear(features=8, in_splits=2, out_splits=2)
+    x = jnp.ones((2, 10))
+    p = m.init(jax.random.PRNGKey(0), x)["params"]
+    g = jax.grad(lambda pp: jnp.sum(m.apply({"params": pp}, x) ** 2))(p)
+    assert all(np.isfinite(np.asarray(l)).all() for l in jax.tree.leaves(g))
+    assert sum(np.abs(np.asarray(l)).sum() for l in jax.tree.leaves(g)) > 0

@@ -1,0 +1,136 @@
+"""Sparse attention tests (reference tests/unit/ops/sparse_attention/
+test_sparse_attention.py analogue)."""
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from deepspeed_tpu.ops.attention import dot_product_attention
+from deepspeed_tpu.ops.sparse_attention import (BigBirdSparsityConfig,
+                                                BSLongformerSparsityConfig,
+                                                DenseSparsityConfig,
+                                                FixedSparsityConfig,
+                                                SparseSelfAttention,
+                                                VariableSparsityConfig,
+                                                block_sparse_attention)
+
+
+def qkv(B=2, S=64, H=4, D=16, seed=0):
+    rng = np.random.default_rng(seed)
+    mk = lambda: jnp.asarray(rng.standard_normal((B, S, H, D)), jnp.float32)
+    return mk(), mk(), mk()
+
+
+# -- layouts ----------------------------------------------------------------
+def test_dense_layout_full():
+    cfg = DenseSparsityConfig(num_heads=4, block=16)
+    layout = cfg.make_layout(64)
+    assert layout.shape == (4, 4, 4) and layout.all()
+
+
+def test_fixed_layout_structure():
+    cfg = FixedSparsityConfig(num_heads=2, block=16, num_local_blocks=2,
+                              num_global_blocks=1, attention="unidirectional")
+    layout = cfg.make_layout(128)  # 8 blocks
+    n = 8
+    tril = np.tril(np.ones((n, n)))
+    assert ((layout[0] <= tril).all())  # unidirectional = lower triangular
+    # diagonal (own block) always visible
+    assert all(layout[0, i, i] for i in range(n))
+    # global column (block 1 = last of first window) visible from later rows
+    assert layout[0, 5, 1] == 1
+    # strictly sparser than dense causal
+    assert layout[0].sum() < tril.sum()
+
+
+def test_bigbird_layout():
+    cfg = BigBirdSparsityConfig(num_heads=2, block=16,
+                                num_sliding_window_blocks=3,
+                                num_random_blocks=1, num_global_blocks=1)
+    layout = cfg.make_layout(128)
+    n = 8
+    # window: diagonal band set
+    for i in range(n):
+        assert layout[0, i, i] == 1
+    # global row+col
+    assert layout[0, 0].all() and layout[0, :, 0].all()
+
+
+def test_bslongformer_layout():
+    cfg = BSLongformerSparsityConfig(num_heads=1, block=16,
+                                     num_sliding_window_blocks=3,
+                                     global_block_indices=[0])
+    layout = cfg.make_layout(128)
+    assert layout[0, 0].all() and layout[0, :, 0].all()
+    assert layout[0, 4, 3] == 1 and layout[0, 4, 5] == 1  # window
+    assert layout[0, 2, 6] == 0  # far off-window, non-global
+
+
+def test_variable_layout_windows():
+    cfg = VariableSparsityConfig(num_heads=1, block=16,
+                                 local_window_blocks=[1, 3],
+                                 global_block_indices=[0])
+    layout = cfg.make_layout(128)
+    # second window covers blocks 1-3 inclusive
+    assert layout[0, 2, 1] and layout[0, 2, 3]
+    assert not layout[0, 2, 4]
+
+
+def test_layout_rejects_bad_seqlen():
+    with pytest.raises(ValueError, match="divisible"):
+        DenseSparsityConfig(num_heads=1, block=16).make_layout(100)
+
+
+# -- attention compute ------------------------------------------------------
+def test_dense_layout_matches_full_attention():
+    q, k, v = qkv()
+    cfg = DenseSparsityConfig(num_heads=4, block=16)
+    out = block_sparse_attention(q, k, v, cfg.make_layout(64), 16)
+    ref = dot_product_attention(q, k, v, causal=False)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=2e-3, atol=2e-3)
+
+
+def test_unidirectional_fixed_matches_causal_where_dense():
+    """With local window >= whole sequence, unidirectional fixed == causal."""
+    q, k, v = qkv(S=64)
+    cfg = FixedSparsityConfig(num_heads=4, block=16, num_local_blocks=4,
+                              num_global_blocks=1, attention="unidirectional")
+    out = block_sparse_attention(q, k, v, cfg.make_layout(64), 16, causal=True)
+    ref = dot_product_attention(q, k, v, causal=True)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=2e-3, atol=2e-3)
+
+
+def test_masked_blocks_do_not_contribute():
+    """Values in invisible blocks must not affect the output."""
+    q, k, v = qkv(S=64)
+    cfg = BSLongformerSparsityConfig(num_heads=4, block=16,
+                                     num_sliding_window_blocks=1,
+                                     global_block_indices=[0])
+    layout = cfg.make_layout(64)
+    out1 = block_sparse_attention(q, k, v, layout, 16)
+    # perturb k/v ONLY inside blocks invisible to query block 2 (row 2)
+    invisible_cols = np.where(layout[0, 2] == 0)[0]
+    assert invisible_cols.size > 0
+    k2, v2 = np.asarray(k).copy(), np.asarray(v).copy()
+    for c in invisible_cols:
+        k2[:, c * 16:(c + 1) * 16] += 100.0
+        v2[:, c * 16:(c + 1) * 16] -= 50.0
+    out2 = block_sparse_attention(q, jnp.asarray(k2), jnp.asarray(v2),
+                                  layout, 16)
+    np.testing.assert_allclose(np.asarray(out1)[:, 32:48],
+                               np.asarray(out2)[:, 32:48], rtol=1e-4, atol=1e-4)
+
+
+def test_sparse_self_attention_wrapper_and_grads():
+    q, k, v = qkv(S=64)
+    ssa = SparseSelfAttention(BigBirdSparsityConfig(num_heads=4, block=16))
+    out = ssa(q, k, v)
+    assert out.shape == q.shape
+    assert 0.0 < ssa.sparsity(64) < 1.0
+    # differentiable end to end
+    g = jax.grad(lambda qq: jnp.sum(ssa(qq, k, v) ** 2))(q)
+    assert np.isfinite(np.asarray(g)).all()
+    # layout cached per seq len
+    assert 64 in ssa._layouts
