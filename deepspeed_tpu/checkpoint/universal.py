@@ -9,13 +9,13 @@ from __future__ import annotations
 
 import json
 import os
-import re
 import sys
 from typing import Any
 
 import numpy as np
 
 from ..utils.logging import logger
+from ..utils.naming import safe_filename as _atom_name
 
 
 def _resolve_tag(ckpt_dir: str, tag: str | None) -> str:
@@ -76,10 +76,6 @@ def zero_to_fp32(ckpt_dir: str, output_file: str, tag: str | None = None) -> str
 
 
 # ---------------------------------------------------------------------------
-def _atom_name(key: str) -> str:
-    return re.sub(r"[^A-Za-z0-9_.-]+", "_", key)
-
-
 def ds_to_universal(ckpt_dir: str, out_dir: str, tag: str | None = None,
                     include_optimizer: bool = True) -> str:
     """Per-parameter atom files (reference ds_to_universal.py:469: extract

@@ -54,6 +54,60 @@ PRESETS: dict[str, ModelConfig] = {
                                 norm="rmsnorm", activation="silu_glu",
                                 tie_embeddings=False,
                                 moe=MoEConfig(num_experts=8, top_k=2)),
+    # --- Falcon (reference inference/v2/model_implementations/falcon) ----
+    "falcon-7b": ModelConfig(vocab_size=65024, hidden_size=4544, num_layers=32,
+                             num_heads=71, num_kv_heads=1, max_seq_len=2048,
+                             position_embedding="rope", norm="layernorm",
+                             activation="gelu", parallel_block=True,
+                             tie_embeddings=False),
+    "falcon-40b": ModelConfig(vocab_size=65024, hidden_size=8192, num_layers=60,
+                              num_heads=128, num_kv_heads=8, max_seq_len=2048,
+                              position_embedding="rope", norm="layernorm",
+                              activation="gelu", parallel_block=True,
+                              parallel_block_norms=2,  # ln_attn + ln_mlp
+                              tie_embeddings=False),
+    # --- BLOOM (reference module_inject/containers/bloom.py; ALiBi) ------
+    "bloom-7b1": ModelConfig(vocab_size=250880, hidden_size=4096, num_layers=30,
+                             num_heads=32, max_seq_len=2048,
+                             position_embedding="alibi", norm="layernorm",
+                             activation="gelu", tie_embeddings=True),
+    # --- OPT (reference v2 model_implementations/opt; ReLU + learned) ----
+    "opt-125m": ModelConfig(vocab_size=50272, hidden_size=768, num_layers=12,
+                            num_heads=12, max_seq_len=2048,
+                            position_embedding="learned", activation="relu"),
+    "opt-6.7b": ModelConfig(vocab_size=50272, hidden_size=4096, num_layers=32,
+                            num_heads=32, max_seq_len=2048,
+                            position_embedding="learned", activation="relu"),
+    # --- GPT-J / GPT-NeoX (reference containers gptj/gptneox) ------------
+    "gptj-6b": ModelConfig(vocab_size=50400, hidden_size=4096, num_layers=28,
+                           num_heads=16, max_seq_len=2048,
+                           position_embedding="rope", rotary_pct=0.25,
+                           activation="gelu", parallel_block=True,
+                           tie_embeddings=False),
+    "gpt-neox-20b": ModelConfig(vocab_size=50432, hidden_size=6144,
+                                num_layers=44, num_heads=64, max_seq_len=2048,
+                                position_embedding="rope", rotary_pct=0.25,
+                                activation="gelu", parallel_block=True,
+                                parallel_block_norms=2,  # input+post_attn ln
+                                tie_embeddings=False),
+    # --- Phi (reference v2 model_implementations/phi; partial rotary) ----
+    "phi-2": ModelConfig(vocab_size=51200, hidden_size=2560, num_layers=32,
+                         num_heads=32, max_seq_len=2048,
+                         position_embedding="rope", rotary_pct=0.4,
+                         activation="gelu", parallel_block=True,
+                         tie_embeddings=False),
+    # --- Qwen (reference v2 model_implementations/qwen*; qkv bias) -------
+    "qwen-7b": ModelConfig(vocab_size=151936, hidden_size=4096, num_layers=32,
+                           num_heads=32, intermediate_size=11008,
+                           max_seq_len=8192, position_embedding="rope",
+                           norm="rmsnorm", activation="silu_glu",
+                           qkv_bias=True, tie_embeddings=False),
+    "qwen2-7b": ModelConfig(vocab_size=152064, hidden_size=3584, num_layers=28,
+                            num_heads=28, num_kv_heads=4,
+                            intermediate_size=18944, max_seq_len=32768,
+                            position_embedding="rope", norm="rmsnorm",
+                            activation="silu_glu", qkv_bias=True,
+                            tie_embeddings=False),
     # --- tiny variants for tests/debug (reference tests/unit/simple_model.py) --
     "tiny-gpt2": ModelConfig(vocab_size=256, hidden_size=64, num_layers=2,
                              num_heads=4, max_seq_len=128,
@@ -68,6 +122,26 @@ PRESETS: dict[str, ModelConfig] = {
                                 activation="silu_glu", tie_embeddings=False,
                                 moe=MoEConfig(num_experts=4, top_k=2,
                                               min_capacity=4)),
+    "tiny-falcon": ModelConfig(vocab_size=256, hidden_size=64, num_layers=2,
+                               num_heads=4, num_kv_heads=1, max_seq_len=128,
+                               position_embedding="rope", activation="gelu",
+                               parallel_block=True, tie_embeddings=False),
+    "tiny-bloom": ModelConfig(vocab_size=256, hidden_size=64, num_layers=2,
+                              num_heads=4, max_seq_len=128,
+                              position_embedding="alibi", activation="gelu"),
+    "tiny-opt": ModelConfig(vocab_size=256, hidden_size=64, num_layers=2,
+                            num_heads=4, max_seq_len=128,
+                            position_embedding="learned", activation="relu"),
+    "tiny-phi": ModelConfig(vocab_size=256, hidden_size=64, num_layers=2,
+                            num_heads=4, max_seq_len=128,
+                            position_embedding="rope", rotary_pct=0.5,
+                            activation="gelu", parallel_block=True,
+                            tie_embeddings=False),
+    "tiny-qwen": ModelConfig(vocab_size=256, hidden_size=64, num_layers=2,
+                             num_heads=4, num_kv_heads=2, max_seq_len=128,
+                             position_embedding="rope", norm="rmsnorm",
+                             activation="silu_glu", qkv_bias=True,
+                             tie_embeddings=False),
 }
 
 

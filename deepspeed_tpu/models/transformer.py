@@ -85,11 +85,16 @@ class ModelConfig:
     num_kv_heads: int | None = None          # GQA; None → num_heads
     intermediate_size: int | None = None     # None → 4*hidden (gpt) / 8/3*hidden (glu)
     max_seq_len: int = 1024
-    position_embedding: str = "learned"      # learned | rope
+    position_embedding: str = "learned"      # learned | rope | alibi
     rope_theta: float = 10000.0
+    rotary_pct: float = 1.0                  # partial rotary (gpt-neox/phi)
     norm: str = "layernorm"                  # layernorm | rmsnorm
     norm_eps: float = 1e-5
-    activation: str = "gelu"                 # gelu | silu_glu (SwiGLU)
+    activation: str = "gelu"                 # gelu | relu | silu_glu (SwiGLU)
+    qkv_bias: bool = False                   # qwen-style projection biases
+    parallel_block: bool = False             # falcon/gpt-j/phi: attn ∥ ffn
+    parallel_block_norms: int = 1            # 2 = separate ln for ffn branch
+                                             # (gpt-neox, falcon-40b)
     tie_embeddings: bool = True
     moe: MoEConfig | None = None
     dtype: Any = jnp.bfloat16                # compute dtype
@@ -162,6 +167,24 @@ class Norm(nn.Module):
         return out.astype(dtype)
 
 
+def alibi_slopes(num_heads: int) -> jax.Array:
+    """ALiBi per-head slopes (Press et al.; reference bloom container /
+    inference v2 alibi kernels): geometric sequence from 2^(-8/n)."""
+    import math
+
+    def pow2_slopes(n):
+        start = 2.0 ** (-(2.0 ** -(math.log2(n) - 3)))
+        return [start * (start ** i) for i in range(n)]
+
+    if math.log2(num_heads).is_integer():
+        vals = pow2_slopes(num_heads)
+    else:
+        closest = 2 ** math.floor(math.log2(num_heads))
+        vals = pow2_slopes(closest) + pow2_slopes(2 * closest)[0::2][
+            :num_heads - closest]
+    return jnp.asarray(vals, jnp.float32)
+
+
 def rope(q: jax.Array, k: jax.Array, positions: jax.Array, theta: float) -> tuple[jax.Array, jax.Array]:
     """Rotary position embedding on [B, S, H, D] q/k."""
     d = q.shape[-1]
@@ -204,9 +227,28 @@ class Attention(nn.Module):
         q = jnp.einsum("bse,ehd->bshd", x, wq.astype(cfg.dtype))
         k = jnp.einsum("bse,ehd->bshd", x, wk.astype(cfg.dtype))
         v = jnp.einsum("bse,ehd->bshd", x, wv.astype(cfg.dtype))
+        if cfg.qkv_bias:
+            bq = self.param("bq", nn.with_partitioning(
+                nn.initializers.zeros, ("heads", "head_dim")), (H, D), jnp.float32)
+            bk = self.param("bk", nn.with_partitioning(
+                nn.initializers.zeros, ("kv_heads", "head_dim")), (KV, D), jnp.float32)
+            bv = self.param("bv", nn.with_partitioning(
+                nn.initializers.zeros, ("kv_heads", "head_dim")), (KV, D), jnp.float32)
+            q = q + bq.astype(cfg.dtype)
+            k = k + bk.astype(cfg.dtype)
+            v = v + bv.astype(cfg.dtype)
 
         if cfg.position_embedding == "rope":
-            q, k = rope(q, k, positions, cfg.rope_theta)
+            if cfg.rotary_pct >= 1.0:
+                q, k = rope(q, k, positions, cfg.rope_theta)
+            else:
+                # partial rotary (gpt-neox rotary_pct / phi): rotate the
+                # leading fraction of each head dim, pass the rest through
+                d_rot = (int(D * cfg.rotary_pct) // 2) * 2
+                qr, kr = rope(q[..., :d_rot], k[..., :d_rot], positions,
+                              cfg.rope_theta)
+                q = jnp.concatenate([qr, q[..., d_rot:]], axis=-1)
+                k = jnp.concatenate([kr, k[..., d_rot:]], axis=-1)
 
         new_cache = None
         if kv_cache is not None:
@@ -222,13 +264,24 @@ class Attention(nn.Module):
         k = constrain(k, BATCH, None, HEADS if KV == H else None, None)
         v = constrain(v, BATCH, None, HEADS if KV == H else None, None)
 
+        alibi_bias = None
+        if cfg.position_embedding == "alibi":
+            # ALiBi: logits += slope_h * (k_pos - q_pos) (reference bloom
+            # policy / inference v2 alibi); no pallas path yet → xla attn
+            slopes = alibi_slopes(H)
+            k_pos = jnp.arange(k.shape[1], dtype=jnp.float32)
+            q_pos = positions.astype(jnp.float32)      # [B, S]
+            rel = k_pos[None, None, None, :] - q_pos[:, None, :, None]
+            alibi_bias = slopes[None, :, None, None] * rel  # [B,H,S,K]
+
         out = dot_product_attention(
             q, k, v,
             causal=True,
             positions=positions if kv_cache is not None else None,
             kv_len=(kv_cache[2] + S) if kv_cache is not None else None,
             mask=attn_mask,
-            impl=cfg.attn_impl,
+            bias=alibi_bias,
+            impl="xla" if alibi_bias is not None else cfg.attn_impl,
         )
         # back to seq-sharded, heads full
         out = constrain(out, BATCH, SEQ, None, None)
@@ -263,7 +316,8 @@ class DenseFFN(nn.Module):
                             (F,), jnp.float32)
             bd = self.param("b_down", nn.with_partitioning(nn.initializers.zeros, ("embed",)),
                             (cfg.hidden_size,), jnp.float32)
-            h = jax.nn.gelu(x @ wu.astype(cfg.dtype) + bu.astype(cfg.dtype))
+            act = jax.nn.relu if cfg.activation == "relu" else jax.nn.gelu
+            h = act(x @ wu.astype(cfg.dtype) + bu.astype(cfg.dtype))
         h = constrain(h, BATCH, SEQ, MLP)
         out = h @ wd.astype(cfg.dtype)
         if cfg.activation != "silu_glu":
@@ -303,6 +357,28 @@ class Block(nn.Module):
     @nn.compact
     def __call__(self, x, positions, kv_cache=None, attn_mask=None, deterministic=True):
         cfg = self.config
+        if cfg.parallel_block:
+            # falcon-7b/gpt-j/phi: ONE pre-norm feeds attention and ffn;
+            # gpt-neox/falcon-40b keep separate norms per branch
+            # (parallel_block_norms=2) — reference falcon/gptneox containers
+            h = Norm(cfg, name="ln_attn")(x)
+            attn_out = Attention(cfg, name="attn")(h, positions,
+                                                   kv_cache=kv_cache,
+                                                   attn_mask=attn_mask)
+            if kv_cache is not None:
+                attn_out, new_cache = attn_out
+            else:
+                new_cache = None
+            h_ffn = h if cfg.parallel_block_norms == 1 \
+                else Norm(cfg, name="ln_ffn")(x)
+            if self.use_moe:
+                ffn_out = MoEFFN(cfg, name="moe")(h_ffn, deterministic=deterministic)
+            else:
+                ffn_out = DenseFFN(cfg, name="ffn")(h_ffn)
+            x = x + attn_out + ffn_out
+            if kv_cache is not None:
+                return x, new_cache
+            return x
         attn_out = Attention(cfg, name="attn")(Norm(cfg, name="ln_attn")(x), positions,
                                                kv_cache=kv_cache, attn_mask=attn_mask)
         if kv_cache is not None:

@@ -13,7 +13,7 @@ import jax
 import jax.numpy as jnp
 
 
-def _xla_attention(q, k, v, *, causal, positions, kv_len, mask):
+def _xla_attention(q, k, v, *, causal, positions, kv_len, mask, bias=None):
     B, Sq, H, D = q.shape
     Skv, KV = k.shape[1], k.shape[2]
     scale = 1.0 / (D ** 0.5)
@@ -40,6 +40,9 @@ def _xla_attention(q, k, v, *, causal, positions, kv_len, mask):
         # mask: [B, Skv] (1 = attend) or broadcastable bool
         m = mask[:, None, None, :] if mask.ndim == 2 else mask
         logits = jnp.where(m.astype(bool), logits, neg)
+    if bias is not None:
+        # additive position bias (ALiBi etc.), broadcastable to [B,H,Sq,Skv]
+        logits = logits + bias.astype(jnp.float32)
 
     weights = jax.nn.softmax(logits, axis=-1)
     out = jnp.einsum("bhqk,bkhd->bqhd", weights.astype(v.dtype), v)
@@ -47,7 +50,7 @@ def _xla_attention(q, k, v, *, causal, positions, kv_len, mask):
 
 
 def dot_product_attention(q, k, v, *, causal: bool = True, positions=None,
-                          kv_len=None, mask=None, impl: str = "auto",
+                          kv_len=None, mask=None, bias=None, impl: str = "auto",
                           allow_multi_device: bool = False):
     """q: [B,Sq,H,D]; k/v: [B,Skv,KV,D] (KV divides H for GQA).
 
@@ -57,7 +60,7 @@ def dot_product_attention(q, k, v, *, causal: bool = True, positions=None,
     a multi-device mesh would force q/k/v replication. ``impl='pallas'``
     alone does not opt in.
     """
-    if impl in ("auto", "pallas"):
+    if impl in ("auto", "pallas") and bias is None:
         try:
             from .pallas.flash_attention import flash_attention_usable, flash_attention
 
@@ -69,5 +72,8 @@ def dot_product_attention(q, k, v, *, causal: bool = True, positions=None,
             pass
         if impl == "pallas":
             raise ValueError("pallas flash attention not usable for these inputs")
+    elif impl == "pallas" and bias is not None:
+        raise ValueError("pallas flash attention has no additive-bias path "
+                         "(ALiBi models run the XLA attention)")
     return _xla_attention(q, k, v, causal=causal, positions=positions,
-                          kv_len=kv_len, mask=mask)
+                          kv_len=kv_len, mask=mask, bias=bias)

@@ -32,10 +32,20 @@ class SparsityConfig:
     block: int = 16
     different_layout_per_head: bool = False
 
+    #: configs whose pattern actually varies per head (random components);
+    #: the deterministic ones would produce H identical copies
+    SUPPORTS_PER_HEAD = False
+
     def setup_layout(self, seq_len: int) -> np.ndarray:
         if seq_len % self.block:
             raise ValueError(f"seq_len {seq_len} not divisible by block "
                              f"{self.block}")
+        if self.different_layout_per_head and not self.SUPPORTS_PER_HEAD:
+            raise ValueError(
+                f"{type(self).__name__} is deterministic — "
+                f"different_layout_per_head would just replicate one layout "
+                f"{self.num_heads}x (use BigBird/Variable for per-head "
+                f"randomness)")
         n = seq_len // self.block
         heads = self.num_heads if self.different_layout_per_head else 1
         return np.zeros((heads, n, n), dtype=np.int64)
@@ -103,6 +113,8 @@ class FixedSparsityConfig(SparsityConfig):
 @dataclass
 class BigBirdSparsityConfig(SparsityConfig):
     """random + sliding-window + global blocks (reference :462)."""
+
+    SUPPORTS_PER_HEAD = True
     num_random_blocks: int = 1
     num_sliding_window_blocks: int = 3
     num_global_blocks: int = 1
@@ -163,6 +175,8 @@ class BSLongformerSparsityConfig(SparsityConfig):
 @dataclass
 class VariableSparsityConfig(SparsityConfig):
     """per-config local windows + custom global indices (reference :262)."""
+
+    SUPPORTS_PER_HEAD = True
     num_random_blocks: int = 0
     local_window_blocks: list[int] = field(default_factory=lambda: [4])
     global_block_indices: list[int] = field(default_factory=lambda: [0])

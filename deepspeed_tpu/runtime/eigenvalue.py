@@ -19,13 +19,14 @@ Pytree = Any
 
 
 def _tree_dot(a: Pytree, b: Pytree) -> jax.Array:
-    # accumulate in f32 regardless of param dtype (bf16 dots drift)
-    return sum(jnp.vdot(x, y).astype(jnp.float32) for x, y in
-               zip(jax.tree.leaves(a), jax.tree.leaves(b)))
+    # compute each dot IN f32 (a bf16 vdot result is already quantized)
+    return sum(jnp.vdot(x.astype(jnp.float32), y.astype(jnp.float32))
+               for x, y in zip(jax.tree.leaves(a), jax.tree.leaves(b)))
 
 
 def _tree_norm(a: Pytree) -> jax.Array:
-    return jnp.sqrt(sum(jnp.sum(x * x) for x in jax.tree.leaves(a)))
+    return jnp.sqrt(sum(jnp.sum(jnp.square(x.astype(jnp.float32)))
+                        for x in jax.tree.leaves(a)))
 
 
 class Eigenvalue:

@@ -6,7 +6,9 @@ layers drop more often (stochastic-depth ramp across depth).
 On TPU, dropping is a jit-friendly per-layer Bernoulli gate:
 ``pld_keep_mask(rng, num_layers, theta_t)`` gives the per-layer keep
 decisions for one step; a model applies layer l as
-``x = where(keep[l], x + f_l(x), x)`` (identity-bypass, scaled at eval).
+``x = where(keep[l], x + f_l(x), x)`` during training, and at eval runs
+every layer with its branch scaled by the keep probability
+(``apply_pld_layer_eval``) so activation statistics match training.
 """
 from __future__ import annotations
 

@@ -23,7 +23,6 @@ applies); a clear error guards it.
 from __future__ import annotations
 
 import os
-import re
 from typing import Any
 
 import jax
@@ -33,6 +32,7 @@ import numpy as np
 from ...ops.aio import AsyncIOHandle
 from ...ops.cpu_optimizer import HostOptState, build_cpu_optimizer
 from ...utils.logging import logger
+from ...utils.naming import safe_filename as _safe_name
 
 Pytree = Any
 
@@ -40,10 +40,6 @@ Pytree = Any
 def _flatten(tree) -> dict[str, jax.Array]:
     flat, _ = jax.tree_util.tree_flatten_with_path(tree)
     return {jax.tree_util.keystr(path): leaf for path, leaf in flat}
-
-
-def _safe_name(key: str) -> str:
-    return re.sub(r"[^A-Za-z0-9_.-]+", "_", key).strip("_")
 
 
 class HostOffloadOptimizer:

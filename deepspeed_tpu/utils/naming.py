@@ -1,0 +1,16 @@
+"""Shared name sanitization for state keys → filenames.
+
+Used by the NVMe swapper (runtime/zero/offload.py — keystr-style keys) and
+the universal-checkpoint atom writer (checkpoint/universal.py — dotted
+keys); both flattenings keep their own key FORMAT deliberately (keystr
+round-trips pytree paths; dotted names match the reference atom naming),
+but the on-disk sanitization is one rule.
+"""
+from __future__ import annotations
+
+import re
+
+
+def safe_filename(key: str) -> str:
+    """Filesystem-safe token for a state key."""
+    return re.sub(r"[^A-Za-z0-9_.-]+", "_", key).strip("_")
