@@ -39,8 +39,32 @@ except ImportError:  # pragma: no cover
 
 NEG_INF = float(jnp.finfo(jnp.float32).min)
 
-DEFAULT_BLOCK_Q = 128
-DEFAULT_BLOCK_K = 128
+# Measured on v5e (gpt2-350m shapes, B8 H16 S1024 D64): 128x128 blocks run
+# ~1000x slower than 256+ (per-grid-step overhead dominates the tiny tiles
+# and the [*,64]-lane blocks relayout poorly); 512x512 was fastest across
+# the sweep. Blocks clamp to the sequence length for short inputs, which
+# collapses the grid and stays fast.
+DEFAULT_BLOCK_Q = 512
+DEFAULT_BLOCK_K = 512
+#: below this, the XLA fused attention is both fast and memory-cheap
+MIN_SEQ = 128
+#: divisor fallbacks stay in the fast regime (128 measured ~1000x slower)
+_FAST_BLOCKS = (512, 256)
+
+
+def _pick_block(seq: int, requested: int | None = None) -> int | None:
+    """The block size both the gate and the kernel agree on: an explicit
+    request is honored when it divides the sequence; otherwise a whole-seq
+    single block (seq <= default) or the largest fast divisor. None → the
+    kernel should not be used for this length."""
+    if requested is not None and requested < seq:
+        return requested if seq % requested == 0 else None
+    if seq <= DEFAULT_BLOCK_Q:
+        return seq
+    for cand in _FAST_BLOCKS:
+        if seq % cand == 0:
+            return cand
+    return None
 
 
 def _interpret() -> bool:
@@ -67,7 +91,9 @@ def flash_attention_usable(q, k, v, *, causal: bool, positions=None,
     Skv, KV = k.shape[1], k.shape[2]
     if Sq != Skv:                      # prefill/training only
         return False
-    if Sq % DEFAULT_BLOCK_Q != 0 or Skv % DEFAULT_BLOCK_K != 0:
+    if Sq < MIN_SEQ:                   # tiny: XLA is fast and cheap anyway
+        return False
+    if _pick_block(Sq) is None or _pick_block(Skv) is None:
         return False
     if H % KV != 0:
         return False
@@ -136,12 +162,16 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref,
         l = l_scr[:]
         l_safe = jnp.where(l == 0.0, 1.0, l)
         o_ref[0, 0, :, :] = (acc_scr[:] / l_safe).astype(o_ref.dtype)
-        lse_ref[0, 0, :] = (m_scr[:] + jnp.log(l_safe))[:, 0]
+        lse_ref[0, 0, :, :] = m_scr[:] + jnp.log(l_safe)
 
 
 def _fwd(q, k, v, *, causal: bool, scale: float,
          block_q: int, block_k: int):
-    """q: [B,H,Sq,D]; k/v: [B,KV,Skv,D] → (out [B,H,Sq,D], lse [B,H,Sq])."""
+    """q: [B,H,Sq,D]; k/v: [B,KV,Skv,D] → (out [B,H,Sq,D], lse [B,H,Sq,1]).
+
+    lse is carried with a trailing singleton dim: TPU block shapes must have
+    their last two dims divide (8, 128) or equal the array dims, which a
+    (1, 1, block_q) block over [B, H, S] cannot satisfy."""
     B, H, Sq, D = q.shape
     KV, Skv = k.shape[1], k.shape[2]
     group = H // KV
@@ -161,11 +191,11 @@ def _fwd(q, k, v, *, causal: bool, scale: float,
         ],
         out_specs=[
             pl.BlockSpec((1, 1, block_q, D), lambda b, h, i, j: (b, h, i, 0)),
-            pl.BlockSpec((1, 1, block_q), lambda b, h, i, j: (b, h, i)),
+            pl.BlockSpec((1, 1, block_q, 1), lambda b, h, i, j: (b, h, i, 0)),
         ],
         out_shape=[
             jax.ShapeDtypeStruct((B, H, Sq, D), q.dtype),
-            jax.ShapeDtypeStruct((B, H, Sq), jnp.float32),
+            jax.ShapeDtypeStruct((B, H, Sq, 1), jnp.float32),
         ],
         scratch_shapes=[
             pltpu.VMEM((block_q, 1), jnp.float32),
@@ -204,11 +234,11 @@ def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
                                 preferred_element_type=jnp.float32) * scale
         if causal:
             s = _apply_causal_mask(s, q_start, k_start, block_q, block_k)
-        p = jnp.exp(s - lse_ref[0, 0, :][:, None])          # [bq, bk]
+        p = jnp.exp(s - lse_ref[0, 0, :, :])                # [bq, bk]
         do = do_ref[0, 0, :, :]
         dp = jax.lax.dot_general(do, v, (((1,), (1,)), ((), ())),
                                  preferred_element_type=jnp.float32)
-        ds = p * (dp - delta_ref[0, 0, :][:, None]) * scale
+        ds = p * (dp - delta_ref[0, 0, :, :]) * scale
         dq_scr[:] += jax.lax.dot_general(
             ds.astype(k.dtype), k, (((1,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)
@@ -243,14 +273,14 @@ def _dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
                                 preferred_element_type=jnp.float32) * scale
         if causal:
             s = _apply_causal_mask(s, q_start, k_start, block_q, block_k)
-        p = jnp.exp(s - lse_ref[0, 0, :][:, None])          # [bq, bk]
+        p = jnp.exp(s - lse_ref[0, 0, :, :])                # [bq, bk]
         # dV += P^T @ dO
         dv_scr[:] += jax.lax.dot_general(
             p.astype(do.dtype), do, (((0,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)
         dp = jax.lax.dot_general(do, v, (((1,), (1,)), ((), ())),
                                  preferred_element_type=jnp.float32)
-        ds = p * (dp - delta_ref[0, 0, :][:, None]) * scale  # [bq, bk]
+        ds = p * (dp - delta_ref[0, 0, :, :]) * scale        # [bq, bk]
         # dK += dS^T @ Q
         dk_scr[:] += jax.lax.dot_general(
             ds.astype(q.dtype), q, (((0,), (0,)), ((), ())),
@@ -269,7 +299,7 @@ def _bwd(causal, scale, block_q, block_k, res, do):
     group = H // KV
 
     delta = jnp.sum(do.astype(jnp.float32) * out.astype(jnp.float32),
-                    axis=-1)                                  # [B,H,Sq]
+                    axis=-1, keepdims=True)                   # [B,H,Sq,1]
 
     grid_dq = (B, H, Sq // block_q, Skv // block_k)
     dq = pl.pallas_call(
@@ -283,8 +313,8 @@ def _bwd(causal, scale, block_q, block_k, res, do):
             pl.BlockSpec((1, 1, block_k, D),
                          lambda b, h, i, j: (b, h // group, j, 0)),
             pl.BlockSpec((1, 1, block_q, D), lambda b, h, i, j: (b, h, i, 0)),
-            pl.BlockSpec((1, 1, block_q), lambda b, h, i, j: (b, h, i)),
-            pl.BlockSpec((1, 1, block_q), lambda b, h, i, j: (b, h, i)),
+            pl.BlockSpec((1, 1, block_q, 1), lambda b, h, i, j: (b, h, i, 0)),
+            pl.BlockSpec((1, 1, block_q, 1), lambda b, h, i, j: (b, h, i, 0)),
         ],
         out_specs=pl.BlockSpec((1, 1, block_q, D),
                                lambda b, h, i, j: (b, h, i, 0)),
@@ -306,8 +336,8 @@ def _bwd(causal, scale, block_q, block_k, res, do):
             pl.BlockSpec((1, 1, block_k, D),
                          lambda b, h, j, i: (b, h // group, j, 0)),
             pl.BlockSpec((1, 1, block_q, D), lambda b, h, j, i: (b, h, i, 0)),
-            pl.BlockSpec((1, 1, block_q), lambda b, h, j, i: (b, h, i)),
-            pl.BlockSpec((1, 1, block_q), lambda b, h, j, i: (b, h, i)),
+            pl.BlockSpec((1, 1, block_q, 1), lambda b, h, j, i: (b, h, i, 0)),
+            pl.BlockSpec((1, 1, block_q, 1), lambda b, h, j, i: (b, h, i, 0)),
         ],
         out_specs=[
             pl.BlockSpec((1, 1, block_k, D), lambda b, h, j, i: (b, h, j, 0)),
@@ -360,12 +390,13 @@ def flash_attention(q, k, v, *, causal: bool = True,
     B, Sq, H, D = q.shape
     if scale is None:
         scale = 1.0 / (D ** 0.5)
-    block_q = min(block_q, Sq)
-    block_k = min(block_k, k.shape[1])
-    if Sq % block_q or k.shape[1] % block_k:
+    bq = _pick_block(Sq, None if block_q == DEFAULT_BLOCK_Q else block_q)
+    bk = _pick_block(k.shape[1], None if block_k == DEFAULT_BLOCK_K else block_k)
+    if bq is None or bk is None:
         raise ValueError(
             f"flash_attention requires seq lengths divisible by block sizes: "
-            f"Sq={Sq} % {block_q}, Skv={k.shape[1]} % {block_k}")
+            f"Sq={Sq} (block_q={block_q}), Skv={k.shape[1]} (block_k={block_k})")
+    block_q, block_k = bq, bk
     if q.shape[2] % k.shape[2]:
         raise ValueError(
             f"GQA requires num q heads ({q.shape[2]}) divisible by kv heads "
