@@ -65,22 +65,28 @@ def main():
         loss = engine.train_batch(batch)
     jax.block_until_ready(loss)
 
-    t0 = time.perf_counter()
-    for _ in range(steps):
-        loss = engine.train_batch(batch)
-    jax.block_until_ready(loss)
-    dt = time.perf_counter() - t0
-
-    tokens_per_step = B * seq_len
-    tok_s = tokens_per_step * steps / dt
-    tok_s_chip = tok_s / n_dev
-
     n_params = engine.num_parameters()
     flops_per_token = 6 * n_params  # fwd+bwd dense-transformer rule of thumb
-    tflops_chip = tok_s_chip * flops_per_token / 1e12
     kind = jax.devices()[0].device_kind
     peak = next((v for k, v in PEAK_BF16_TFLOPS.items() if k in str(kind)), None)
-    mfu = tflops_chip / peak if peak else 0.0
+    tokens_per_step = B * seq_len
+
+    # remote backends occasionally replay cached step results, yielding
+    # impossible (>peak) throughput; retry until the measurement is physical
+    for attempt in range(4):
+        t0 = time.perf_counter()
+        for _ in range(steps):
+            loss = engine.train_batch(batch)
+        jax.block_until_ready(loss)
+        dt = time.perf_counter() - t0
+        tok_s = tokens_per_step * steps / dt
+        tok_s_chip = tok_s / n_dev
+        tflops_chip = tok_s_chip * flops_per_token / 1e12
+        mfu = tflops_chip / peak if peak else 0.0
+        if peak is None or mfu <= 1.0:
+            break
+        print(f"# suspect measurement (mfu={mfu:.2f} > 1); retrying",
+              flush=True)
 
     print(json.dumps({
         "metric": f"{model_name} ZeRO train throughput "

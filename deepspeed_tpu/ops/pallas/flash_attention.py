@@ -39,24 +39,36 @@ except ImportError:  # pragma: no cover
 
 NEG_INF = float(jnp.finfo(jnp.float32).min)
 
-# Measured on v5e (gpt2-350m shapes, B8 H16 S1024 D64): 128x128 blocks run
-# ~1000x slower than 256+ (per-grid-step overhead dominates the tiny tiles
-# and the [*,64]-lane blocks relayout poorly); 512x512 was fastest across
-# the sweep. Blocks clamp to the sequence length for short inputs, which
-# collapses the grid and stays fast.
-DEFAULT_BLOCK_Q = 512
-DEFAULT_BLOCK_K = 512
+# Block policy, measured on v5e (gpt2-350m shapes, B8 H16 S1024 D64):
+# per-grid-invocation overhead dominates small tiles — 128x128 blocks ran
+# ~1000x slower than 256+, and fewer/fatter invocations kept winning
+# (1024 > 512 > 256 in end-to-end bench). Blocks clamp to the sequence for
+# short inputs (single-block grid). VMEM bounds the choice from above: the
+# bwd kernels keep ~4 [bq,bk] fp32 intermediates plus the q/k/v/do blocks
+# live, so the picker shrinks along _FAST_BLOCKS until the estimate fits.
+DEFAULT_BLOCK_Q = 1024
+DEFAULT_BLOCK_K = 1024
 #: below this, the XLA fused attention is both fast and memory-cheap
 MIN_SEQ = 128
-#: divisor fallbacks stay in the fast regime (128 measured ~1000x slower)
-_FAST_BLOCKS = (512, 256)
+#: divisor fallbacks, fastest first
+_FAST_BLOCKS = (1024, 512, 256)
+#: usable VMEM budget per core (conservative across TPU generations)
+VMEM_BUDGET_BYTES = 12 * 1024 * 1024
+
+
+def _vmem_estimate(bq: int, bk: int, d: int, dtype_bytes: int) -> int:
+    """Rough peak VMEM of the bwd kernels: 4 fp32 [bq,bk] intermediates +
+    double-buffered q/do [bq,d] and k/v [bk,d] blocks + fp32 scratch."""
+    inter = 4 * bq * bk * 4
+    blocks = 2 * (2 * bq * d + 2 * bk * d) * dtype_bytes
+    scratch = (bq + bk) * d * 4
+    return inter + blocks + scratch
 
 
 def _pick_block(seq: int, requested: int | None = None) -> int | None:
-    """The block size both the gate and the kernel agree on: an explicit
-    request is honored when it divides the sequence; otherwise a whole-seq
-    single block (seq <= default) or the largest fast divisor. None → the
-    kernel should not be used for this length."""
+    """Divisibility-only choice for one axis: an explicit request is honored
+    when it divides the sequence; otherwise a whole-seq single block
+    (seq <= default) or the largest fast divisor. None → unusable."""
     if requested is not None and requested < seq:
         return requested if seq % requested == 0 else None
     if seq <= DEFAULT_BLOCK_Q:
@@ -65,6 +77,47 @@ def _pick_block(seq: int, requested: int | None = None) -> int | None:
         if seq % cand == 0:
             return cand
     return None
+
+
+def _pick_blocks(Sq: int, Skv: int, d: int, dtype_bytes: int,
+                 req_q: int | None = None, req_k: int | None = None
+                 ) -> tuple[int, int] | None:
+    """(block_q, block_k) satisfying divisibility AND the VMEM budget —
+    the single source of truth for the gate and the kernel launcher.
+    Explicit requests are honored verbatim (the caller owns the tradeoff)."""
+    bq = _pick_block(Sq, req_q)
+    bk = _pick_block(Skv, req_k)
+    if bq is None or bk is None:
+        return None
+    if req_q is not None or req_k is not None:
+        return bq, bk
+    while _vmem_estimate(bq, bk, d, dtype_bytes) > VMEM_BUDGET_BYTES:
+        # shrink the larger axis to its next fast divisor of the seq
+        def next_down(cur, seq):
+            for cand in _FAST_BLOCKS:
+                if cand < cur and seq % cand == 0:
+                    return cand
+            return None
+
+        if bq >= bk:
+            nxt = next_down(bq, Sq)
+            if nxt is None:
+                nxt_k = next_down(bk, Skv)
+                if nxt_k is None:
+                    return None
+                bk = nxt_k
+            else:
+                bq = nxt
+        else:
+            nxt = next_down(bk, Skv)
+            if nxt is None:
+                nxt_q = next_down(bq, Sq)
+                if nxt_q is None:
+                    return None
+                bq = nxt_q
+            else:
+                bk = nxt
+    return bq, bk
 
 
 def _interpret() -> bool:
@@ -93,7 +146,7 @@ def flash_attention_usable(q, k, v, *, causal: bool, positions=None,
         return False
     if Sq < MIN_SEQ:                   # tiny: XLA is fast and cheap anyway
         return False
-    if _pick_block(Sq) is None or _pick_block(Skv) is None:
+    if _pick_blocks(Sq, Skv, D, q.dtype.itemsize) is None:
         return False
     if H % KV != 0:
         return False
@@ -383,20 +436,23 @@ _flash.defvjp(_flash_fwd, _bwd)
 
 
 def flash_attention(q, k, v, *, causal: bool = True,
-                    block_q: int = DEFAULT_BLOCK_Q,
-                    block_k: int = DEFAULT_BLOCK_K,
+                    block_q: int | None = None,
+                    block_k: int | None = None,
                     scale: float | None = None) -> Any:
     """q: [B,Sq,H,D]; k/v: [B,Skv,KV,D]. Returns [B,Sq,H,D]."""
     B, Sq, H, D = q.shape
     if scale is None:
         scale = 1.0 / (D ** 0.5)
-    bq = _pick_block(Sq, None if block_q == DEFAULT_BLOCK_Q else block_q)
-    bk = _pick_block(k.shape[1], None if block_k == DEFAULT_BLOCK_K else block_k)
-    if bq is None or bk is None:
+    picked = _pick_blocks(Sq, k.shape[1], D, q.dtype.itemsize,
+                          block_q, block_k)
+    if picked is None:
         raise ValueError(
-            f"flash_attention requires seq lengths divisible by block sizes: "
-            f"Sq={Sq} (block_q={block_q}), Skv={k.shape[1]} (block_k={block_k})")
-    block_q, block_k = bq, bk
+            f"flash_attention cannot block Sq={Sq}/Skv={k.shape[1]}: "
+            f"sequences <= {DEFAULT_BLOCK_Q} run as one block, longer ones "
+            f"need a divisor in {_FAST_BLOCKS} (pad the sequence, e.g. to a "
+            f"multiple of {_FAST_BLOCKS[-1]}), and explicit block_q/block_k "
+            f"must divide the sequence")
+    block_q, block_k = picked
     if q.shape[2] % k.shape[2]:
         raise ValueError(
             f"GQA requires num q heads ({q.shape[2]}) divisible by kv heads "

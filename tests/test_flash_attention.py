@@ -73,8 +73,8 @@ def test_bf16_forward_close():
 
 
 def test_usable_gate():
-    # tiny seqs go to XLA (fast + cheap there); 128..512 collapse to one
-    # block; longer seqs need a fast divisor (512/256) — 600 has none
+    # tiny seqs go to XLA (fast + cheap there); 128..1024 collapse to one
+    # block; longer seqs need a fast divisor (1024/512/256)
     q = jnp.zeros((1, 100, 4, 64))
     k = v = jnp.zeros((1, 100, 4, 64))
     assert not flash_attention_usable(q, k, v, causal=True,
@@ -83,13 +83,13 @@ def test_usable_gate():
     k1 = v1 = jnp.zeros((1, 384, 4, 64))
     assert flash_attention_usable(q1, k1, v1, causal=True,
                                   allow_multi_device=True)
-    qm = jnp.zeros((1, 600, 4, 64))
-    km = vm = jnp.zeros((1, 600, 4, 64))
+    qm = jnp.zeros((1, 1250, 4, 64))   # >1024, no fast divisor
+    km = vm = jnp.zeros((1, 1250, 4, 64))
     assert not flash_attention_usable(qm, km, vm, causal=True,
                                       allow_multi_device=True)
-    # multiple of 256 but not 512 → fast divisor fallback keeps the kernel
-    q2 = jnp.zeros((1, 768, 4, 64))
-    k2 = v2 = jnp.zeros((1, 768, 4, 64))
+    # multiple of 512 but not 1024 → fast divisor fallback keeps the kernel
+    q2 = jnp.zeros((1, 1536, 4, 64))
+    k2 = v2 = jnp.zeros((1, 1536, 4, 64))
     assert flash_attention_usable(q2, k2, v2, causal=True,
                                   allow_multi_device=True)
     q2 = jnp.zeros((1, 1, 4, 64))    # decode shape
@@ -104,11 +104,12 @@ def test_usable_gate():
 
 
 def test_shape_validation():
-    # blocks clamp to seq, so only lengths NOT divisible by the clamped
-    # block fail (600 % 512 != 0); short seqs like 150 collapse to one block
-    q = jnp.zeros((1, 600, 4, 64))
-    k = v = jnp.zeros((1, 600, 4, 64))
-    with pytest.raises(ValueError, match="divisible by block"):
+    # blocks clamp to seq, so only long lengths with NO fast divisor fail
+    # (1250 > 1024 and not a multiple of 1024/512/256); short seqs like 150
+    # collapse to one block
+    q = jnp.zeros((1, 1250, 4, 64))
+    k = v = jnp.zeros((1, 1250, 4, 64))
+    with pytest.raises(ValueError, match="cannot block"):
         flash_attention(q, k, v, causal=True)
     out = flash_attention(jnp.zeros((1, 150, 4, 64)),
                           jnp.zeros((1, 150, 4, 64)),
