@@ -52,8 +52,11 @@ DEFAULT_BLOCK_K = 1024
 MIN_SEQ = 128
 #: divisor fallbacks, fastest first
 _FAST_BLOCKS = (1024, 512, 256)
-#: usable VMEM budget per core (conservative across TPU generations)
-VMEM_BUDGET_BYTES = 12 * 1024 * 1024
+#: usable VMEM budget per core. 1024x1024 blocks (16 MiB of fp32
+#: intermediates) measured to compile and run fastest on v5e — Mosaic
+#: spills what doesn't fit — so the budget is a soft bound that still
+#: rejects runaway combinations (long-seq x large-D fp32).
+VMEM_BUDGET_BYTES = 24 * 1024 * 1024
 
 
 def _vmem_estimate(bq: int, bk: int, d: int, dtype_bytes: int) -> int:
@@ -89,34 +92,36 @@ def _pick_blocks(Sq: int, Skv: int, d: int, dtype_bytes: int,
     bk = _pick_block(Skv, req_k)
     if bq is None or bk is None:
         return None
-    if req_q is not None or req_k is not None:
-        return bq, bk
-    while _vmem_estimate(bq, bk, d, dtype_bytes) > VMEM_BUDGET_BYTES:
-        # shrink the larger axis to its next fast divisor of the seq
-        def next_down(cur, seq):
-            for cand in _FAST_BLOCKS:
-                if cand < cur and seq % cand == 0:
-                    return cand
-            return None
+    if req_q is not None and req_k is not None:
+        return bq, bk  # caller owns the whole tradeoff
 
-        if bq >= bk:
-            nxt = next_down(bq, Sq)
-            if nxt is None:
-                nxt_k = next_down(bk, Skv)
-                if nxt_k is None:
-                    return None
-                bk = nxt_k
+    def next_down(cur, seq):
+        for cand in _FAST_BLOCKS:
+            if cand < cur and seq % cand == 0:
+                return cand
+        return None
+
+    # shrink only axes the caller did NOT pin, larger axis first
+    while _vmem_estimate(bq, bk, d, dtype_bytes) > VMEM_BUDGET_BYTES:
+        cands = []
+        if req_q is None:
+            cands.append(("q", bq))
+        if req_k is None:
+            cands.append(("k", bk))
+        cands.sort(key=lambda t: -t[1])
+        for axis, _ in cands:
+            if axis == "q":
+                nxt = next_down(bq, Sq)
+                if nxt is not None:
+                    bq = nxt
+                    break
             else:
-                bq = nxt
+                nxt = next_down(bk, Skv)
+                if nxt is not None:
+                    bk = nxt
+                    break
         else:
-            nxt = next_down(bk, Skv)
-            if nxt is None:
-                nxt_q = next_down(bq, Sq)
-                if nxt_q is None:
-                    return None
-                bq = nxt_q
-            else:
-                bk = nxt
+            return None
     return bq, bk
 
 

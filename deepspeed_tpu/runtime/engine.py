@@ -885,6 +885,12 @@ class DeepSpeedEngine:
 
         return _load(self, load_dir, tag=tag)
 
+    def wait_for_checkpoint(self) -> None:
+        """Block until an async checkpoint save has committed."""
+        from .checkpointing import wait_for_checkpoint as _wait
+
+        _wait(self)
+
 
 # --------------------------------------------------------------------------
 def initialize(model: nn.Module | None = None,
