@@ -13,9 +13,10 @@ Architecture (TPU-first):
 - Each step is one of two cached jitted programs — prefill ([S, chunk]
   prompt chunks) or decode ([S, 1]) — built by the SplitFuse scheduler
   (inference/scheduler.py). New KV is scattered into the pool by flat token
-  slot; attention gathers each slot's pages via its block table and runs
-  masked attention against them (the XLA formulation of the blocked-flash
-  paged kernel; a Pallas in-place paged kernel is the optimization path).
+  slot; decode steps ([S, 1]) run the Pallas paged-attention kernel
+  (ops/pallas/paged_attention.py) which DMAs pages straight out of the
+  pool via scalar-prefetched block tables; prefill chunks use the XLA
+  gather formulation of the same math.
 - The model is the SAME TransformerLM parameter tree the trainer produces —
   no weight surgery; the ragged forward reads the tree directly.
 """
@@ -37,11 +38,13 @@ from ..models.transformer import (
     ModelConfig,
     Norm,
     TransformerLM,
+    apply_rope,
     default_activation_rules,
-    rope,
 )
 from ..parallel.topology import MeshConfig, MeshTopology
 from ..utils.logging import logger
+from ..ops.pallas.paged_attention import (paged_attention_usable,
+                                          paged_decode_attention)
 from .ragged import StateManager, StepPlan
 from .sampling import sample_logits
 from .scheduler import SplitFuseScheduler
@@ -64,6 +67,9 @@ class RaggedInferenceConfig:
     temperature: float = 1.0
     top_k: int = 0
     top_p: float = 1.0
+    #: use the Pallas paged-attention kernel for decode steps; None = auto
+    #: (on whenever the kernel supports the model's head geometry)
+    use_pallas_decode: bool | None = None
 
 
 class InferenceEngineV2:
@@ -77,9 +83,6 @@ class InferenceEngineV2:
         cfg = self.config
         self.model = model
         self.mcfg: ModelConfig = model.config
-        if self.mcfg.moe is not None:
-            raise NotImplementedError("MoE ragged inference lands with the "
-                                      "grouped-GEMM decode path")
         if topology is None:
             topology = MeshTopology(MeshConfig(tensor=cfg.tensor_parallel, data=1))
         self.topology = topology
@@ -94,15 +97,32 @@ class InferenceEngineV2:
         self.params, _ = load_tp_params(model, params, rng, topology, cfg.dtype)
 
         # --- the paged KV pool -------------------------------------------
+        # [L, 2, KV, P, D]: kv-head-major so the Pallas kernel's page DMA
+        # ([1, 1, block_size, D] tiles) reads contiguous HBM.
         m = self.mcfg
         pool_tokens = cfg.num_blocks * cfg.block_size
-        kv_spec = P(None, None, None, "tensor", None) \
+        kv_spec = P(None, None, "tensor", None, None) \
             if m.kv_heads % max(topology.size("tensor"), 1) == 0 else \
             P(None, None, None, None, None)
         self._pool_sharding = NamedSharding(topology.mesh, kv_spec)
         self.kv_pool = jax.device_put(
-            jnp.zeros((m.num_layers, 2, pool_tokens, m.kv_heads, m.head_dim),
+            jnp.zeros((m.num_layers, 2, m.kv_heads, pool_tokens, m.head_dim),
                       cfg.dtype), self._pool_sharding)
+
+        # alibi needs a positional bias inside the kernel — XLA path only;
+        # pallas_call has no GSPMD rule, so multi-device meshes are out too
+        pallas_ok = (paged_attention_usable(m.num_heads, m.kv_heads,
+                                            m.head_dim, cfg.block_size)
+                     and m.position_embedding != "alibi"
+                     and topology.mesh.size == 1)
+        if cfg.use_pallas_decode and not pallas_ok:
+            raise ValueError(
+                "use_pallas_decode=True but the paged decode kernel does not "
+                "support this setup (needs head_dim in {64,128,256}, "
+                "block_size % 8 == 0, heads % kv_heads == 0, no alibi, "
+                "single-device mesh)")
+        self._pallas_decode = pallas_ok if cfg.use_pallas_decode is None \
+            else cfg.use_pallas_decode
 
         self._programs: dict[int, Any] = {}
         self._rng = jax.random.PRNGKey(17)
@@ -136,48 +156,97 @@ class InferenceEngineV2:
         page_index = (block_tables[:, :, None] * bs +
                       jnp.arange(bs)[None, None, :]).reshape(S, ctx)  # [S,ctx]
 
-        def layer(x, layer_params_and_kv):
-            p, kv = layer_params_and_kv                            # kv [2,P,KV,D]
-            h = Norm(m).apply({"params": p["ln_attn"]}, x)
+        def ffn(p, h, use_moe: bool):
+            if use_moe:
+                from ..moe.layer import MoE
+
+                mo = m.moe
+                # drop_tokens=False: generation must not drop routed tokens
+                # (the FastGen v2 MoE contract — reference inference/v2
+                # mixtral routes every token); token counts per step are
+                # tiny so the no-drop capacity is cheap. NB this diverges
+                # from the v1/training forward exactly when eval capacity
+                # would bind — there v1 drops overflow tokens, v2 doesn't.
+                mod = MoE(hidden_size=m.hidden_size,
+                          num_experts=mo.num_experts, ffn_size=m.ffn_size,
+                          k=mo.top_k, min_capacity=mo.min_capacity,
+                          drop_tokens=False,
+                          activation="silu_glu" if m.activation == "silu_glu"
+                          else "gelu")
+                return mod.apply({"params": p["moe"]["moe_layer"]}, h, True)
+            return DenseFFN(m).apply({"params": p["ffn"]}, h)
+
+        def attention(p, kv, h):
+            """QKV → scatter into pool → paged attention. Returns (o, kv)."""
             a = p["attn"]
             q = jnp.einsum("ste,ehd->sthd", h, a["wq"].astype(cfg.dtype))
             k = jnp.einsum("ste,ehd->sthd", h, a["wk"].astype(cfg.dtype))
             v = jnp.einsum("ste,ehd->sthd", h, a["wv"].astype(cfg.dtype))
+            if m.qkv_bias:
+                q = q + a["bq"].astype(cfg.dtype)
+                k = k + a["bk"].astype(cfg.dtype)
+                v = v + a["bv"].astype(cfg.dtype)
             if m.position_embedding == "rope":
-                q, k = rope(q, k, positions, m.rope_theta)
+                q, k = apply_rope(q, k, positions, m.rope_theta, m.rotary_pct)
 
-            # scatter new KV into the pool (trash block absorbs padding)
-            kv = kv.at[0, flat_slots].set(k.reshape(-1, KV, D).astype(kv.dtype))
-            kv = kv.at[1, flat_slots].set(v.reshape(-1, KV, D).astype(kv.dtype))
+            # scatter new KV into the pool (trash block absorbs padding).
+            # NB: (0, :, flat_slots) mixes non-consecutive advanced indices,
+            # so the token dim lands in FRONT of the result → [S*T, KV, D].
+            kv = kv.at[0, :, flat_slots].set(
+                k.reshape(-1, KV, D).astype(kv.dtype))
+            kv = kv.at[1, :, flat_slots].set(
+                v.reshape(-1, KV, D).astype(kv.dtype))
 
-            # gather each slot's pages: [S, ctx, KV, D]
-            K = kv[0, page_index]
-            V = kv[1, page_index]
-            if KV != H:
-                K = jnp.repeat(K, H // KV, axis=2)
-                V = jnp.repeat(V, H // KV, axis=2)
+            if T == 1 and self._pallas_decode:
+                # decode: Pallas kernel pages K/V straight out of the pool
+                o = paged_decode_attention(
+                    q[:, 0], kv[0], kv[1], block_tables, seq_lens,
+                    block_size=bs)[:, None]                        # [S,1,H,D]
+            else:
+                # prefill/mixed: gather each slot's pages. Advanced-index
+                # placement again: result is [S, ctx, KV, D] directly.
+                K = kv[0, :, page_index]
+                V = kv[1, :, page_index]
+                if KV != H:
+                    K = jnp.repeat(K, H // KV, axis=2)
+                    V = jnp.repeat(V, H // KV, axis=2)
 
-            scores = jnp.einsum("sthd,schd->shtc", q, K).astype(jnp.float32)
-            scores = scores / (D ** 0.5)
-            # pages are position-ordered, so context index j IS absolute
-            # position j: valid iff j < seq_len, causal iff j <= query pos
-            cpos = jnp.arange(ctx)[None, :]
-            valid = (cpos < seq_lens[:, None])[:, None, None, :]
-            causal = cpos[:, None, :] <= positions[:, :, None]     # [S,T,ctx]
-            mask = valid & causal[:, None, :, :]
-            scores = jnp.where(mask, scores, jnp.finfo(jnp.float32).min)
-            w = jax.nn.softmax(scores, axis=-1).astype(V.dtype)
-            o = jnp.einsum("shtc,schd->sthd", w, V)
+                scores = jnp.einsum("sthd,schd->shtc", q, K).astype(jnp.float32)
+                scores = scores / (D ** 0.5)
+                if m.position_embedding == "alibi":
+                    from ..models.transformer import alibi_slopes
+
+                    slopes = alibi_slopes(H)                       # [H]
+                    rel = (jnp.arange(ctx, dtype=jnp.float32)[None, None, None, :]
+                           - positions[:, None, :, None].astype(jnp.float32))
+                    scores = scores + slopes[None, :, None, None] * rel
+                # pages are position-ordered, so context index j IS absolute
+                # position j: valid iff j < seq_len, causal iff j <= query pos
+                cpos = jnp.arange(ctx)[None, :]
+                valid = (cpos < seq_lens[:, None])[:, None, None, :]
+                causal = cpos[:, None, :] <= positions[:, :, None]  # [S,T,ctx]
+                mask = valid & causal[:, None, :, :]
+                scores = jnp.where(mask, scores, jnp.finfo(jnp.float32).min)
+                w = jax.nn.softmax(scores, axis=-1).astype(V.dtype)
+                o = jnp.einsum("shtc,schd->sthd", w, V)
             o = jnp.einsum("sthd,hde->ste", o, a["wo"].astype(cfg.dtype))
-            x = x + o
+            return o, kv
 
-            h = Norm(m).apply({"params": p["ln_ffn"]}, x)
-            x = x + DenseFFN(m).apply({"params": p["ffn"]}, h)
-            return x, kv
+        def layer(x, i, p, kv):                                    # kv [2,KV,P,D]
+            use_moe = bool(m.moe) and (i % (m.moe.moe_layer_freq or 1) == 0)
+            h_attn = Norm(m).apply({"params": p["ln_attn"]}, x)
+            o, kv = attention(p, kv, h_attn)
+            if m.parallel_block:
+                h_ffn = h_attn if m.parallel_block_norms == 1 else \
+                    Norm(m).apply({"params": p["ln_ffn"]}, x)
+                return x + o + ffn(p, h_ffn, use_moe), kv
+            x = x + o
+            h_ffn = Norm(m).apply({"params": p["ln_ffn"]}, x)
+            return x + ffn(p, h_ffn, use_moe), kv
 
         new_kv = []
         for i in range(m.num_layers):
-            x, kv_i = layer(x, (params[f"layer_{i}"], kv_pool[i]))
+            x, kv_i = layer(x, i, params[f"layer_{i}"], kv_pool[i])
             new_kv.append(kv_i)
         kv_pool = jnp.stack(new_kv)
 

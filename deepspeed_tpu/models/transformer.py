@@ -74,6 +74,10 @@ class MoEConfig:
     router_z_loss_weight: float = 0.001
     # layers where MoE replaces dense FFN; every Nth layer (1 = all)
     moe_layer_freq: int = 1
+    # dropless (megablocks-style) routing through the Pallas grouped GEMM
+    # instead of capacity-dispatch einsums (ops/pallas/grouped_matmul.py)
+    dropless: bool = False
+    dropless_block_m: int = 128
 
 
 @dataclass(frozen=True)
@@ -201,6 +205,19 @@ def rope(q: jax.Array, k: jax.Array, positions: jax.Array, theta: float) -> tupl
     return rot(q.astype(jnp.float32)).astype(q.dtype), rot(k.astype(jnp.float32)).astype(k.dtype)
 
 
+def apply_rope(q: jax.Array, k: jax.Array, positions: jax.Array,
+               theta: float, rotary_pct: float = 1.0) -> tuple[jax.Array, jax.Array]:
+    """Full or partial (gpt-neox ``rotary_pct`` / phi) rotary embedding —
+    the single implementation shared by training attention and the ragged
+    inference forward."""
+    if rotary_pct >= 1.0:
+        return rope(q, k, positions, theta)
+    d_rot = (int(q.shape[-1] * rotary_pct) // 2) * 2
+    qr, kr = rope(q[..., :d_rot], k[..., :d_rot], positions, theta)
+    return (jnp.concatenate([qr, q[..., d_rot:]], axis=-1),
+            jnp.concatenate([kr, k[..., d_rot:]], axis=-1))
+
+
 class Attention(nn.Module):
     """Causal self-attention with GQA + optional RoPE + KV cache.
 
@@ -239,16 +256,7 @@ class Attention(nn.Module):
             v = v + bv.astype(cfg.dtype)
 
         if cfg.position_embedding == "rope":
-            if cfg.rotary_pct >= 1.0:
-                q, k = rope(q, k, positions, cfg.rope_theta)
-            else:
-                # partial rotary (gpt-neox rotary_pct / phi): rotate the
-                # leading fraction of each head dim, pass the rest through
-                d_rot = (int(D * cfg.rotary_pct) // 2) * 2
-                qr, kr = rope(q[..., :d_rot], k[..., :d_rot], positions,
-                              cfg.rope_theta)
-                q = jnp.concatenate([qr, q[..., d_rot:]], axis=-1)
-                k = jnp.concatenate([kr, k[..., d_rot:]], axis=-1)
+            q, k = apply_rope(q, k, positions, cfg.rope_theta, cfg.rotary_pct)
 
         new_cache = None
         if kv_cache is not None:
@@ -347,6 +355,8 @@ class MoEFFN(nn.Module):
             activation="silu_glu" if cfg.activation == "silu_glu" else "gelu",
             aux_loss_weight=moe.aux_loss_weight,
             z_loss_weight=moe.router_z_loss_weight,
+            dropless=moe.dropless,
+            dropless_block_m=moe.dropless_block_m,
             name="moe_layer")(x, deterministic)
 
 

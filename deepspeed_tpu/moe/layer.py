@@ -21,7 +21,7 @@ import jax
 import jax.numpy as jnp
 
 from ..parallel.axes import BATCH, EMBED, EXPERT, SEQ, constrain as _constrain
-from .sharded_moe import GateOutput, topkgating
+from .sharded_moe import GateOutput, topk_dropless_gating, topkgating
 
 
 class TopKGate(nn.Module):
@@ -35,9 +35,10 @@ class TopKGate(nn.Module):
     min_capacity: int = 4
     noisy_gate_policy: str | None = None     # None | 'RSample'
     drop_tokens: bool = True
+    dropless: bool = False
 
     @nn.compact
-    def __call__(self, x: jax.Array, deterministic: bool = True) -> GateOutput:
+    def __call__(self, x: jax.Array, deterministic: bool = True):
         wg = self.param(
             "wg",
             nn.with_partitioning(nn.initializers.variance_scaling(
@@ -47,6 +48,8 @@ class TopKGate(nn.Module):
         rng = None
         if self.noisy_gate_policy == "RSample" and not deterministic:
             rng = self.make_rng("gating")
+        if self.dropless:
+            return topk_dropless_gating(logits, self.k, noise_rng=rng)
         return topkgating(
             logits, self.k,
             self.eval_capacity_factor if deterministic else self.capacity_factor,
@@ -65,24 +68,41 @@ class Experts(nn.Module):
     activation: str = "silu_glu"
 
     @nn.compact
-    def __call__(self, x: jax.Array) -> jax.Array:    # [n, g, cap, E]
+    def __call__(self, x: jax.Array, sort=None,
+                 block_m: int = 128) -> jax.Array:
+        """Capacity mode (``sort=None``): x [n, g, cap, E] → same shape.
+        Dropless mode: x is the expert-sorted padded buffer [Tp, E] and
+        ``sort`` an ``ExpertSort``; experts run as Pallas grouped GEMMs
+        (reference cutlass_ops/moe_gemm analogue)."""
         E, F, n = self.hidden_size, self.ffn_size, self.num_experts
         init = nn.initializers.variance_scaling(1.0, "fan_in", "normal")
         dtype = x.dtype
-        if self.activation == "silu_glu":
+        glu = self.activation == "silu_glu"
+        if glu:
             wg = self.param("w_gate", nn.with_partitioning(
                 init, ("expert", "embed", "expert_mlp")), (n, E, F), jnp.float32)
-            wu = self.param("w_up", nn.with_partitioning(
-                init, ("expert", "embed", "expert_mlp")), (n, E, F), jnp.float32)
-            wd = self.param("w_down", nn.with_partitioning(
-                init, ("expert", "expert_mlp", "embed")), (n, F, E), jnp.float32)
+        wu = self.param("w_up", nn.with_partitioning(
+            init, ("expert", "embed", "expert_mlp")), (n, E, F), jnp.float32)
+        wd = self.param("w_down", nn.with_partitioning(
+            init, ("expert", "expert_mlp", "embed")), (n, F, E), jnp.float32)
+
+        if sort is not None:
+            from ..ops.pallas.grouped_matmul import grouped_matmul
+
+            te = sort.tile_expert
+            if glu:
+                h = jax.nn.silu(grouped_matmul(x, wg.astype(dtype), te,
+                                               block_m)) * \
+                    grouped_matmul(x, wu.astype(dtype), te, block_m)
+            else:
+                h = jax.nn.gelu(grouped_matmul(x, wu.astype(dtype), te,
+                                               block_m))
+            return grouped_matmul(h, wd.astype(dtype), te, block_m)
+
+        if glu:
             h = jax.nn.silu(jnp.einsum("ngce,nef->ngcf", x, wg.astype(dtype))) * \
                 jnp.einsum("ngce,nef->ngcf", x, wu.astype(dtype))
         else:
-            wu = self.param("w_up", nn.with_partitioning(
-                init, ("expert", "embed", "expert_mlp")), (n, E, F), jnp.float32)
-            wd = self.param("w_down", nn.with_partitioning(
-                init, ("expert", "expert_mlp", "embed")), (n, F, E), jnp.float32)
             h = jax.nn.gelu(jnp.einsum("ngce,nef->ngcf", x, wu.astype(dtype)))
         return jnp.einsum("ngcf,nfe->ngce", h, wd.astype(dtype))
 
@@ -106,6 +126,11 @@ class MoE(nn.Module):
     activation: str = "silu_glu"
     aux_loss_weight: float = 0.01
     z_loss_weight: float = 0.001
+    #: megablocks-style dropless routing via the Pallas grouped GEMM.
+    #: Single-device / shard_map-local only (pallas_call has no GSPMD
+    #: partitioning rule) — the capacity path is the multi-device default.
+    dropless: bool = False
+    dropless_block_m: int = 128
 
     @nn.compact
     def __call__(self, x: jax.Array, deterministic: bool = True) -> jax.Array:
@@ -117,11 +142,33 @@ class MoE(nn.Module):
             eval_capacity_factor=self.eval_capacity_factor,
             min_capacity=self.min_capacity,
             noisy_gate_policy=self.noisy_gate_policy,
-            drop_tokens=self.drop_tokens, name="gate")(x, deterministic)
+            drop_tokens=self.drop_tokens, dropless=self.dropless,
+            name="gate")(x, deterministic)
 
         self.sow("losses", "moe_aux_loss",
                  gate.aux_loss * self.aux_loss_weight +
                  gate.z_loss * self.z_loss_weight)
+
+        if self.dropless:
+            from ..ops.pallas.grouped_matmul import sort_tokens_by_expert
+
+            bm = self.dropless_block_m
+            flat = x.reshape(B * S, E)                       # [T, E]
+            srt = sort_tokens_by_expert(
+                gate.experts.reshape(B * S, self.k), self.num_experts, bm)
+            rows = jnp.repeat(flat, self.k, axis=0)          # [T*k, E]
+            buf = jnp.zeros((srt.Tp, E), dtype).at[srt.dst].set(rows)
+            out_buf = Experts(
+                hidden_size=self.hidden_size,
+                ffn_size=self.ffn_size or 4 * self.hidden_size,
+                num_experts=self.num_experts,
+                activation=self.activation, name="experts")(
+                    buf, sort=srt, block_m=bm)
+            rows_out = out_buf[srt.dst].reshape(B * S, self.k, E)
+            y = jnp.einsum("tk,tke->te",
+                           gate.gates.reshape(B * S, self.k).astype(dtype),
+                           rows_out)
+            return _constrain(y.reshape(B, S, E), BATCH, SEQ, EMBED)
 
         # dispatch: [B,S,E] tokens → [n, B, cap, E] expert inputs. Under
         # GSPMD this einsum IS the expert all-to-all (_AllToAll :96).

@@ -102,6 +102,45 @@ def topkgating(logits: jax.Array,
                       exp_counts=exp_counts, z_loss=z_loss)
 
 
+class DroplessGateOutput(NamedTuple):
+    """Routing for the dropless (megablocks-style) path: raw top-k choices
+    instead of capacity masks."""
+    gates: jax.Array           # [G, S, k] normalized gate weights
+    experts: jax.Array         # [G, S, k] int32 expert ids
+    aux_loss: jax.Array
+    z_loss: jax.Array
+    exp_counts: jax.Array      # [n]
+
+
+def topk_dropless_gating(logits: jax.Array, k: int, *,
+                         noise_rng: jax.Array | None = None,
+                         noise_eps: float = 1e-2,
+                         normalize_gates: bool = True) -> DroplessGateOutput:
+    """Top-k routing with NO capacity and NO drops — every token reaches
+    all k chosen experts (the megablocks contract; tokens are instead
+    block-aligned per expert by ``sort_tokens_by_expert``)."""
+    G, S, n = logits.shape
+    logits = logits.astype(jnp.float32)
+    if noise_rng is not None:
+        logits = logits + jax.random.normal(noise_rng, logits.shape) * noise_eps
+    probs = jax.nn.softmax(logits, axis=-1)
+    gate_vals, expert_idx = jax.lax.top_k(probs, k)                # [G,S,k]
+    if normalize_gates:
+        gate_vals = gate_vals / jnp.maximum(
+            jnp.sum(gate_vals, axis=-1, keepdims=True), 1e-9)
+
+    onehot = jax.nn.one_hot(expert_idx, n, dtype=jnp.float32)      # [G,S,k,n]
+    me = jnp.mean(probs, axis=(0, 1))
+    ce = jnp.mean(jnp.sum(onehot, axis=2), axis=(0, 1))
+    aux_loss = jnp.sum(me * ce) * n
+    z_loss = jnp.mean(jnp.square(jax.nn.logsumexp(logits, axis=-1)))
+    exp_counts = jnp.sum(onehot, axis=(0, 1, 2))
+    return DroplessGateOutput(gates=gate_vals,
+                              experts=expert_idx.astype(jnp.int32),
+                              aux_loss=aux_loss, z_loss=z_loss,
+                              exp_counts=exp_counts)
+
+
 def top1gating(logits: jax.Array, capacity_factor: float = 1.0,
                min_capacity: int = 4, **kw) -> GateOutput:
     """Switch-style top-1 gating (reference top1gating :183)."""

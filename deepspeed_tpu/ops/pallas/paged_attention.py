@@ -1,0 +1,157 @@
+"""Paged (block-table) attention as a Pallas TPU kernel — the decode path.
+
+TPU-native equivalent of the reference's blocked-flash ragged attention
+(/root/reference/deepspeed/inference/v2/kernels/ragged_ops/blocked_flash/
+blocked_flash.py:64, a flash-attn-2 variant reading K/V through a paged KV
+cache). Re-designed for the TPU pipeline model rather than translated:
+
+- The KV pool lives in HBM as [KV, num_blocks, block_size, D]. Each grid
+  step DMAs ONE page of ONE kv head into VMEM; the page index comes from a
+  scalar-prefetched block table (``pltpu.PrefetchScalarGridSpec``), so the
+  gather happens in the DMA engine — no [S, ctx, KV, D] materialization
+  like the XLA gather formulation in inference/engine_v2.py.
+- Grid (seqs, kv_heads, max_pages), pages innermost. Online-softmax state
+  (m, l, acc) is carried in VMEM scratch across the page steps of one
+  (seq, head); output is written on the last page step.
+- Pages wholly past ``seq_len`` are predicated off with ``@pl.when`` (their
+  DMA still lands on whatever the padded table entry points at — callers
+  pad tables with the trash block so it stays cache-friendly).
+- GQA: queries arrive as [S, KV, G, D] (G = H // KV query heads per kv
+  head); each grid step computes all G query heads of one kv head against
+  the page, so K/V are never repeated per query head.
+
+Decode semantics: one new token per sequence whose K/V has already been
+scattered into the pool; ``seq_lens`` counts valid context tokens
+*including* that token, so position ``p`` attends iff ``p < seq_len``
+(causality is implied — the query is the last token).
+"""
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+
+try:  # pragma: no cover
+    from jax.experimental.pallas import tpu as pltpu
+except ImportError:  # pragma: no cover
+    pltpu = None
+
+NEG_INF = float(jnp.finfo(jnp.float32).min)
+
+
+def paged_attention_usable(num_heads: int, kv_heads: int, head_dim: int,
+                           block_size: int) -> bool:
+    """Gate: MXU-friendly head_dim, sublane-aligned pages, even GQA groups."""
+    if pltpu is None:
+        return False
+    if num_heads % kv_heads:
+        return False
+    if block_size % 8:
+        return False
+    return head_dim in (64, 128, 256)
+
+
+def _decode_kernel(tables_ref, lens_ref, q_ref, k_ref, v_ref, o_ref,
+                   m_scr, l_scr, acc_scr, *, block_size: int, scale: float):
+    s = pl.program_id(0)
+    j = pl.program_id(2)
+    nj = pl.num_programs(2)
+
+    @pl.when(j == 0)
+    def _init():
+        m_scr[:] = jnp.full_like(m_scr, NEG_INF)
+        l_scr[:] = jnp.zeros_like(l_scr)
+        acc_scr[:] = jnp.zeros_like(acc_scr)
+
+    seq_len = lens_ref[s]
+    page_start = j * block_size
+
+    @pl.when(page_start < seq_len)
+    def _body():
+        q = q_ref[0, 0]                                     # [G, D]
+        k = k_ref[0, 0]                                     # [bs, D]
+        v = v_ref[0, 0]
+        scores = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32) * scale      # [G, bs]
+        pos = page_start + jax.lax.broadcasted_iota(
+            jnp.int32, scores.shape, 1)
+        scores = jnp.where(pos < seq_len, scores, NEG_INF)
+
+        m_prev = m_scr[:]                                    # [G, 1]
+        m_new = jnp.maximum(m_prev, jnp.max(scores, axis=-1, keepdims=True))
+        alpha = jnp.exp(m_prev - m_new)
+        p = jnp.exp(scores - m_new)                          # [G, bs]
+        l_scr[:] = alpha * l_scr[:] + jnp.sum(p, axis=-1, keepdims=True)
+        acc_scr[:] = acc_scr[:] * alpha + jax.lax.dot_general(
+            p.astype(v.dtype), v, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        m_scr[:] = m_new
+
+    @pl.when(j == nj - 1)
+    def _finalize():
+        l = l_scr[:]
+        l_safe = jnp.where(l == 0.0, 1.0, l)                 # empty slot → 0s
+        o_ref[0, 0] = (acc_scr[:] / l_safe).astype(o_ref.dtype)
+
+
+def paged_decode_attention(q, k_pool, v_pool, block_tables, seq_lens, *,
+                           block_size: int, scale: float | None = None,
+                           interpret: bool | None = None):
+    """One-token-per-sequence attention against a paged KV pool.
+
+    q:            [S, H, D] — the new token's query per sequence slot
+    k_pool/v_pool:[KV, P, D] with P = num_blocks * block_size
+    block_tables: [S, max_pages] int32 (pad entries with the trash block)
+    seq_lens:     [S] int32 — valid context incl. the new token (0 = empty)
+    Returns [S, H, D].
+    """
+    S, H, D = q.shape
+    KV, P, _ = k_pool.shape
+    if P % block_size:
+        raise ValueError(f"pool tokens {P} not divisible by block_size "
+                         f"{block_size}")
+    if H % KV:
+        raise ValueError(f"GQA needs H ({H}) divisible by KV ({KV})")
+    G = H // KV
+    max_pages = block_tables.shape[1]
+    if scale is None:
+        scale = 1.0 / (D ** 0.5)
+    if interpret is None:
+        interpret = jax.default_backend() != "tpu"
+
+    qg = q.reshape(S, KV, G, D)
+    kp = k_pool.reshape(KV, P // block_size, block_size, D)
+    vp = v_pool.reshape(KV, P // block_size, block_size, D)
+    tables = block_tables.astype(jnp.int32)
+    lens = seq_lens.astype(jnp.int32)
+
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=2,
+        grid=(S, KV, max_pages),
+        in_specs=[
+            pl.BlockSpec((1, 1, G, D),
+                         lambda s, h, j, tables, lens: (s, h, 0, 0)),
+            pl.BlockSpec((1, 1, block_size, D),
+                         lambda s, h, j, tables, lens: (h, tables[s, j], 0, 0)),
+            pl.BlockSpec((1, 1, block_size, D),
+                         lambda s, h, j, tables, lens: (h, tables[s, j], 0, 0)),
+        ],
+        out_specs=pl.BlockSpec((1, 1, G, D),
+                               lambda s, h, j, tables, lens: (s, h, 0, 0)),
+        scratch_shapes=[
+            pltpu.VMEM((G, 1), jnp.float32),
+            pltpu.VMEM((G, 1), jnp.float32),
+            pltpu.VMEM((G, D), jnp.float32),
+        ],
+    )
+    out = pl.pallas_call(
+        functools.partial(_decode_kernel, block_size=block_size,
+                          scale=float(scale)),
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((S, KV, G, D), q.dtype),
+        interpret=interpret,
+    )(tables, lens, qg, kp, vp)
+    return out.reshape(S, H, D)
