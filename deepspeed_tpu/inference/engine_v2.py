@@ -158,21 +158,16 @@ class InferenceEngineV2:
 
         def ffn(p, h, use_moe: bool):
             if use_moe:
+                from ..models.transformer import moe_layer_kwargs
                 from ..moe.layer import MoE
 
-                mo = m.moe
                 # drop_tokens=False: generation must not drop routed tokens
                 # (the FastGen v2 MoE contract — reference inference/v2
                 # mixtral routes every token); token counts per step are
                 # tiny so the no-drop capacity is cheap. NB this diverges
                 # from the v1/training forward exactly when eval capacity
                 # would bind — there v1 drops overflow tokens, v2 doesn't.
-                mod = MoE(hidden_size=m.hidden_size,
-                          num_experts=mo.num_experts, ffn_size=m.ffn_size,
-                          k=mo.top_k, min_capacity=mo.min_capacity,
-                          drop_tokens=False,
-                          activation="silu_glu" if m.activation == "silu_glu"
-                          else "gelu")
+                mod = MoE(**moe_layer_kwargs(m, drop_tokens=False))
                 return mod.apply({"params": p["moe"]["moe_layer"]}, h, True)
             return DenseFFN(m).apply({"params": p["ffn"]}, h)
 

@@ -108,6 +108,30 @@ PRESETS: dict[str, ModelConfig] = {
                             position_embedding="rope", norm="rmsnorm",
                             activation="silu_glu", qkv_bias=True,
                             tie_embeddings=False),
+    # --- bert family: bidirectional post-norm encoders (reference
+    # module_inject/containers/{bert,distil_bert}.py policies and the
+    # csrc/transformer training kernels, whose target workload is BERT) ----
+    "bert-base-uncased": ModelConfig(vocab_size=30522, hidden_size=768,
+                                     num_layers=12, num_heads=12,
+                                     max_seq_len=512,
+                                     position_embedding="learned",
+                                     activation="gelu", causal=False,
+                                     pre_norm=False, dropout=0.1,
+                                     type_vocab_size=2, norm_eps=1e-12),
+    "bert-large-uncased": ModelConfig(vocab_size=30522, hidden_size=1024,
+                                      num_layers=24, num_heads=16,
+                                      max_seq_len=512,
+                                      position_embedding="learned",
+                                      activation="gelu", causal=False,
+                                      pre_norm=False, dropout=0.1,
+                                      type_vocab_size=2, norm_eps=1e-12),
+    "distilbert-base": ModelConfig(vocab_size=30522, hidden_size=768,
+                                   num_layers=6, num_heads=12,
+                                   max_seq_len=512,
+                                   position_embedding="learned",
+                                   activation="gelu", causal=False,
+                                   pre_norm=False, dropout=0.1,
+                                   norm_eps=1e-12),
     # --- tiny variants for tests/debug (reference tests/unit/simple_model.py) --
     "tiny-gpt2": ModelConfig(vocab_size=256, hidden_size=64, num_layers=2,
                              num_heads=4, max_seq_len=128,
@@ -142,6 +166,11 @@ PRESETS: dict[str, ModelConfig] = {
                              position_embedding="rope", norm="rmsnorm",
                              activation="silu_glu", qkv_bias=True,
                              tie_embeddings=False),
+    "tiny-bert": ModelConfig(vocab_size=256, hidden_size=64, num_layers=2,
+                             num_heads=4, max_seq_len=128,
+                             position_embedding="learned", activation="gelu",
+                             causal=False, pre_norm=False,
+                             type_vocab_size=2),
 }
 
 

@@ -31,6 +31,18 @@ def cross_entropy_lm(logits: jax.Array, labels: jax.Array,
     return loss
 
 
+def _train_mode_kwargs(batch: dict) -> dict:
+    """The engine injects '_train_rng' (one key per optimizer step) into
+    training batches — its presence switches the model to train mode:
+    deterministic=False with dropout/gating streams derived from the key."""
+    rng = batch.get("_train_rng")
+    if rng is None:
+        return {}
+    return {"deterministic": False,
+            "rngs": {"dropout": jax.random.fold_in(rng, 0),
+                     "gating": jax.random.fold_in(rng, 1)}}
+
+
 def lm_loss_fn(model, params, batch, deterministic: bool = True):
     """Default engine loss: causal LM on {'input_ids', 'labels'} batches.
     Adds any aux losses the model sowed (MoE balance/z losses)."""
@@ -39,10 +51,33 @@ def lm_loss_fn(model, params, batch, deterministic: bool = True):
     if labels is None:
         labels = jnp.concatenate(
             [input_ids[:, 1:], jnp.full_like(input_ids[:, :1], IGNORE_INDEX)], axis=1)
+    kwargs = {"deterministic": deterministic} | _train_mode_kwargs(batch)
     out, variables = model.apply({"params": params}, input_ids,
-                                 deterministic=deterministic, mutable=["losses"])
+                                 mutable=["losses"], **kwargs)
     logits = out
     loss = cross_entropy_lm(logits, labels)
+    for leaf in jax.tree.leaves(variables.get("losses", {})):
+        loss = loss + jnp.sum(leaf)
+    return loss
+
+
+def mlm_loss_fn(model, params, batch, deterministic: bool = True):
+    """Masked-LM loss for bidirectional encoders (bert family — role of the
+    reference's BingBertSquad/BERT pretraining path, tests/model/).
+
+    Batch: {'input_ids' [B,S] with [MASK] already substituted,
+    'labels' [B,S] = original ids at masked positions, IGNORE_INDEX
+    elsewhere, optional 'attention_mask' [B,S] (1 = real token),
+    optional 'token_type_ids' [B,S]}.
+    """
+    labels = batch["labels"]  # MLM labels are never derivable by shifting
+    kwargs = {"deterministic": deterministic} | _train_mode_kwargs(batch)
+    out, variables = model.apply(
+        {"params": params}, batch["input_ids"],
+        attn_mask=batch.get("attention_mask"),
+        token_type_ids=batch.get("token_type_ids"),
+        mutable=["losses"], **kwargs)
+    loss = cross_entropy_lm(out, labels)
     for leaf in jax.tree.leaves(variables.get("losses", {})):
         loss = loss + jnp.sum(leaf)
     return loss

@@ -99,6 +99,12 @@ class ModelConfig:
     parallel_block: bool = False             # falcon/gpt-j/phi: attn ∥ ffn
     parallel_block_norms: int = 1            # 2 = separate ln for ffn branch
                                              # (gpt-neox, falcon-40b)
+    causal: bool = True                      # False → bidirectional encoder
+                                             # (bert family)
+    pre_norm: bool = True                    # False → post-norm residuals
+                                             # (original BERT layout)
+    dropout: float = 0.0                     # bert-style residual dropout
+    type_vocab_size: int = 0                 # >0 → bert segment embeddings
     tie_embeddings: bool = True
     moe: MoEConfig | None = None
     dtype: Any = jnp.bfloat16                # compute dtype
@@ -136,9 +142,14 @@ class ModelConfig:
             ffn = self.moe.num_experts * 3 * h * f + h * self.moe.num_experts
         else:
             ffn = ffn_dense
+        if self.qkv_bias:
+            attn += self.num_heads * self.head_dim \
+                + 2 * self.kv_heads * self.head_dim
         per_norm = h if self.norm == "rmsnorm" else 2 * h
+        # pre-norm: 2 per layer + ln_final; post-norm: 2 per layer + ln_embed
         norms = (2 * L + 1) * per_norm
         emb = v * h + (0 if self.tie_embeddings else v * h)
+        emb += self.type_vocab_size * h
         pos = self.max_seq_len * h if self.position_embedding == "learned" else 0
         return emb + pos + L * (attn + ffn) + norms
 
@@ -284,7 +295,7 @@ class Attention(nn.Module):
 
         out = dot_product_attention(
             q, k, v,
-            causal=True,
+            causal=cfg.causal,
             positions=positions if kv_cache is not None else None,
             kv_len=(kv_cache[2] + S) if kv_cache is not None else None,
             mask=attn_mask,
@@ -333,6 +344,30 @@ class DenseFFN(nn.Module):
         return constrain(out, BATCH, SEQ, EMBED)
 
 
+def moe_layer_kwargs(cfg: ModelConfig, **overrides) -> dict:
+    """The single ModelConfig.moe → MoE-layer kwargs mapping, shared by the
+    training adapter below and the ragged inference forward
+    (inference/engine_v2.py) so new MoEConfig fields can't silently drift
+    between the two."""
+    moe = cfg.moe
+    kw = dict(
+        hidden_size=cfg.hidden_size,
+        num_experts=moe.num_experts,
+        ffn_size=cfg.ffn_size,
+        k=moe.top_k,
+        capacity_factor=moe.capacity_factor,
+        eval_capacity_factor=moe.eval_capacity_factor,
+        min_capacity=moe.min_capacity,
+        activation="silu_glu" if cfg.activation == "silu_glu" else "gelu",
+        aux_loss_weight=moe.aux_loss_weight,
+        z_loss_weight=moe.router_z_loss_weight,
+        dropless=moe.dropless,
+        dropless_block_m=moe.dropless_block_m,
+    )
+    kw.update(overrides)
+    return kw
+
+
 class MoEFFN(nn.Module):
     """Routed expert FFN — thin adapter over the first-class MoE layer
     (deepspeed_tpu/moe/layer.py; reference deepspeed/moe/layer.py:17)."""
@@ -342,22 +377,8 @@ class MoEFFN(nn.Module):
     def __call__(self, x, deterministic: bool = True):
         from ..moe.layer import MoE
 
-        cfg = self.config
-        moe = cfg.moe
-        return MoE(
-            hidden_size=cfg.hidden_size,
-            num_experts=moe.num_experts,
-            ffn_size=cfg.ffn_size,
-            k=moe.top_k,
-            capacity_factor=moe.capacity_factor,
-            eval_capacity_factor=moe.eval_capacity_factor,
-            min_capacity=moe.min_capacity,
-            activation="silu_glu" if cfg.activation == "silu_glu" else "gelu",
-            aux_loss_weight=moe.aux_loss_weight,
-            z_loss_weight=moe.router_z_loss_weight,
-            dropless=moe.dropless,
-            dropless_block_m=moe.dropless_block_m,
-            name="moe_layer")(x, deterministic)
+        return MoE(**moe_layer_kwargs(self.config),
+                   name="moe_layer")(x, deterministic)
 
 
 class Block(nn.Module):
@@ -389,19 +410,42 @@ class Block(nn.Module):
             if kv_cache is not None:
                 return x, new_cache
             return x
+        drop = (lambda t: nn.Dropout(cfg.dropout, deterministic=deterministic)(t)) \
+            if cfg.dropout > 0 else (lambda t: t)
+
+        if not cfg.pre_norm:
+            # post-norm residuals (original BERT layout; the reference's
+            # DeepSpeedTransformerConfig pre_layer_norm=False mode)
+            attn_out = Attention(cfg, name="attn")(x, positions,
+                                                   kv_cache=kv_cache,
+                                                   attn_mask=attn_mask)
+            if kv_cache is not None:
+                attn_out, new_cache = attn_out
+            else:
+                new_cache = None
+            x = Norm(cfg, name="ln_attn")(x + drop(attn_out))
+            if self.use_moe:
+                ffn_out = MoEFFN(cfg, name="moe")(x, deterministic=deterministic)
+            else:
+                ffn_out = DenseFFN(cfg, name="ffn")(x)
+            x = Norm(cfg, name="ln_ffn")(x + drop(ffn_out))
+            if kv_cache is not None:
+                return x, new_cache
+            return x
+
         attn_out = Attention(cfg, name="attn")(Norm(cfg, name="ln_attn")(x), positions,
                                                kv_cache=kv_cache, attn_mask=attn_mask)
         if kv_cache is not None:
             attn_out, new_cache = attn_out
         else:
             new_cache = None
-        x = x + attn_out
+        x = x + drop(attn_out)
         h = Norm(cfg, name="ln_ffn")(x)
         if self.use_moe:
             ffn_out = MoEFFN(cfg, name="moe")(h, deterministic=deterministic)
         else:
             ffn_out = DenseFFN(cfg, name="ffn")(h)
-        x = x + ffn_out
+        x = x + drop(ffn_out)
         if kv_cache is not None:
             return x, new_cache
         return x
@@ -413,9 +457,11 @@ class TransformerLM(nn.Module):
 
     @nn.compact
     def __call__(self, input_ids, positions=None, kv_caches=None, attn_mask=None,
-                 deterministic: bool = True):
+                 deterministic: bool = True, token_type_ids=None):
         cfg = self.config
         B, S = input_ids.shape
+        if not cfg.causal and kv_caches is not None:
+            raise ValueError("bidirectional encoders have no decode path")
         if positions is None:
             positions = jnp.broadcast_to(jnp.arange(S, dtype=jnp.int32), (B, S))
 
@@ -428,6 +474,18 @@ class TransformerLM(nn.Module):
                 nn.initializers.normal(0.02), (None, "embed")),
                 (cfg.max_seq_len, cfg.hidden_size), jnp.float32)
             x = x + pos_emb.astype(cfg.dtype)[positions]
+        if cfg.type_vocab_size:
+            type_emb = self.param("type_embed", nn.with_partitioning(
+                nn.initializers.normal(0.02), (None, "embed")),
+                (cfg.type_vocab_size, cfg.hidden_size), jnp.float32)
+            if token_type_ids is None:
+                token_type_ids = jnp.zeros_like(input_ids)
+            x = x + type_emb.astype(cfg.dtype)[token_type_ids]
+        if not cfg.pre_norm:
+            # bert: layernorm + dropout on the embedding sum
+            x = Norm(cfg, name="ln_embed")(x)
+        if cfg.dropout > 0:
+            x = nn.Dropout(cfg.dropout, deterministic=deterministic)(x)
         x = constrain(x, BATCH, SEQ, EMBED)
 
         block_cls = Block
@@ -450,7 +508,8 @@ class TransformerLM(nn.Module):
             else:
                 x = out
 
-        x = Norm(cfg, name="ln_final")(x)
+        if cfg.pre_norm:  # post-norm layers already end normalized
+            x = Norm(cfg, name="ln_final")(x)
         if cfg.tie_embeddings:
             logits = jnp.einsum("bse,ve->bsv", x, embed.astype(cfg.dtype))
         else:

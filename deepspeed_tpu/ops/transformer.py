@@ -1,0 +1,82 @@
+"""Fused transformer encoder layer — the ``DeepSpeedTransformerLayer``
+analogue.
+
+Reference: deepspeed/ops/transformer/transformer.py
+(``DeepSpeedTransformerConfig`` :34, ``DeepSpeedTransformerLayer`` :296),
+backed by the hand-fused CUDA encoder kernels in csrc/transformer/*.cu
+(softmax/gelu/normalize/dropout fusion, fwd+bwd). On TPU the same fusion
+comes from XLA (elementwise ops fold into the surrounding matmuls) plus the
+Pallas flash-attention kernel for the attention core, so this module is a
+thin, config-compatible wrapper over the shared Block implementation —
+there is nothing left to hand-schedule.
+
+The reference kernel's target workload is the BERT encoder, so the layer
+defaults to bidirectional attention and supports both residual layouts via
+``pre_layer_norm`` (post-norm = original BERT).
+"""
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+import flax.linen as nn
+import jax
+
+from ..models.transformer import Block, ModelConfig
+
+
+@dataclass
+class TransformerLayerConfig:
+    """Field-compatible subset of the reference DeepSpeedTransformerConfig
+    (transformer.py:34). Fields that steer the CUDA kernel scheduler
+    (normalize_invertible, gelu_checkpoint, stochastic_mode, ...) have no
+    TPU meaning — XLA owns the schedule — and are accepted via
+    ``from_dict`` but ignored."""
+    hidden_size: int = 768
+    intermediate_size: int | None = None     # None → 4*hidden
+    heads: int = 12
+    hidden_dropout_ratio: float = 0.1
+    attn_dropout_ratio: float = 0.1          # accepted, IGNORED: the block
+                                             # has no attention-prob dropout
+                                             # (only residual dropout)
+    layer_norm_eps: float = 1e-12
+    pre_layer_norm: bool = True
+    causal: bool = False                     # encoder default
+    activation: str = "gelu"
+
+    @classmethod
+    def from_dict(cls, d: dict) -> "TransformerLayerConfig":
+        import dataclasses
+
+        known = {f.name for f in dataclasses.fields(cls)}
+        return cls(**{k: v for k, v in d.items() if k in known})
+
+    def model_config(self) -> ModelConfig:
+        return ModelConfig(
+            vocab_size=1,  # layer-level module: no embeddings involved
+            hidden_size=self.hidden_size,
+            num_heads=self.heads,
+            intermediate_size=self.intermediate_size,
+            activation=self.activation,
+            norm_eps=self.layer_norm_eps,
+            causal=self.causal,
+            pre_norm=self.pre_layer_norm,
+            dropout=self.hidden_dropout_ratio,
+        )
+
+
+class TransformerLayer(nn.Module):
+    """One fused encoder layer: (hidden_states [B,S,E], attention_mask
+    [B,S]) → [B,S,E] (reference DeepSpeedTransformerLayer :296 forward)."""
+    config: TransformerLayerConfig
+
+    @nn.compact
+    def __call__(self, hidden_states: jax.Array, attention_mask=None,
+                 deterministic: bool = True) -> jax.Array:
+        cfg = self.config.model_config()
+        B, S, _ = hidden_states.shape
+        import jax.numpy as jnp
+
+        positions = jnp.broadcast_to(jnp.arange(S, dtype=jnp.int32), (B, S))
+        return Block(cfg, name="layer")(hidden_states, positions,
+                                        attn_mask=attention_mask,
+                                        deterministic=deterministic)

@@ -262,6 +262,9 @@ class DeepSpeedEngine:
         topo = self.topology
         if rng is None:
             rng = jax.random.PRNGKey(cfg.seed)
+        # independent stream for train-time stochastic layers (dropout /
+        # noisy gating) — never touches the init stream
+        self._train_rng_base = jax.random.fold_in(rng, 0x5eed)
 
         init_input = None
         if self.model is not None:
@@ -390,7 +393,16 @@ class DeepSpeedEngine:
         )
 
     # ------------------------------------------------------------------
-    def _loss_with_rules(self, params, batch):
+    def _loss_with_rules(self, params, batch, step=None):
+        """``step`` present → training call: a per-step PRNG key rides into
+        the batch under '_train_rng' so stochastic layers (bert dropout,
+        RSample noisy gating) can draw masks; loss fns that don't use it
+        ignore the key. One key per optimizer step — microbatches within a
+        GAS step share masks (they already share the step's params)."""
+        if step is not None:
+            batch = dict(batch)
+            batch["_train_rng"] = jax.random.fold_in(self._train_rng_base,
+                                                     step)
         with nn.logical_axis_rules(self._rules):
             return self._raw_loss_fn(params, batch)
 
@@ -404,7 +416,8 @@ class DeepSpeedEngine:
                 # QAT/pruning transform inside the grad so STE gradients
                 # reach the raw weights; step traced → schedule stays live
                 p = mgr.transform_params(p, state.opt_state.step)
-            loss = self._loss_with_rules(p, batch)
+            loss = self._loss_with_rules(p, batch,
+                                         step=state.opt_state.step)
             if state.scaler is not None:
                 loss = loss * state.scaler.scale
             return loss
@@ -606,13 +619,15 @@ class DeepSpeedEngine:
                       and not (isinstance(ax, (tuple, list))
                                and any(a in dp_axes for a in ax))]
 
-        def local_loss(p, mb):
+        def local_loss(p, mb, step):
+            mb = dict(mb)
+            mb["_train_rng"] = jax.random.fold_in(self._train_rng_base, step)
             with nn.logical_axis_rules(safe_rules):
                 return self._raw_loss_fn(p, mb)
 
         def local_compute(state, mb):
             loss, grads = jax.value_and_grad(
-                lambda p: local_loss(p, mb))(state.params)
+                lambda p: local_loss(p, mb, state.opt_state.step))(state.params)
             return loss, _cast_tree(grads, jnp.float32)
 
         gas_local = make_gas_grads(local_compute, constrain=False)
