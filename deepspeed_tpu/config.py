@@ -197,6 +197,13 @@ class MonitorBackendConfig:
     team: str | None = None
     group: str | None = None
     project: str | None = None
+    # comet extras (reference monitor/config.py CometConfig)
+    workspace: str | None = None
+    api_key: str | None = None
+    experiment_name: str | None = None
+    experiment_key: str | None = None
+    online: bool | None = None
+    mode: str | None = None
 
 
 @dataclass
@@ -314,6 +321,7 @@ class Config:
     tensorboard: MonitorBackendConfig = field(default_factory=MonitorBackendConfig)
     csv_monitor: MonitorBackendConfig = field(default_factory=MonitorBackendConfig)
     wandb: MonitorBackendConfig = field(default_factory=MonitorBackendConfig)
+    comet: MonitorBackendConfig = field(default_factory=MonitorBackendConfig)
     data_types: DataTypesConfig = field(default_factory=DataTypesConfig)
     checkpoint: CheckpointConfig = field(default_factory=CheckpointConfig)
     data_efficiency: DataEfficiencyConfig = field(
@@ -351,6 +359,7 @@ class Config:
             "tensorboard": MonitorBackendConfig,
             "csv_monitor": MonitorBackendConfig,
             "wandb": MonitorBackendConfig,
+            "comet": MonitorBackendConfig,
             "data_types": DataTypesConfig,
             "checkpoint": CheckpointConfig,
             "data_efficiency": DataEfficiencyConfig,

@@ -1,0 +1,112 @@
+"""Collective micro-benchmark CLI — the ``ds_bench`` analogue.
+
+Reference: bin/ds_bench → benchmarks/communication (all_reduce.py etc.),
+which sweeps message sizes per collective over NCCL and reports latency /
+algorithm bandwidth / bus bandwidth. Here the same sweep runs over the
+live device mesh with the framework's comm facade inside ``shard_map``:
+each timed op is a jitted program whose only payload is the collective, so
+the measurement is the interconnect (ICI on a slice, host loopback on the
+virtual CPU mesh).
+
+Usage:
+    python -m deepspeed_tpu.launcher.ds_bench [--ops all_reduce,...]
+        [--minsize 1024] [--maxsize 16777216] [--trials 20] [--warmups 3]
+
+busbw follows the reference's calc_bw_log factors (comms_logging.py:34):
+allreduce 2(n-1)/n, all_gather / reduce_scatter (n-1)/n, all_to_all
+(n-1)/n.
+"""
+from __future__ import annotations
+
+import argparse
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, PartitionSpec as P
+from jax import shard_map
+
+from .. import comm
+
+OPS = ("all_reduce", "all_gather", "reduce_scatter", "all_to_all",
+       "broadcast")
+
+
+def _busbw_factor(op: str, n: int) -> float:
+    if op == "all_reduce":
+        return 2.0 * (n - 1) / n
+    if op in ("all_gather", "reduce_scatter", "all_to_all"):
+        return (n - 1) / n
+    return 1.0
+
+
+def bench_op(op: str, mesh: Mesh, size_bytes: int, trials: int,
+             warmups: int) -> dict:
+    n = mesh.devices.size
+    # per-device shard (elems/n) must itself split n ways for rs/a2a
+    elems = max(size_bytes // 4, n * n)
+    elems = (elems // (n * n)) * (n * n)
+    x = jnp.arange(elems, dtype=jnp.float32)
+
+    def body(x):
+        if op == "all_reduce":
+            return comm.all_reduce(x, "x")
+        if op == "all_gather":
+            return comm.all_gather(x, "x")
+        if op == "reduce_scatter":
+            return comm.reduce_scatter(x, "x")
+        if op == "all_to_all":
+            return comm.all_to_all(x.reshape(n, -1), "x", 0, 0).reshape(-1)
+        if op == "broadcast":
+            return comm.broadcast(x, "x")
+        raise ValueError(op)
+
+    fn = jax.jit(shard_map(body, mesh=mesh, in_specs=P("x"),
+                           out_specs=P("x"), check_vma=False))
+    out = fn(x)
+    jax.block_until_ready(out)                     # compile + warm
+    for _ in range(warmups):
+        out = fn(x)
+    jax.block_until_ready(out)
+    t0 = time.perf_counter()
+    for _ in range(trials):
+        out = fn(x)
+    jax.block_until_ready(out)
+    dt = (time.perf_counter() - t0) / trials
+    # NCCL-test convention (the one calc_bw_log's factors assume): algbw =
+    # per-rank buffer / time; in_specs=P("x") gives each device elems/n
+    payload = (elems // n) * 4
+    algbw = payload / dt / 1e9
+    return {"op": op, "size": payload, "lat_us": dt * 1e6,
+            "algbw_GBps": algbw,
+            "busbw_GBps": algbw * _busbw_factor(op, n)}
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(description="deepspeed_tpu comms benchmark")
+    p.add_argument("--ops", default="all")
+    p.add_argument("--minsize", type=int, default=1 << 12)
+    p.add_argument("--maxsize", type=int, default=1 << 24)
+    p.add_argument("--trials", type=int, default=20)
+    p.add_argument("--warmups", type=int, default=3)
+    args = p.parse_args(argv)
+
+    devs = np.array(jax.devices())
+    mesh = Mesh(devs, ("x",))
+    ops = OPS if args.ops == "all" else tuple(args.ops.split(","))
+    print(f"# devices={devs.size} platform={devs.flat[0].platform}")
+    print(f"{'op':<16}{'size':>12}{'lat(us)':>12}{'algbw(GB/s)':>14}"
+          f"{'busbw(GB/s)':>14}")
+    for op in ops:
+        size = args.minsize
+        while size <= args.maxsize:
+            r = bench_op(op, mesh, size, args.trials, args.warmups)
+            print(f"{r['op']:<16}{r['size']:>12}{r['lat_us']:>12.1f}"
+                  f"{r['algbw_GBps']:>14.3f}{r['busbw_GBps']:>14.3f}")
+            size *= 4
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -1,0 +1,82 @@
+"""Small operator CLIs: ``ds_ssh`` and ``ds_elastic`` analogues.
+
+Reference: bin/ds_ssh (run one command on every hostfile node over
+pdsh/ssh) and bin/ds_elastic (inspect an elastic config: which total batch
+sizes / chip counts are mutually compatible). Both are thin front-ends over
+machinery that already exists here — the hostfile parser + runners in
+launcher/, and the elasticity solver in elasticity/.
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import shutil
+import subprocess
+import sys
+
+from .runner import parse_hostfile
+
+
+def ds_ssh_main(argv=None) -> int:
+    """Run a shell command on every node of a hostfile (reference
+    bin/ds_ssh). Uses pdsh when present, else sequential ssh."""
+    p = argparse.ArgumentParser(description="run a command on all hostfile nodes")
+    p.add_argument("-H", "--hostfile", default="/job/hostfile")
+    p.add_argument("command", nargs=argparse.REMAINDER)
+    args = p.parse_args(argv)
+    if not args.command:
+        p.error("no command given")
+    cmd = " ".join(args.command)
+    hosts = list(parse_hostfile(args.hostfile))
+    if not hosts:
+        print(f"hostfile '{args.hostfile}' missing/empty; running locally",
+              file=sys.stderr)
+        return subprocess.call(cmd, shell=True)
+    if shutil.which("pdsh"):
+        return subprocess.call(["pdsh", "-w", ",".join(hosts), cmd])
+    rc = 0
+    for h in hosts:
+        print(f"--- {h} ---")
+        rc |= subprocess.call(["ssh", "-o", "StrictHostKeyChecking=no", h, cmd])
+    return rc
+
+
+def ds_elastic_main(argv=None) -> int:
+    """Inspect an elastic training config (reference bin/ds_elastic):
+    print the compatible (total batch, micro-batch, chip-count) space."""
+    from ..elasticity.elasticity import compute_elastic_config
+
+    p = argparse.ArgumentParser(description="elastic config inspector")
+    p.add_argument("-c", "--config", required=True, help="DeepSpeed-style JSON")
+    p.add_argument("-w", "--world-size", type=int, default=0,
+                   help="also resolve micro-batch/GAS for this chip count")
+    args = p.parse_args(argv)
+    with open(args.config) as f:
+        ds_config = json.load(f)
+
+    if args.world_size:
+        out = compute_elastic_config(ds_config, num_gpus=args.world_size)
+        if len(out) == 3:
+            batch, valid, micro = out
+            print(f"world_size={args.world_size}: train_batch={batch} "
+                  f"micro_batch={micro} "
+                  f"gas={batch // (micro * args.world_size)}")
+        else:
+            batch, valid = out
+            print(f"world_size={args.world_size}: train_batch={batch}")
+        print(f"compatible chip counts: {valid}")
+    else:
+        batch, valid = compute_elastic_config(ds_config)
+        print(f"max compatible train_batch={batch}")
+        print(f"compatible chip counts: {valid}")
+    return 0
+
+
+if __name__ == "__main__":  # python -m deepspeed_tpu.launcher.tools ds_ssh ...
+    prog, *rest = sys.argv[1:] or ["help"]
+    if prog == "ds_ssh":
+        raise SystemExit(ds_ssh_main(rest))
+    if prog == "ds_elastic":
+        raise SystemExit(ds_elastic_main(rest))
+    print("usage: python -m deepspeed_tpu.launcher.tools {ds_ssh|ds_elastic} ...")
+    raise SystemExit(2)

@@ -62,6 +62,45 @@ class WandbMonitor(Monitor):
             self._wandb.log({tag: float(value)}, step=int(step))
 
 
+class CometMonitor(Monitor):
+    """Comet ML backend (reference deepspeed/monitor/comet.py). Lazily
+    imports comet_ml and disables itself when absent — this image has no
+    network, so in practice it only activates in user deployments."""
+
+    def __init__(self, config):
+        super().__init__(config)
+        if not self.enabled:
+            return
+        try:
+            import comet_ml
+        except Exception:
+            logger.warning("comet_ml not available; comet monitor disabled")
+            self.enabled = False
+            return
+        kw = {}
+        for field_, key in (("project", "project_name"),
+                            ("workspace", "workspace"),
+                            ("api_key", "api_key"),
+                            ("experiment_name", "experiment_name"),
+                            ("experiment_key", "experiment_key"),
+                            ("online", "online"),
+                            ("mode", "mode")):
+            v = getattr(config, field_, None)
+            if v is not None:
+                kw[key] = v
+        self.experiment = comet_ml.start(**kw)
+
+    def write_events(self, event_list: Sequence[tuple]) -> None:
+        if not self.enabled:
+            return
+        for tag, value, step in event_list:
+            self.experiment.log_metric(tag, float(value), step=int(step))
+
+    def flush(self) -> None:
+        if self.enabled and hasattr(self.experiment, "flush"):
+            self.experiment.flush()
+
+
 class CSVMonitor(Monitor):
     """One csv per tag under output_path/job_name (reference
     csv_monitor.py)."""

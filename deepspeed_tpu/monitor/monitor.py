@@ -32,12 +32,14 @@ class MonitorMaster(Monitor):
     monitor.py:30)."""
 
     def __init__(self, config):
-        from .backends import CSVMonitor, TensorBoardMonitor, WandbMonitor
+        from .backends import (CometMonitor, CSVMonitor, TensorBoardMonitor,
+                               WandbMonitor)
 
         self.backends: list[Monitor] = []
         for attr, cls in (("tensorboard", TensorBoardMonitor),
                           ("wandb", WandbMonitor),
-                          ("csv_monitor", CSVMonitor)):
+                          ("csv_monitor", CSVMonitor),
+                          ("comet", CometMonitor)):
             sub = getattr(config, attr, None)
             if sub is not None and getattr(sub, "enabled", False):
                 backend = cls(sub)

@@ -88,3 +88,20 @@ def test_fp16_dynamic_scale_defaults():
     cfg = Config.from_dict({"fp16": {"enabled": True}})
     assert cfg.fp16.initial_scale_power == 16
     assert cfg.fp16.loss_scale == 0.0
+
+
+def test_comet_monitor_config_section():
+    """comet section parses like the other monitor backends (reference
+    monitor/config.py CometConfig) and the master skips it when comet_ml
+    is absent instead of crashing."""
+    from deepspeed_tpu.config import Config
+    from deepspeed_tpu.monitor.monitor import MonitorMaster
+
+    cfg = Config.from_dict({
+        "train_micro_batch_size_per_gpu": 1,
+        "comet": {"enabled": True, "project": "p", "workspace": "w",
+                  "experiment_name": "e"},
+    })
+    assert cfg.comet.enabled and cfg.comet.workspace == "w"
+    master = MonitorMaster(cfg)   # comet_ml not installed → disabled
+    assert all(type(b).__name__ != "CometMonitor" for b in master.backends)

@@ -202,3 +202,47 @@ def test_runner_elastic_nodes(tmp_path):
     total = sum(active.values())
     batch, valid = compute_elastic_config(json.loads(cfg_path.read_text()))[:2]
     assert total in valid
+
+
+# ---------------------------------------------------------------------------
+# operator CLIs: ds_ssh / ds_elastic / ds_bench (reference bin/)
+# ---------------------------------------------------------------------------
+
+def test_ds_ssh_local_fallback(tmp_path, capsys):
+    from deepspeed_tpu.launcher.tools import ds_ssh_main
+
+    rc = ds_ssh_main(["-H", str(tmp_path / "nope"), "echo", "ds-ssh-ok"])
+    assert rc == 0
+
+
+def test_ds_elastic_cli(tmp_path, capsys):
+    import json
+
+    from deepspeed_tpu.launcher.tools import ds_elastic_main
+
+    cfg = {"elasticity": {"enabled": True, "max_train_batch_size": 2000,
+                          "micro_batch_sizes": [2, 4, 6], "min_gpus": 1,
+                          "max_gpus": 128, "version": 0.2,
+                          "ignore_non_elastic_batch_info": True,
+                          "num_gpus_per_node": 4, "model_parallel_size": 1}}
+    p = tmp_path / "ds.json"
+    p.write_text(json.dumps(cfg))
+    assert ds_elastic_main(["-c", str(p)]) == 0
+    out = capsys.readouterr().out
+    assert "train_batch=1920" in out
+    assert ds_elastic_main(["-c", str(p), "-w", "16"]) == 0
+    out = capsys.readouterr().out
+    assert "micro_batch=6" in out and "gas=20" in out
+
+
+def test_ds_bench_one_op():
+    from jax.sharding import Mesh
+    import jax
+    import numpy as np
+
+    from deepspeed_tpu.launcher.ds_bench import bench_op
+
+    mesh = Mesh(np.array(jax.devices()), ("x",))
+    r = bench_op("all_reduce", mesh, 1 << 12, trials=2, warmups=1)
+    assert r["lat_us"] > 0 and r["algbw_GBps"] > 0
+    assert r["busbw_GBps"] == r["algbw_GBps"] * 2 * 7 / 8  # n=8 factor
