@@ -74,9 +74,13 @@ def bench_op(op: str, mesh: Mesh, size_bytes: int, trials: int,
         out = fn(x)
     jax.block_until_ready(out)
     dt = (time.perf_counter() - t0) / trials
-    # NCCL-test convention (the one calc_bw_log's factors assume): algbw =
-    # per-rank buffer / time; in_specs=P("x") gives each device elems/n
-    payload = (elems // n) * 4
+    # nccl-tests size conventions (what calc_bw_log's factors assume), with
+    # the per-device shard s = elems/n as each rank's send buffer:
+    #   all_reduce / broadcast : S = per-rank buffer           = s
+    #   reduce_scatter         : S = per-rank input (n*recv)   = s
+    #   all_to_all             : S = per-rank send buffer      = s
+    #   all_gather             : S = total gathered output     = n*s
+    payload = (elems if op == "all_gather" else elems // n) * 4
     algbw = payload / dt / 1e9
     return {"op": op, "size": payload, "lat_us": dt * 1e6,
             "algbw_GBps": algbw,

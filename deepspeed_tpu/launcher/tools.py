@@ -10,6 +10,7 @@ from __future__ import annotations
 
 import argparse
 import json
+import shlex
 import shutil
 import subprocess
 import sys
@@ -26,7 +27,8 @@ def ds_ssh_main(argv=None) -> int:
     args = p.parse_args(argv)
     if not args.command:
         p.error("no command given")
-    cmd = " ".join(args.command)
+    # preserve argument boundaries through the local/pdsh/remote shell
+    cmd = " ".join(shlex.quote(a) for a in args.command)
     hosts = list(parse_hostfile(args.hostfile))
     if not hosts:
         print(f"hostfile '{args.hostfile}' missing/empty; running locally",

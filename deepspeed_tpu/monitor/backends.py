@@ -78,17 +78,19 @@ class CometMonitor(Monitor):
             self.enabled = False
             return
         kw = {}
-        for field_, key in (("project", "project_name"),
-                            ("workspace", "workspace"),
-                            ("api_key", "api_key"),
-                            ("experiment_name", "experiment_name"),
-                            ("experiment_key", "experiment_key"),
-                            ("online", "online"),
-                            ("mode", "mode")):
-            v = getattr(config, field_, None)
+        for key in ("project", "workspace", "api_key", "experiment_key",
+                    "online", "mode"):
+            v = getattr(config, key, None)
             if v is not None:
                 kw[key] = v
-        self.experiment = comet_ml.start(**kw)
+        try:
+            self.experiment = comet_ml.start(**kw)
+            name = getattr(config, "experiment_name", None)
+            if name:
+                self.experiment.set_name(name)
+        except Exception as e:  # bad creds/kwargs must not kill training
+            logger.warning(f"comet experiment init failed ({e}); disabled")
+            self.enabled = False
 
     def write_events(self, event_list: Sequence[tuple]) -> None:
         if not self.enabled:

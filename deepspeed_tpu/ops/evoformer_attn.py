@@ -1,0 +1,56 @@
+"""DS4Science Evoformer (triangle/MSA) attention.
+
+TPU-native equivalent of the reference's CUTLASS-fused kernel
+(/root/reference/csrc/deepspeed4science/evoformer_attn/, python wrapper
+deepspeed/ops/deepspeed4science/evoformer_attn.py ``DS4Sci_EvoformerAttention``
+:87). The reference hand-fuses QK^T + two broadcast biases + softmax + PV
+for AlphaFold-style workloads; on TPU that exact fusion is what XLA
+produces from the einsum formulation (bias adds fold into the softmax
+fusion), so the op is expressed directly and differentiates through —
+no custom VJP needed (the reference's bwd kernel exists because CUDA
+autograd can't see inside the fused op).
+
+Shapes follow the reference contract:
+    Q, K, V : [*, L, H, D]   (typically [B, N_rows, L, H, D] for MSA /
+                              triangle attention; L > 16 in the reference)
+    bias1   : [B, N, 1, 1, L]   row mask bias (broadcast over heads+query)
+    bias2   : [B, 1, H, L, L]   pair bias (broadcast over rows)
+
+For very long L the whole [*, H, L, L] logits tensor is materialized per
+fusion tile by XLA, not in HBM — but activations during grad still scale
+as L^2; pair with remat for AlphaFold-size inputs.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+def ds4sci_evoformer_attention(q: jax.Array, k: jax.Array, v: jax.Array,
+                               biases: list[jax.Array | None] | None = None
+                               ) -> jax.Array:
+    """Evoformer attention with up to two additive biases (reference
+    ``DS4Sci_EvoformerAttention``). Returns an array shaped like ``q``."""
+    biases = list(biases or [])
+    if len(biases) > 2:
+        raise ValueError("at most two biases (mask bias, pair bias)")
+    while len(biases) < 2:
+        biases.append(None)
+    b1, b2 = biases
+
+    *lead, L, H, D = q.shape
+    scale = 1.0 / (D ** 0.5)
+    # [..., L, H, D] → logits [..., H, Lq, Lk] in fp32
+    logits = jnp.einsum("...qhd,...khd->...hqk", q, k).astype(jnp.float32)
+    logits = logits * scale
+    if b1 is not None:
+        logits = logits + b1.astype(jnp.float32)   # [B,N,1,1,L] broadcast
+    if b2 is not None:
+        logits = logits + b2.astype(jnp.float32)   # [B,1,H,L,L] broadcast
+    weights = jax.nn.softmax(logits, axis=-1)
+    out = jnp.einsum("...hqk,...khd->...qhd", weights.astype(v.dtype), v)
+    return out.astype(q.dtype)
+
+
+# reference-compatible alias
+DS4Sci_EvoformerAttention = ds4sci_evoformer_attention

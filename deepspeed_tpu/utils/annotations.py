@@ -1,0 +1,47 @@
+"""Profiler range annotations — the NVTX analogue.
+
+Reference: deepspeed/utils/nvtx.py ``instrument_w_nvtx`` (wraps functions in
+``get_accelerator().range_push/pop`` so kernels group under named ranges in
+nsight). The TPU equivalent is a ``jax.profiler.TraceAnnotation`` (host
+span) + ``jax.named_scope`` (names carried into the compiled HLO, visible
+in XProf/xplane traces).
+"""
+from __future__ import annotations
+
+import functools
+
+import jax
+
+
+def instrument_w_nvtx(fn=None, *, name: str | None = None):
+    """Decorator: run ``fn`` under a named profiler range. Usable bare
+    (``@instrument_w_nvtx``) or with a custom name."""
+    def wrap(f):
+        label = name or getattr(f, "__qualname__", getattr(f, "__name__", "fn"))
+
+        @functools.wraps(f)
+        def inner(*args, **kwargs):
+            with jax.profiler.TraceAnnotation(label), jax.named_scope(label):
+                return f(*args, **kwargs)
+
+        return inner
+
+    return wrap(fn) if fn is not None else wrap
+
+
+class range_push:
+    """Context-manager form (reference range_push/range_pop pairs)."""
+
+    def __init__(self, name: str):
+        self._ann = jax.profiler.TraceAnnotation(name)
+        self._scope = jax.named_scope(name)
+
+    def __enter__(self):
+        self._ann.__enter__()
+        self._scope.__enter__()
+        return self
+
+    def __exit__(self, *exc):
+        self._scope.__exit__(*exc)
+        self._ann.__exit__(*exc)
+        return False

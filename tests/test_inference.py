@@ -84,3 +84,50 @@ def test_gpu_only_config_keys_ignored():
     engine = ds.init_inference(model, config={
         "replace_with_kernel_inject": True, "enable_cuda_graph": True})
     assert engine.config.tensor_parallel == 1
+
+
+# ---------------------------------------------------------------------------
+# ZeRO-Inference: quantized-weight serving (reference README "20x" claim)
+# ---------------------------------------------------------------------------
+
+def test_zero_inference_int8_weights():
+    """int8 weight serving: memory shrinks ~2x and greedy generations track
+    the bf16 engine closely; the reference 'quant' config form parses."""
+    import jax
+    import numpy as np
+
+    from deepspeed_tpu.inference import InferenceEngine
+    from deepspeed_tpu.models import build_model
+    from deepspeed_tpu.ops.quantizer import QuantizedTensor
+    from deepspeed_tpu.parallel.topology import MeshTopology
+
+    model = build_model("tiny-llama")
+    rng = jax.random.PRNGKey(7)
+    topo = MeshTopology({"tensor": 1, "data": 1})
+    full = InferenceEngine(model, config={"max_seq_len": 64}, rng=rng,
+                           topology=topo)
+    q8 = InferenceEngine(model, config={"max_seq_len": 64,
+                                        "quant": {"weight": {"num_bits": 8}}},
+                         rng=rng, topology=topo)
+    assert q8.config.quant_bits == 8
+    qleaves = [l for l in jax.tree.leaves(
+        q8.params, is_leaf=lambda x: isinstance(x, QuantizedTensor))
+        if isinstance(l, QuantizedTensor)]
+    assert qleaves, "no weights were quantized"
+
+    def nbytes(t):
+        return sum(l.nbytes for l in jax.tree.leaves(t))
+
+    assert nbytes(q8.params) < 0.6 * nbytes(full.params)
+
+    prompts = np.asarray([[5, 9, 2, 7, 1, 3]], np.int32)
+    ref = np.asarray(full.generate(prompts, max_new_tokens=8, greedy=True))
+    got = np.asarray(q8.generate(prompts, max_new_tokens=8, greedy=True))
+    # int8 blockwise is near-lossless; allow a late-chain tie flip
+    assert (ref[0] == got[0]).mean() >= 0.75
+
+    # logits stay close on the prompt forward
+    lf = np.asarray(full.forward(prompts), np.float32)
+    lq = np.asarray(q8.forward(prompts), np.float32)
+    rel = np.abs(lf - lq).max() / np.abs(lf).max()
+    assert rel < 0.08, rel

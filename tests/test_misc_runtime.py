@@ -155,3 +155,23 @@ def test_tiled_linear_trains():
     g = jax.grad(lambda pp: jnp.sum(m.apply({"params": pp}, x) ** 2))(p)
     assert all(np.isfinite(np.asarray(l)).all() for l in jax.tree.leaves(g))
     assert sum(np.abs(np.asarray(l)).sum() for l in jax.tree.leaves(g)) > 0
+
+
+def test_instrument_w_nvtx_annotation():
+    """Range decorator runs inside jit and names the scope in the HLO
+    (reference utils/nvtx.py instrument_w_nvtx)."""
+    import jax
+    import jax.numpy as jnp
+
+    from deepspeed_tpu.utils.annotations import instrument_w_nvtx, range_push
+
+    @instrument_w_nvtx(name="my_marked_op")
+    def f(x):
+        return x * 2 + 1
+
+    out = jax.jit(f)(jnp.ones((4,)))
+    assert float(out[0]) == 3.0
+    txt = jax.jit(f).lower(jnp.ones((4,))).as_text(debug_info=True)
+    assert "my_marked_op" in txt
+    with range_push("block"):
+        assert float(f(jnp.ones(()))) == 3.0
