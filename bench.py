@@ -38,9 +38,14 @@ def main():
     micro_bs = int(os.environ.get("BENCH_MICRO_BS", "8"))
     steps = int(os.environ.get("BENCH_STEPS", "10"))
     warmup = int(os.environ.get("BENCH_WARMUP", "3"))
+    attn = os.environ.get("BENCH_ATTN", "auto")   # auto | pallas | xla
+    remat = os.environ.get("BENCH_REMAT", "0") == "1"
 
     n_dev = len(jax.devices())
-    model = build_model(model_name, max_seq_len=seq_len)
+    overrides = {"attn_impl": attn}
+    if remat:
+        overrides |= {"remat": True, "remat_policy": "dots_saveable"}
+    model = build_model(model_name, max_seq_len=seq_len, **overrides)
     topo = MeshTopology({"fsdp": n_dev, "data": 1})
     engine, *_ = ds.initialize(
         model=model,

@@ -27,8 +27,14 @@ def ds_ssh_main(argv=None) -> int:
     args = p.parse_args(argv)
     if not args.command:
         p.error("no command given")
-    # preserve argument boundaries through the local/pdsh/remote shell
-    cmd = " ".join(shlex.quote(a) for a in args.command)
+    if len(args.command) == 1:
+        # one pre-quoted string: pass raw so remote shell syntax
+        # (&&, |, $VAR, globs) keeps working, as in the reference ds_ssh
+        cmd = args.command[0]
+    else:
+        # word-per-argv form: preserve argument boundaries through the
+        # local/pdsh/remote shell
+        cmd = " ".join(shlex.quote(a) for a in args.command)
     hosts = list(parse_hostfile(args.hostfile))
     if not hosts:
         print(f"hostfile '{args.hostfile}' missing/empty; running locally",
