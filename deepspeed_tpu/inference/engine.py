@@ -104,6 +104,17 @@ class InferenceEngine:
         self.topology = topology
         self._rules = default_activation_rules(topology)
 
+        if self.config.quant_bits and topology.mesh.size > 1:
+            # quantize's blockwise flatten crosses sharded axes, so GSPMD
+            # would replicate the quantized tree — every device holding the
+            # full model defeats the capacity goal. The ZeRO-Inference
+            # target is single-chip big-model serving. Checked BEFORE the
+            # (expensive) weight load so misconfiguration fails fast.
+            raise ValueError(
+                "quant_bits requires a single-device mesh (blockwise "
+                "quantization is incompatible with TP sharding); drop "
+                "tensor_parallel or serve unquantized")
+
         # TP-shard (stage-0) plan for the weights: logical rules only.
         from .weights import load_tp_params
 
@@ -113,15 +124,6 @@ class InferenceEngine:
         if self.config.quant_bits and materialize:
             from ..ops.quantizer import quantize
 
-            if topology.mesh.size > 1:
-                # quantize's blockwise flatten crosses sharded axes, so
-                # GSPMD would replicate the quantized tree — every device
-                # holding the full model defeats the capacity goal. The
-                # ZeRO-Inference target is single-chip big-model serving.
-                raise ValueError(
-                    "quant_bits requires a single-device mesh (blockwise "
-                    "quantization is incompatible with TP sharding); drop "
-                    "tensor_parallel or serve unquantized")
             bits = self.config.quant_bits
 
             def q(x):
