@@ -90,8 +90,12 @@ class OffloadConfig:
     nvme_path: str | None = None
     buffer_count: int = 4
     pin_memory: bool = False  # accepted; host staging is always pinned by PJRT
+    #: ZeRO-Offload++ Twin-Flow (reference blogs/deepspeed-offloadpp):
+    #: fraction of optimizer state offloaded to the host; the rest updates
+    #: on device, overlapping with the host walk. 1.0 = classic full offload.
+    ratio: float = 1.0
 
-    _IGNORED_KEYS = ("buffer_size", "max_in_cpu", "fast_init", "ratio")
+    _IGNORED_KEYS = ("buffer_size", "max_in_cpu", "fast_init")
 
 
 @dataclass

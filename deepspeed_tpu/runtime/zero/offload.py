@@ -54,6 +54,21 @@ class HostOffloadOptimizer:
         self.compute_dtype = compute_dtype
         self.state: dict[str, HostOptState] = {}
         self._step = 0
+        # Twin-Flow (ZeRO-Offload++, blogs/deepspeed-offloadpp): keep
+        # (1 - ratio) of the state on device; its jitted update dispatches
+        # asynchronously and overlaps with the host optimizer walk.
+        self.ratio = float(getattr(offload_cfg, "ratio", 1.0))
+        if not (0.0 <= self.ratio <= 1.0):
+            raise ValueError(f"offload ratio must be in [0, 1], got {self.ratio}")
+        # built lazily in init_from_master iff a device share exists —
+        # the strict device-optimizer constructors must not reject configs
+        # the (lenient) host path accepts when ratio == 1.0
+        self._opt_spec = (opt_type, opt_params)
+        self._dev_opt = None
+        self._dev_master: dict[str, jax.Array] = {}
+        self._dev_shardings: dict[str, Any] = {}
+        self._dev_state = None
+        self._dev_update = None
 
         self.aio: AsyncIOHandle | None = None
         self.nvme_dir: str | None = None
@@ -67,14 +82,44 @@ class HostOffloadOptimizer:
 
     # ------------------------------------------------------------------
     def init_from_master(self, master_tree: Pytree) -> None:
-        """Take ownership of the fp32 master pytree (device arrays) as host
-        state; with NVMe, immediately spill moments+master to disk."""
-        for key, leaf in _flatten(master_tree).items():
+        """Take ownership of the fp32 master pytree (device arrays): a
+        ``ratio`` fraction (by bytes) becomes host state (NVMe-spilled when
+        configured); the rest stays on device with a jitted fused update."""
+        flat = _flatten(master_tree)
+        total = sum(int(np.prod(l.shape)) for l in flat.values())
+        dev_budget = (1.0 - self.ratio) * total
+        dev_used = 0
+        for key, leaf in flat.items():
+            n = int(np.prod(leaf.shape))
+            if dev_used + n <= dev_budget:
+                dev_used += n
+                self._dev_master[key] = jnp.asarray(leaf, jnp.float32)
+                self._dev_shardings[key] = self._dev_master[key].sharding
+                continue
             st = self.cpu_opt.init_state(np.asarray(leaf, np.float32),
                                          dtype=self.compute_dtype)
             self.state[key] = st
             if self.device == "nvme":
                 self._spill(key, st)
+        if self._dev_master:
+            from ...ops.optimizers import build_optimizer
+
+            self._dev_opt = build_optimizer(*self._opt_spec)
+            self._dev_state = self._dev_opt.init(self._dev_master)
+
+            def upd(master, opt_state, grads, lr):
+                new_master, new_state = self._dev_opt.update(
+                    grads, opt_state, master, lr=lr)
+                params = jax.tree.map(
+                    lambda m: m.astype(self.compute_dtype), new_master)
+                return new_master, new_state, params
+
+            # donate master+state: no transient second copy of the share
+            self._dev_update = jax.jit(upd, donate_argnums=(0, 1))
+            logger.info(
+                f"Twin-Flow: {len(self._dev_master)} leaves "
+                f"({dev_used / max(total, 1):.0%} of state) update on device, "
+                f"{len(self.state)} on host")
 
     # -- nvme staging ---------------------------------------------------
     def _path(self, key: str, slot: str) -> str:
@@ -113,10 +158,20 @@ class HostOffloadOptimizer:
         placed per ``param_shardings``."""
         self._step += 1
         grads = _flatten(grads_tree)
-        keys = list(grads.keys())
-        missing = [k for k in keys if k not in self.state]
+        keys = [k for k in grads if k in self.state]
+        missing = [k for k in grads
+                   if k not in self.state and k not in self._dev_master]
         if missing:
             raise KeyError(f"offload state missing for {missing[:3]}...")
+
+        # Twin-Flow: dispatch the device-resident update first — jit
+        # dispatch is async, so it runs while the host walks its share
+        dev_params = None
+        if self._dev_master:
+            dev_grads = {k: grads[k] for k in self._dev_master}
+            self._dev_master, self._dev_state, dev_params = self._dev_update(
+                self._dev_master, self._dev_state, dev_grads,
+                jnp.float32(lr))
 
         # NVMe: prefetch the first `lookahead` leaves before the walk
         inflight: dict[str, dict] = {}
@@ -150,6 +205,10 @@ class HostOffloadOptimizer:
 
         for _, r in write_reqs:
             self.aio.wait(r)
+
+        if dev_params is not None:
+            for k, leaf in dev_params.items():
+                new_leaves[k] = jax.device_put(leaf, shardings[k])
 
         # rebuild the tree in the original structure
         treedef = jax.tree_util.tree_structure(param_shardings)
@@ -186,11 +245,40 @@ class HostOffloadOptimizer:
                 # the dict's views keep the buffers alive; drop the state's
                 # own refs so post-save the disk copy is authoritative
                 st.drop_buffers()
+        for key, leaf in self._dev_master.items():   # Twin-Flow device share
+            out["master"][key] = np.asarray(leaf, np.float32)
+            if self._dev_state.mu is not None:
+                out.setdefault("mu", {})[key] = np.asarray(
+                    self._dev_state.mu[key], np.float32)
+            if self._dev_state.nu is not None:
+                out.setdefault("nu", {})[key] = np.asarray(
+                    self._dev_state.nu[key], np.float32)
         return out
 
     def load_global_trees(self, master: dict, mu: dict | None,
                           nu: dict | None, step: int) -> None:
         self._step = int(step)
+        if self._dev_master:
+            from ...ops.optimizers import OptState
+
+            def put(k, arr):   # restore with the leaf's original sharding
+                return jax.device_put(np.asarray(arr, np.float32),
+                                      self._dev_shardings[k])
+
+            self._dev_master = {k: put(k, master[k])
+                                for k in self._dev_master}
+            st = self._dev_state
+            self._dev_state = OptState(
+                step=jnp.asarray(step, jnp.int32),
+                mu=None if st.mu is None else
+                {k: put(k, mu[k]) if mu and k in mu
+                 else jnp.zeros_like(self._dev_master[k])
+                 for k in self._dev_master},
+                nu=None if st.nu is None else
+                {k: put(k, nu[k]) if nu and k in nu
+                 else jnp.zeros_like(self._dev_master[k])
+                 for k in self._dev_master},
+                error=st.error)
         for key, st in self.state.items():
             st2 = HostOptState(
                 master=np.ascontiguousarray(master[key], np.float32).reshape(-1),
