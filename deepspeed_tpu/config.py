@@ -92,10 +92,17 @@ class OffloadConfig:
     pin_memory: bool = False  # accepted; host staging is always pinned by PJRT
     #: ZeRO-Offload++ Twin-Flow (reference blogs/deepspeed-offloadpp):
     #: fraction of optimizer state offloaded to the host; the rest updates
-    #: on device, overlapping with the host walk. 1.0 = classic full offload.
+    #: on device, overlapping with the host walk. 1.0 = classic full
+    #: offload. Honored by ``offload_optimizer`` only — ``offload_param``
+    #: rejects partial ratios (validated in ZeroConfig).
     ratio: float = 1.0
 
     _IGNORED_KEYS = ("buffer_size", "max_in_cpu", "fast_init")
+
+    def __post_init__(self):
+        if not (0.0 <= self.ratio <= 1.0):
+            raise ValueError(f"offload ratio must be in [0, 1], "
+                             f"got {self.ratio}")
 
 
 @dataclass
@@ -141,6 +148,10 @@ class ZeroConfig:
         if isinstance(self.offload_param, dict):
             self.offload_param = _take(self.offload_param, OffloadConfig,
                                        "zero_optimization.offload_param")
+        if self.offload_param.ratio != 1.0:
+            raise ValueError(
+                "offload_param.ratio is not supported (Twin-Flow partial "
+                "offload applies to offload_optimizer only)")
         if not 0 <= self.stage <= 3:
             raise ValueError(f"zero stage must be 0-3, got {self.stage}")
 

@@ -168,7 +168,17 @@ class InferenceEngineV2:
                 # from the v1/training forward exactly when eval capacity
                 # would bind — there v1 drops overflow tokens, v2 doesn't.
                 mod = MoE(**moe_layer_kwargs(m, drop_tokens=False))
-                return mod.apply({"params": p["moe"]["moe_layer"]}, h, True)
+                out = mod.apply({"params": p["moe"]["moe_layer"]}, h, True)
+                se = m.moe.shared_expert_intermediate
+                if se:   # qwen2-moe sigmoid-gated shared expert
+                    shared_cfg = dataclasses.replace(m, intermediate_size=se)
+                    shared = DenseFFN(shared_cfg).apply(
+                        {"params": p["moe"]["shared_expert"]}, h)
+                    g = jax.nn.sigmoid(jnp.einsum(
+                        "ste,eo->sto", h.astype(jnp.float32),
+                        p["moe"]["shared_gate"].astype(jnp.float32)))
+                    out = out + g.astype(out.dtype) * shared
+                return out
             return DenseFFN(m).apply({"params": p["ffn"]}, h)
 
         def attention(p, kv, h):

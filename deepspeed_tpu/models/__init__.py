@@ -108,6 +108,28 @@ PRESETS: dict[str, ModelConfig] = {
                             position_embedding="rope", norm="rmsnorm",
                             activation="silu_glu", qkv_bias=True,
                             tie_embeddings=False),
+    "phi-3-mini": ModelConfig(vocab_size=32064, hidden_size=3072,
+                              num_layers=32, num_heads=32,
+                              intermediate_size=8192, max_seq_len=4096,
+                              position_embedding="rope", norm="rmsnorm",
+                              activation="silu_glu", tie_embeddings=False),
+    "internlm-7b": ModelConfig(vocab_size=103168, hidden_size=4096,
+                               num_layers=32, num_heads=32,
+                               intermediate_size=11008, max_seq_len=2048,
+                               position_embedding="rope", norm="rmsnorm",
+                               activation="silu_glu", qkv_bias=True,
+                               tie_embeddings=False),
+    # qwen2-moe (qwen1.5-moe-a2.7b): 60 fine-grained experts top-4 plus a
+    # sigmoid-gated shared expert (reference inference/v2 qwen_v2_moe)
+    "qwen2-moe-a2.7b": ModelConfig(vocab_size=151936, hidden_size=2048,
+                                   num_layers=24, num_heads=16,
+                                   intermediate_size=1408, max_seq_len=8192,
+                                   position_embedding="rope", norm="rmsnorm",
+                                   activation="silu_glu", qkv_bias=True,
+                                   tie_embeddings=False,
+                                   moe=MoEConfig(
+                                       num_experts=60, top_k=4,
+                                       shared_expert_intermediate=5632)),
     # --- bert family: bidirectional post-norm encoders (reference
     # module_inject/containers/{bert,distil_bert}.py policies and the
     # csrc/transformer training kernels, whose target workload is BERT) ----
@@ -171,6 +193,15 @@ PRESETS: dict[str, ModelConfig] = {
                              position_embedding="learned", activation="gelu",
                              causal=False, pre_norm=False,
                              type_vocab_size=2),
+    "tiny-qwen2-moe": ModelConfig(vocab_size=256, hidden_size=64,
+                                  num_layers=2, num_heads=4, num_kv_heads=2,
+                                  intermediate_size=96, max_seq_len=128,
+                                  position_embedding="rope", norm="rmsnorm",
+                                  activation="silu_glu", qkv_bias=True,
+                                  tie_embeddings=False,
+                                  moe=MoEConfig(
+                                      num_experts=4, top_k=2, min_capacity=4,
+                                      shared_expert_intermediate=128)),
 }
 
 

@@ -78,6 +78,10 @@ class MoEConfig:
     # instead of capacity-dispatch einsums (ops/pallas/grouped_matmul.py)
     dropless: bool = False
     dropless_block_m: int = 128
+    # qwen2-moe/deepseek-style always-on shared expert: a dense FFN of this
+    # intermediate size added to the routed output through a sigmoid gate
+    # (reference inference/v2 qwen_v2_moe shared expert). None = no shared.
+    shared_expert_intermediate: int | None = None
 
 
 @dataclass(frozen=True)
@@ -140,6 +144,8 @@ class ModelConfig:
             ffn_dense = 2 * h * f + f + h  # + biases
         if self.moe:
             ffn = self.moe.num_experts * 3 * h * f + h * self.moe.num_experts
+            if self.moe.shared_expert_intermediate:
+                ffn += 3 * h * self.moe.shared_expert_intermediate + h
         else:
             ffn = ffn_dense
         if self.qkv_bias:
@@ -370,15 +376,27 @@ def moe_layer_kwargs(cfg: ModelConfig, **overrides) -> dict:
 
 class MoEFFN(nn.Module):
     """Routed expert FFN — thin adapter over the first-class MoE layer
-    (deepspeed_tpu/moe/layer.py; reference deepspeed/moe/layer.py:17)."""
+    (deepspeed_tpu/moe/layer.py; reference deepspeed/moe/layer.py:17), plus
+    the optional qwen2-moe-style sigmoid-gated shared expert."""
     config: ModelConfig
 
     @nn.compact
     def __call__(self, x, deterministic: bool = True):
         from ..moe.layer import MoE
 
-        return MoE(**moe_layer_kwargs(self.config),
-                   name="moe_layer")(x, deterministic)
+        cfg = self.config
+        out = MoE(**moe_layer_kwargs(cfg), name="moe_layer")(x, deterministic)
+        se = cfg.moe.shared_expert_intermediate
+        if se:
+            shared_cfg = dataclasses.replace(cfg, intermediate_size=se)
+            shared = DenseFFN(shared_cfg, name="shared_expert")(x)
+            gate = self.param("shared_gate", nn.with_partitioning(
+                _dense_init(), ("embed", None)),
+                (cfg.hidden_size, 1), jnp.float32)
+            g = jax.nn.sigmoid(
+                jnp.einsum("bse,eo->bso", x.astype(jnp.float32), gate))
+            out = out + g.astype(out.dtype) * shared
+        return out
 
 
 class Block(nn.Module):
