@@ -119,5 +119,11 @@ def main(hide_errors: bool = False) -> str:
     return text
 
 
+def cli_main() -> int:
+    """Console-script entry (pyproject ``ds-tpu-report``)."""
+    main()
+    return 0
+
+
 if __name__ == "__main__":
     main()
