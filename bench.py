@@ -71,7 +71,12 @@ def main():
     jax.block_until_ready(loss)
 
     n_params = engine.num_parameters()
-    flops_per_token = 6 * n_params  # fwd+bwd dense-transformer rule of thumb
+    # standard MFU accounting (PaLM appendix B; what the Ulysses baseline's
+    # TFLOPs numbers also count): 6N weight flops + attention matmul flops
+    # 12*L*S*D_model per token (QK^T + PV, fwd+bwd)
+    mc = model.config
+    attn_flops = 12 * mc.num_layers * seq_len * mc.num_heads * mc.head_dim
+    flops_per_token = 6 * n_params + attn_flops
     kind = jax.devices()[0].device_kind
     peak = next((v for k, v in PEAK_BF16_TFLOPS.items() if k in str(kind)), None)
     tokens_per_step = B * seq_len

@@ -203,7 +203,9 @@ class InferenceEngine:
                     done = done | (nxt == eos_id)
                 return (caches, nxt, rng, done), tok
 
-            done0 = jnp.zeros((B,), bool)
+            # the prefill-sampled token can itself be eos
+            done0 = (next_tok == eos_id) if eos_id is not None \
+                else jnp.zeros((B,), bool)
             (caches, last, rng, done), toks = jax.lax.scan(
                 step, (caches, next_tok, rng, done0), None, length=max_new - 1)
             toks = jnp.concatenate([jnp.swapaxes(toks, 0, 1), last[:, None]], axis=1)
