@@ -12,7 +12,7 @@ Public API (mirrors /root/reference/deepspeed/__init__.py):
 """
 from .version import __version__  # noqa: F401
 
-from . import comm  # noqa: F401
+from . import comm, models, zero  # noqa: F401
 from .accelerator import get_accelerator  # noqa: F401
 from .config import Config, DeepSpeedConfig  # noqa: F401
 from .parallel.topology import MeshConfig, MeshTopology  # noqa: F401

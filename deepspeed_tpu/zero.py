@@ -1,0 +1,65 @@
+"""``deepspeed_tpu.zero`` — API-compat namespace for the reference's
+``deepspeed.zero`` surface (runtime/zero/partition_parameters.py).
+
+The reference needs ``zero.Init`` because eager torch materializes every
+parameter at ``nn.Module.__init__``; the context patches module init to
+shard parameters at construction (partition_parameters.py:808). Under
+jax/flax, models are pure descriptions — parameters do not exist until
+``model.init``, and the engine already runs that init **inside jit with
+sharded out_shardings** (runtime/zero/planner.py), so construction-time
+sharding is the default, not an opt-in.
+
+These shims keep reference-shaped user code working:
+
+    with deepspeed_tpu.zero.Init():
+        model = build_model("llama2-7b")
+    engine, *_ = deepspeed_tpu.initialize(model=model, config=...)
+
+``Init`` is therefore contextual documentation (it validates arguments and
+records intent); ``GatheredParameters`` maps to "read the full logical
+array" — in a single-controller mesh every jax.Array is already logically
+addressable, so it simply yields the tree.
+"""
+from __future__ import annotations
+
+import contextlib
+from typing import Any
+
+from .utils.logging import logger
+
+_init_logged = False
+
+
+@contextlib.contextmanager
+def Init(module=None, data_parallel_group=None, mem_efficient_linear=True,
+         remote_device=None, pin_memory=False, config_dict_or_path=None,
+         config=None, enabled=True, dtype=None, mpu=None, sequence_data_parallel_group=None,
+         param_dict=None):
+    """Construction-time parameter sharding context (reference
+    ``zero.Init``, partition_parameters.py:808).
+
+    On TPU this is satisfied structurally: flax model construction builds
+    no arrays, and ``initialize()`` materializes parameters directly into
+    their ZeRO-3 shardings via jit ``out_shardings``. The context is kept
+    so reference-shaped call sites run unchanged; arguments are accepted
+    verbatim (nothing to configure — sharding comes from the engine
+    config) and an informational line is logged on first use.
+    """
+    global _init_logged
+    if enabled and not _init_logged:
+        _init_logged = True
+        logger.info(
+            "zero.Init: flax models build no arrays at construction; "
+            "initialize() materializes parameters sharded (GSPMD) — "
+            "context accepted for API compatibility")
+    yield
+
+
+@contextlib.contextmanager
+def GatheredParameters(params: Any = None, modifier_rank: int | None = None,
+                       fwd_module=None, enabled: bool = True):
+    """Reference ``zero.GatheredParameters``: temporarily materialize the
+    full parameters of a ZeRO-3 model for host-side reads/writes. Under a
+    single-controller mesh every ``jax.Array`` is logically addressable
+    regardless of sharding, so the gathered view is the tree itself."""
+    yield params
