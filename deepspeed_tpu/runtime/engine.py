@@ -895,6 +895,20 @@ class DeepSpeedEngine:
 
         return _save(self, save_dir, tag=tag, client_state=client_state)
 
+    def deepspeed_io(self, dataset, batch_size: int | None = None, *,
+                     shuffle: bool = True, drop_last: bool = True,
+                     collate_fn=None):
+        """Build a global-batch DataLoader for this engine (reference
+        ``deepspeed_io``, engine.py:1743). ``batch_size`` defaults to the
+        engine's global train batch; the jitted step shards it per plan."""
+        from .data import DataLoader
+
+        return DataLoader(dataset,
+                          batch_size if batch_size is not None
+                          else self.config.train_batch_size,
+                          shuffle=shuffle, seed=self.config.seed,
+                          drop_last=drop_last, collate_fn=collate_fn)
+
     def load_checkpoint(self, load_dir: str, tag: str | None = None) -> dict:
         from .checkpointing import load_checkpoint as _load
 
@@ -915,10 +929,12 @@ def initialize(model: nn.Module | None = None,
                topology: MeshTopology | None = None,
                sample_batch: dict | None = None,
                rng: jax.Array | None = None,
+               training_data=None,
                **kwargs):
     """Training bring-up (reference deepspeed/__init__.py:69). Returns
-    ``(engine, optimizer, dataloader, lr_scheduler)`` for signature parity —
-    dataloader is None unless you use ``runtime.data.DataLoader``."""
+    ``(engine, optimizer, dataloader, lr_scheduler)``; the dataloader is
+    built from ``training_data`` (reference ``training_data`` arg →
+    ``deepspeed_io``) or None."""
     cfg = Config.load(config)
     engine_cls = DeepSpeedEngine
     if cfg.hybrid_engine.enabled:
@@ -928,4 +944,6 @@ def initialize(model: nn.Module | None = None,
     engine = engine_cls(config=cfg, model=model, loss_fn=loss_fn, params=params,
                         topology=topology, sample_batch=sample_batch, rng=rng,
                         **kwargs)
-    return engine, engine.optimizer, None, engine.lr_schedule
+    loader = engine.deepspeed_io(training_data) if training_data is not None \
+        else None
+    return engine, engine.optimizer, loader, engine.lr_schedule
