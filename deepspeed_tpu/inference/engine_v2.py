@@ -70,6 +70,10 @@ class RaggedInferenceConfig:
     #: use the Pallas paged-attention kernel for decode steps; None = auto
     #: (on whenever the kernel supports the model's head geometry)
     use_pallas_decode: bool | None = None
+    #: when every live sequence is decoding, run up to this many decode
+    #: iterations inside ONE jitted program (lax.scan) — one host→device
+    #: dispatch per window instead of per token. 1 disables windowing.
+    decode_window: int = 8
 
 
 class InferenceEngineV2:
@@ -283,6 +287,94 @@ class InferenceEngineV2:
                                         out_shardings=(self._pool_sharding, None))
         return self._programs[T]
 
+    def _window_program(self, W: int):
+        """W chained decode steps in one jitted program: per step, each
+        slot's write slot comes from its block table at the current
+        position, the forward runs with T=1, and the sampled token feeds
+        the next step. One dispatch per window instead of per token."""
+        key = ("win", W)
+        if key not in self._programs:
+            cfg = self.config
+            bs = cfg.block_size
+
+            def run(params, kv_pool, tok0, pos0, lens0, block_tables,
+                    active, rng):
+                def stepfn(carry, _):
+                    kv_pool, tok, pos, lens, rng = carry
+                    blk = jnp.take_along_axis(
+                        block_tables, (pos // bs)[:, None], axis=1)[:, 0]
+                    # inactive slots carry zeroed tables → blk 0 → trash
+                    slot = blk * bs + pos % bs
+                    with nn.logical_axis_rules(self._rules):
+                        kv_pool2, logits = self._ragged_forward(
+                            params, kv_pool, tok[:, None], pos[:, None],
+                            slot[:, None], block_tables, lens,
+                            jnp.zeros_like(pos))
+                    rng, sub = jax.random.split(rng)
+                    nxt = sample_logits(logits.astype(jnp.float32), sub,
+                                        temperature=cfg.temperature,
+                                        top_k=cfg.top_k, top_p=cfg.top_p,
+                                        greedy=cfg.greedy)
+                    nxt = jnp.where(active, nxt, 0)
+                    return (kv_pool2, nxt, pos + 1, lens + 1, rng), nxt
+
+                (kv_pool, *_), toks = jax.lax.scan(
+                    stepfn, (kv_pool, tok0, pos0, lens0, rng), None, length=W)
+                return kv_pool, toks                       # [W, S]
+
+            self._programs[key] = jax.jit(
+                run, donate_argnums=(1,),
+                out_shardings=(self._pool_sharding, None))
+        return self._programs[key]
+
+    def _try_decode_window(self):
+        """All-decoding fast path: run min(remaining) decode steps (capped
+        by ``decode_window``) in one dispatch. Returns the sampled dict or
+        None when the window path does not apply."""
+        if self.config.decode_window <= 1:
+            return None
+        live = [s for s in self.state.seqs.values()
+                if not s.done and s.slot >= 0]
+        if not live or any(s.pending_tokens != 1 for s in live):
+            return None
+        W = min(min(s.max_new_tokens - s.n_generated for s in live),
+                self.config.decode_window)
+        if W <= 1:
+            return None
+        W = 1 << (W.bit_length() - 1)   # pow2 → bounded set of programs
+
+        S = self.state.max_seqs
+        mb = self.state.max_blocks_per_seq
+        tok0 = np.zeros((S,), np.int32)
+        pos0 = np.zeros((S,), np.int32)
+        lens0 = np.zeros((S,), np.int32)
+        tables = np.zeros((S, mb), np.int32)
+        active = np.zeros((S,), bool)
+        for s in live:
+            tok0[s.slot] = s.tokens[-1]
+            pos0[s.slot] = len(s.tokens) - 1
+            lens0[s.slot] = len(s.tokens)
+            tables[s.slot, :len(s.blocks)] = s.blocks
+            active[s.slot] = True
+
+        fn = self._window_program(W)
+        self._rng, sub = jax.random.split(self._rng)
+        self.kv_pool, toks = fn(self.params, self.kv_pool,
+                                jnp.asarray(tok0), jnp.asarray(pos0),
+                                jnp.asarray(lens0), jnp.asarray(tables),
+                                jnp.asarray(active), sub)
+        toks = np.asarray(toks)                            # [W, S]
+        sampled = {}
+        for s in live:
+            new = [int(t) for t in toks[:, s.slot]]
+            s.tokens.extend(new)
+            s.n_computed += W
+            s.n_generated += W
+            s.done = s.n_generated >= s.max_new_tokens
+            self._results[s.uid].extend(new)
+            sampled[s.uid] = new[-1]
+        return sampled
+
     # ------------------------------------------------------------------
     # public API (reference engine_v2.py put/query/flush)
     # ------------------------------------------------------------------
@@ -322,7 +414,11 @@ class InferenceEngineV2:
 
     def step(self) -> dict[int, int]:
         """Run one scheduled forward step; returns {uid: sampled_token} for
-        sequences that produced a token. Empty dict = nothing to do."""
+        sequences that produced a token (the last of the window when the
+        multi-step decode path runs). Empty dict = nothing to do."""
+        windowed = self._try_decode_window()
+        if windowed is not None:
+            return windowed
         plan = self.scheduler.next_step()
         if plan is None:
             return {}
