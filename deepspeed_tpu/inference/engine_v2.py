@@ -367,10 +367,7 @@ class InferenceEngineV2:
         sampled = {}
         for s in live:
             new = [int(t) for t in toks[:, s.slot]]
-            s.tokens.extend(new)
-            s.n_computed += W
-            s.n_generated += W
-            s.done = s.n_generated >= s.max_new_tokens
+            s.commit_generated(new, W)
             self._results[s.uid].extend(new)
             sampled[s.uid] = new[-1]
         return sampled

@@ -66,6 +66,16 @@ class SequenceDescriptor:
         (sampled or final-prompt) token."""
         return len(self.tokens) - self.n_computed
 
+    def commit_generated(self, new_tokens: list[int], n_computed: int) -> None:
+        """THE generation-accounting step, shared by the per-step scheduler
+        commit and the multi-step decode window: append sampled tokens,
+        advance the computed-KV counter, apply the stop criterion."""
+        self.tokens.extend(new_tokens)
+        self.n_computed += n_computed
+        self.n_generated += len(new_tokens)
+        if self.n_generated >= self.max_new_tokens:
+            self.done = True
+
 
 class StateManager:
     """Tracks live sequences + owns the allocator (reference

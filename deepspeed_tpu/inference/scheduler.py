@@ -96,10 +96,5 @@ class SplitFuseScheduler:
                 continue
             seq = st.seqs[uid]
             n = int(plan.active[s].sum())
-            seq.n_computed += n
-            if plan.do_sample[s]:
-                tok = sampled[uid]
-                seq.tokens.append(tok)
-                seq.n_generated += 1
-                if seq.n_generated >= seq.max_new_tokens:
-                    seq.done = True
+            seq.commit_generated(
+                [sampled[uid]] if plan.do_sample[s] else [], n)

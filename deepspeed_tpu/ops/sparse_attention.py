@@ -248,6 +248,17 @@ def block_sparse_attention(q, k, v, layout: np.ndarray, block: int,
     B, S, H, D = q.shape
     if scale is not None and abs(scale - D ** -0.5) > 1e-12:
         q = q * (scale * D ** 0.5)  # fold a custom scale into q
+
+    # grid-pruned Pallas path: masked blocks cost nothing (long-seq fast
+    # path; the masked XLA formulation below is the numerics oracle)
+    from .pallas.block_sparse_attention import (block_sparse_flash_attention,
+                                                block_sparse_usable)
+
+    if block_sparse_usable(layout, block, S, D, H, k.shape[2]) \
+            and jax.device_count() == 1:
+        return block_sparse_flash_attention(q, k, v, np.asarray(layout),
+                                            block, causal=causal)
+
     mask = layout_to_mask(layout, block)           # [H, S, S]
     if causal:
         mask = mask & jnp.tril(jnp.ones((S, S), jnp.bool_))[None]
