@@ -1,0 +1,83 @@
+"""Device trace capture + xplane analysis — the nsight/NVTX-report analogue.
+
+Reference profiling surfaces kernel timelines via nsight/torch profiler;
+on TPU the equivalent is a ``jax.profiler`` trace whose xplane protobuf
+carries per-op device timings. The stock tensorboard converter is broken in
+some images, so this module parses the xplane directly (the recipe from
+.claude/skills/verify) and aggregates exclusive device time per op — the
+tool used to find this framework's own train-step bottlenecks.
+"""
+from __future__ import annotations
+
+import collections
+import glob
+import os
+import re
+from contextlib import contextmanager
+
+import jax
+
+
+@contextmanager
+def trace(log_dir: str):
+    """Capture a device trace: ``with trace(dir): run_steps()``. Pair with
+    :func:`op_breakdown` to read it back."""
+    jax.profiler.start_trace(log_dir)
+    try:
+        yield
+    finally:
+        jax.profiler.stop_trace()
+
+
+def _latest_xplane(log_dir: str) -> str:
+    paths = sorted(glob.glob(os.path.join(log_dir, "**", "*.xplane.pb"),
+                             recursive=True))
+    if not paths:
+        raise FileNotFoundError(f"no xplane.pb under {log_dir} — did the "
+                                f"trace() context run any device work?")
+    return paths[-1]
+
+
+def op_breakdown(log_dir: str, *, by_base_name: bool = True,
+                 device_substr: str = "TPU") -> dict[str, float]:
+    """{op name: total device ms} from the newest trace under ``log_dir``.
+
+    ``by_base_name`` strips the ``%name.123`` instance suffix so repeated
+    ops (one per layer) aggregate. Requires the tensorflow profiler protos
+    (present in images that ship tensorflow); raises ImportError otherwise.
+    """
+    os.environ.setdefault("PROTOCOL_BUFFERS_PYTHON_IMPLEMENTATION", "python")
+    from tensorflow.tsl.profiler.protobuf import xplane_pb2
+
+    xs = xplane_pb2.XSpace()
+    with open(_latest_xplane(log_dir), "rb") as f:
+        xs.ParseFromString(f.read())
+    totals: dict[str, float] = collections.Counter()
+    # aggregate over EVERY matching device plane (multi-chip hosts have one
+    # per device; runtime planes without an "XLA Ops" line contribute 0)
+    for plane in xs.planes:
+        if device_substr not in plane.name:
+            continue
+        meta = plane.event_metadata
+        for line in plane.lines:
+            if line.name != "XLA Ops":      # exclusive per-op timings
+                continue
+            for ev in line.events:
+                name = meta[ev.metadata_id].name
+                if by_base_name:
+                    name = re.sub(r"\.\d+$", "",
+                                  name.split(" = ")[0]).lstrip("%")
+                totals[name] += ev.duration_ps / 1e9
+    return dict(totals)
+
+
+def print_breakdown(log_dir: str, top: int = 20, steps: int = 1,
+                    device_substr: str = "TPU") -> str:
+    """Human-readable top-N op table (ms per step)."""
+    totals = op_breakdown(log_dir, device_substr=device_substr)
+    lines = [f"{'ms/step':>10}  op"]
+    for name, ms in sorted(totals.items(), key=lambda kv: -kv[1])[:top]:
+        lines.append(f"{ms / max(steps, 1):10.3f}  {name}")
+    text = "\n".join(lines)
+    print(text)
+    return text

@@ -85,3 +85,32 @@ def test_env_report_runs(capsys):
     text = env_report.main()
     assert "deepspeed_tpu environment report" in text
     assert "jax" in text
+
+
+def test_trace_capture_and_breakdown(tmp_path):
+    """profiling.trace: capture a device trace and read back per-op device
+    time (the xplane path nsight plays on GPU)."""
+    import jax
+    import jax.numpy as jnp
+    import pytest as _pytest
+
+    from deepspeed_tpu.profiling.trace import op_breakdown, trace
+
+    _pytest.importorskip("tensorflow.tsl.profiler.protobuf.xplane_pb2",
+                         reason="xplane protos need tensorflow")
+
+    @jax.jit
+    def f(a, b):
+        return (a @ b).sum()
+
+    a = jnp.ones((256, 256)); b = jnp.ones((256, 256))
+    jax.block_until_ready(f(a, b))          # compile outside the trace
+    with trace(str(tmp_path)):
+        jax.block_until_ready(f(a, b))
+    totals = op_breakdown(str(tmp_path), device_substr="TPU")
+    if jax.default_backend() != "tpu":
+        # CPU xplanes carry host-thread lines, not the per-op device line
+        # this utility reads; the capture machinery is still exercised
+        _pytest.skip("per-op device lines are TPU-trace only")
+    assert totals, "no device ops captured"
+    assert all(ms >= 0 for ms in totals.values())
