@@ -366,10 +366,10 @@ class InferenceEngineV2:
         toks = np.asarray(toks)                            # [W, S]
         sampled = {}
         for s in live:
-            new = [int(t) for t in toks[:, s.slot]]
-            s.commit_generated(new, W)
-            self._results[s.uid].extend(new)
-            sampled[s.uid] = new[-1]
+            new = s.commit_generated([int(t) for t in toks[:, s.slot]], W)
+            if new:
+                self._results[s.uid].extend(new)
+                sampled[s.uid] = new[-1]
         return sampled
 
     # ------------------------------------------------------------------
@@ -380,9 +380,11 @@ class InferenceEngineV2:
         worst-case block budget (blocks are reserved at admit)."""
         return self.state.can_admit(prompt_len, max_new_tokens)
 
-    def put(self, uid: int, prompt_tokens, max_new_tokens: int = 32) -> None:
+    def put(self, uid: int, prompt_tokens, max_new_tokens: int = 32,
+            eos_token_id: int | None = None) -> None:
         """Admit a request (reference ``put`` :107). Raises if the pool or
-        slot budget is exhausted — callers gate on ``can_schedule``."""
+        slot budget is exhausted — callers gate on ``can_schedule``.
+        ``eos_token_id`` stops the sequence early (truncated at the eos)."""
         toks = [int(t) for t in prompt_tokens]
         if not toks:
             raise ValueError("empty prompt")
@@ -390,7 +392,7 @@ class InferenceEngineV2:
             raise ValueError("prompt + max_new_tokens exceeds max_seq_len")
         if not self.state.can_admit(len(toks), max_new_tokens):
             raise RuntimeError("cannot schedule: pool/slots exhausted")
-        self.state.admit(uid, toks, max_new_tokens)
+        self.state.admit(uid, toks, max_new_tokens, eos_id=eos_token_id)
         self._results[uid] = []
 
     def query(self, uid: int) -> dict:
@@ -430,13 +432,16 @@ class InferenceEngineV2:
         toks = np.asarray(toks)
         sampled = {uid: int(toks[s]) for s, uid in enumerate(plan.uids)
                    if uid >= 0 and plan.do_sample[s]}
-        self.scheduler.commit(plan, sampled)
-        for uid, t in sampled.items():
-            self._results[uid].append(t)
-        return sampled
+        accepted = self.scheduler.commit(plan, sampled)
+        emitted = {}
+        for uid, new in accepted.items():   # stop criteria may drop tokens
+            if new:
+                self._results[uid].extend(new)
+                emitted[uid] = new[-1]
+        return emitted
 
-    def generate(self, prompts: list[list[int]], max_new_tokens: int = 32
-                 ) -> list[list[int]]:
+    def generate(self, prompts: list[list[int]], max_new_tokens: int = 32,
+                 eos_token_id: int | None = None) -> list[list[int]]:
         """Convenience driver: continuous-batch a set of prompts to
         completion (the MII serving loop, compressed)."""
         pending = list(enumerate(prompts))
@@ -446,7 +451,7 @@ class InferenceEngineV2:
             while pending and self.can_schedule(len(pending[0][1]),
                                                 max_new_tokens):
                 uid, toks = pending.pop(0)
-                self.put(uid, toks, max_new_tokens)
+                self.put(uid, toks, max_new_tokens, eos_token_id=eos_token_id)
                 live.add(uid)
             if not live:
                 raise RuntimeError(

@@ -58,6 +58,7 @@ class SequenceDescriptor:
     max_new_tokens: int = 0
     n_generated: int = 0
     done: bool = False
+    eos_id: int | None = None         # stop criterion besides max_new_tokens
 
     @property
     def pending_tokens(self) -> int:
@@ -66,15 +67,27 @@ class SequenceDescriptor:
         (sampled or final-prompt) token."""
         return len(self.tokens) - self.n_computed
 
-    def commit_generated(self, new_tokens: list[int], n_computed: int) -> None:
+    def commit_generated(self, new_tokens: list[int],
+                         n_computed: int) -> list[int]:
         """THE generation-accounting step, shared by the per-step scheduler
         commit and the multi-step decode window: append sampled tokens,
-        advance the computed-KV counter, apply the stop criterion."""
+        advance the computed-KV counter, apply the stop criteria
+        (max_new_tokens, and eos when configured — a window may sample past
+        the eos; the surplus is truncated here, never surfaced)."""
+        if self.eos_id is not None and new_tokens:
+            for i, t in enumerate(new_tokens):
+                if t == self.eos_id:
+                    new_tokens = new_tokens[:i + 1]
+                    self.done = True
+                    break
         self.tokens.extend(new_tokens)
-        self.n_computed += n_computed
+        # clamp: a truncated window computed KV for tokens we discarded;
+        # pending_tokens must never go negative for a finished sequence
+        self.n_computed = min(self.n_computed + n_computed, len(self.tokens))
         self.n_generated += len(new_tokens)
         if self.n_generated >= self.max_new_tokens:
             self.done = True
+        return new_tokens
 
 
 class StateManager:
@@ -102,7 +115,8 @@ class StateManager:
         need = self._blocks_for(prompt_len + max_new_tokens)
         return bool(self._free_slots) and self.allocator.free_blocks >= need
 
-    def admit(self, uid: int, tokens: list[int], max_new_tokens: int) -> SequenceDescriptor:
+    def admit(self, uid: int, tokens: list[int], max_new_tokens: int,
+              eos_id: int | None = None) -> SequenceDescriptor:
         if uid in self.seqs:
             raise ValueError(f"uid {uid} already live")
         if not tokens:
@@ -111,6 +125,7 @@ class StateManager:
             raise RuntimeError("no free sequence slots")
         seq = SequenceDescriptor(uid=uid, tokens=list(tokens),
                                  max_new_tokens=max_new_tokens,
+                                 eos_id=eos_id,
                                  slot=self._free_slots.pop(0))
         try:
             seq.blocks = self.allocator.allocate(

@@ -87,14 +87,19 @@ class SplitFuseScheduler:
             return self._desc("decode", 1, entries)
         return None
 
-    def commit(self, plan: StepPlan, sampled: dict[int, int]) -> None:
+    def commit(self, plan: StepPlan,
+               sampled: dict[int, int]) -> dict[int, list[int]]:
         """Advance sequence state after a step ran. ``sampled``: uid → token
-        for every slot that had do_sample."""
+        for every slot that had do_sample. Returns uid → tokens actually
+        ACCEPTED by each sequence's stop criteria (callers surface these,
+        never the raw samples)."""
         st = self.state
+        accepted: dict[int, list[int]] = {}
         for s, uid in enumerate(plan.uids):
             if uid < 0:
                 continue
             seq = st.seqs[uid]
             n = int(plan.active[s].sum())
-            seq.commit_generated(
+            accepted[uid] = seq.commit_generated(
                 [sampled[uid]] if plan.do_sample[s] else [], n)
+        return accepted

@@ -214,3 +214,28 @@ def test_v2_moe_ragged_generation():
         ref = np.asarray(v1.generate(np.asarray([p], np.int32),
                                      max_new_tokens=4, greedy=True))[0]
         np.testing.assert_array_equal(np.asarray(g), ref)
+
+
+def test_v2_eos_stops_early_both_decode_paths():
+    """eos_token_id ends a sequence at the eos (truncated, never past it)
+    in both the per-step path and the multi-step window path."""
+    from deepspeed_tpu.parallel.topology import MeshTopology
+
+    model = build_model("tiny-gpt2")
+    topo = MeshTopology({"tensor": 1, "data": 1})
+    rng = jax.random.PRNGKey(5)
+    outs = {}
+    for win in (1, 8):
+        eng = InferenceEngineV2(
+            model, config={"block_size": 4, "num_blocks": 64, "max_seqs": 2,
+                           "chunk": 8, "max_seq_len": 128,
+                           "decode_window": win},
+            rng=rng, topology=topo)
+        prompt = [5, 9, 2, 7, 1, 3]
+        free = eng.generate([prompt], max_new_tokens=12)[0]
+        eos = free[2]                     # token that appears mid-stream
+        got = eng.generate([prompt], max_new_tokens=12, eos_token_id=eos)[0]
+        assert got == free[:free.index(eos) + 1], (win, free, got)
+        assert got[-1] == eos and len(got) <= 12
+        outs[win] = got
+    assert outs[1] == outs[8]             # paths agree
