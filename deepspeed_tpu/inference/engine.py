@@ -126,11 +126,15 @@ class InferenceEngine:
 
             bits = self.config.quant_bits
 
+            # int4's 15-level grid needs fine scaling blocks; int8 keeps the
+            # bandwidth-friendly default
+            qblock = 128 if bits <= 4 else 2048
+
             def q(x):
                 # matrices only; tiny 1-D norm/bias vectors stay exact
                 if isinstance(x, jax.Array) and x.ndim >= 2 \
                         and jnp.issubdtype(x.dtype, jnp.floating):
-                    return quantize(x, bits=bits)
+                    return quantize(x, bits=bits, block_size=qblock)
                 return x
 
             before = sum(l.nbytes for l in jax.tree.leaves(self.params))
