@@ -105,26 +105,34 @@ class InferenceEngineV2:
         # ([1, 1, block_size, D] tiles) reads contiguous HBM.
         m = self.mcfg
         pool_tokens = cfg.num_blocks * cfg.block_size
+        tp = max(topology.size("tensor"), 1)
         kv_spec = P(None, None, "tensor", None, None) \
-            if m.kv_heads % max(topology.size("tensor"), 1) == 0 else \
+            if m.kv_heads % tp == 0 else \
             P(None, None, None, None, None)
         self._pool_sharding = NamedSharding(topology.mesh, kv_spec)
         self.kv_pool = jax.device_put(
             jnp.zeros((m.num_layers, 2, m.kv_heads, pool_tokens, m.head_dim),
                       cfg.dtype), self._pool_sharding)
 
-        # alibi needs a positional bias inside the kernel — XLA path only;
-        # pallas_call has no GSPMD rule, so multi-device meshes are out too
+        # alibi needs a positional bias inside the kernel — XLA path only.
+        # pallas_call has no GSPMD rule, so multi-device meshes run the
+        # kernel per-shard through shard_map over the tensor axis: q sharded
+        # on query heads, the pool on kv heads (the TP slicing the weights
+        # already use). Requires head counts divisible by tp and no other
+        # live mesh axes.
+        tp_ok = (topology.mesh.size == tp
+                 and m.num_heads % tp == 0 and m.kv_heads % tp == 0)
         pallas_ok = (paged_attention_usable(m.num_heads, m.kv_heads,
                                             m.head_dim, cfg.block_size)
                      and m.position_embedding != "alibi"
-                     and topology.mesh.size == 1)
+                     and (topology.mesh.size == 1 or tp_ok))
         if cfg.use_pallas_decode and not pallas_ok:
             raise ValueError(
                 "use_pallas_decode=True but the paged decode kernel does not "
                 "support this setup (needs head_dim in {64,128,256}, "
-                "block_size % 8 == 0, heads % kv_heads == 0, no alibi, "
-                "single-device mesh)")
+                "block_size % 8 == 0, heads % kv_heads == 0, no alibi, and "
+                "a mesh that is single-device or tensor-only with head "
+                "counts divisible by tp)")
         self._pallas_decode = pallas_ok if cfg.use_pallas_decode is None \
             else cfg.use_pallas_decode
 
@@ -208,9 +216,28 @@ class InferenceEngineV2:
 
             if T == 1 and self._pallas_decode:
                 # decode: Pallas kernel pages K/V straight out of the pool
-                o = paged_decode_attention(
-                    q[:, 0], kv[0], kv[1], block_tables, seq_lens,
-                    block_size=bs)[:, None]                        # [S,1,H,D]
+                mesh = self.topology.mesh
+                if mesh.size > 1:
+                    # per-shard over the tensor axis: q on query heads, the
+                    # pool on kv heads (matching the weight TP slicing)
+                    from jax import shard_map
+
+                    o = shard_map(
+                        lambda qq, kk, vv, bt, sl: paged_decode_attention(
+                            qq, kk, vv, bt, sl, block_size=bs),
+                        mesh=mesh,
+                        in_specs=(P(None, "tensor", None),
+                                  P("tensor", None, None),
+                                  P("tensor", None, None),
+                                  P(None, None), P(None)),
+                        out_specs=P(None, "tensor", None),
+                        check_vma=False,
+                    )(q[:, 0], kv[0], kv[1], block_tables,
+                      seq_lens)[:, None]
+                else:
+                    o = paged_decode_attention(
+                        q[:, 0], kv[0], kv[1], block_tables, seq_lens,
+                        block_size=bs)[:, None]                    # [S,1,H,D]
             else:
                 # prefill/mixed: gather each slot's pages. Advanced-index
                 # placement again: result is [S, ctx, KV, D] directly.
