@@ -1,0 +1,34 @@
+"""ZeRO-Offload++ example: optimizer state split between the host SIMD
+optimizer and an on-device fused update (Twin-Flow ratio).
+
+    python examples/offload_twin_flow.py
+"""
+import numpy as np
+
+import deepspeed_tpu as ds
+from deepspeed_tpu.models import build_model
+
+
+def main():
+    engine, *_ = ds.initialize(
+        model=build_model("tiny-gpt2"),
+        config={
+            "train_micro_batch_size_per_gpu": 2,
+            "optimizer": {"type": "AdamW", "params": {"lr": 1e-3}},
+            "zero_optimization": {
+                "stage": 1,
+                "offload_optimizer": {"device": "cpu", "ratio": 0.5},
+            },
+            "steps_per_print": 2,
+        },
+        topology=ds.MeshTopology({"data": 1}),
+    )
+    batch = {"input_ids": np.random.default_rng(0).integers(
+        0, 256, (2, 32)).astype(np.int32)}
+    for _ in range(6):
+        loss = engine.train_batch(batch)
+    print(f"final loss {float(loss):.4f}")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,34 @@
+"""Continuous-batching serving example (the FastGen/MII serving loop):
+paged KV pool, SplitFuse scheduling, multi-step decode windows, eos
+stopping.
+
+    python examples/serve_fastgen.py
+"""
+import numpy as np
+
+from deepspeed_tpu.inference import InferenceEngineV2
+from deepspeed_tpu.models import build_model
+
+
+def main():
+    import jax
+
+    from deepspeed_tpu.parallel.topology import MeshTopology
+
+    engine = InferenceEngineV2(
+        build_model("tiny-llama"),               # swap for llama2-7b etc.
+        config={"block_size": 16, "num_blocks": 256, "max_seqs": 4,
+                "chunk": 32, "max_seq_len": 256},
+        rng=jax.random.PRNGKey(0),
+        topology=MeshTopology({"tensor": 1, "data": 1}))
+
+    r = np.random.default_rng(0)
+    prompts = [list(map(int, r.integers(0, 256, (L,))))
+               for L in (12, 40, 7, 23)]
+    outs = engine.generate(prompts, max_new_tokens=16)
+    for p, o in zip(prompts, outs):
+        print(f"prompt[{len(p)} toks] -> generated {o}")
+
+
+if __name__ == "__main__":
+    main()
