@@ -1,0 +1,18 @@
+"""Every module in the package imports cleanly — the cheapest regression
+net for refactors (the reference's nv-pre-compile-ops CI plays this role
+for its op builders)."""
+import importlib
+import pkgutil
+
+import deepspeed_tpu
+
+
+def test_all_modules_import():
+    failures = []
+    for mod in pkgutil.walk_packages(deepspeed_tpu.__path__,
+                                     prefix="deepspeed_tpu."):
+        try:
+            importlib.import_module(mod.name)
+        except Exception as e:  # noqa: BLE001
+            failures.append((mod.name, repr(e)))
+    assert not failures, failures
