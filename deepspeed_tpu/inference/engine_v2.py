@@ -266,6 +266,8 @@ class InferenceEngineV2:
                 w = jax.nn.softmax(scores, axis=-1).astype(V.dtype)
                 o = jnp.einsum("shtc,schd->sthd", w, V)
             o = jnp.einsum("sthd,hde->ste", o, a["wo"].astype(cfg.dtype))
+            if m.attn_out_bias:
+                o = o + a["bo"].astype(cfg.dtype)
             return o, kv
 
         def layer(x, i, p, kv):                                    # kv [2,KV,P,D]

@@ -19,13 +19,16 @@ PRESETS: dict[str, ModelConfig] = {
     "gpt2-125m": ModelConfig(vocab_size=50257, hidden_size=768, num_layers=12,
                              num_heads=12, max_seq_len=1024,
                              position_embedding="learned", norm="layernorm",
+                             qkv_bias=True, attn_out_bias=True,
                              activation="gelu", tie_embeddings=True),
     "gpt2-350m": ModelConfig(vocab_size=50257, hidden_size=1024, num_layers=24,
                              num_heads=16, max_seq_len=1024,
-                             position_embedding="learned", activation="gelu"),
+                             position_embedding="learned", qkv_bias=True, attn_out_bias=True,
+                             activation="gelu"),
     "gpt2-1.3b": ModelConfig(vocab_size=50257, hidden_size=2048, num_layers=24,
                              num_heads=32, max_seq_len=1024,
-                             position_embedding="learned", activation="gelu"),
+                             position_embedding="learned", qkv_bias=True, attn_out_bias=True,
+                             activation="gelu"),
     # --- LLaMA-2 family (BASELINE.json configs 2/4) ----------------------
     "llama2-7b": ModelConfig(vocab_size=32000, hidden_size=4096, num_layers=32,
                              num_heads=32, num_kv_heads=32, intermediate_size=11008,
@@ -137,27 +140,31 @@ PRESETS: dict[str, ModelConfig] = {
                                      num_layers=12, num_heads=12,
                                      max_seq_len=512,
                                      position_embedding="learned",
-                                     activation="gelu", causal=False,
+                                     activation="gelu", qkv_bias=True, attn_out_bias=True,
+                             causal=False,
                                      pre_norm=False, dropout=0.1,
                                      type_vocab_size=2, norm_eps=1e-12),
     "bert-large-uncased": ModelConfig(vocab_size=30522, hidden_size=1024,
                                       num_layers=24, num_heads=16,
                                       max_seq_len=512,
                                       position_embedding="learned",
-                                      activation="gelu", causal=False,
+                                      activation="gelu", qkv_bias=True, attn_out_bias=True,
+                             causal=False,
                                       pre_norm=False, dropout=0.1,
                                       type_vocab_size=2, norm_eps=1e-12),
     "distilbert-base": ModelConfig(vocab_size=30522, hidden_size=768,
                                    num_layers=6, num_heads=12,
                                    max_seq_len=512,
                                    position_embedding="learned",
-                                   activation="gelu", causal=False,
+                                   activation="gelu", qkv_bias=True, attn_out_bias=True,
+                             causal=False,
                                    pre_norm=False, dropout=0.1,
                                    norm_eps=1e-12),
     # --- tiny variants for tests/debug (reference tests/unit/simple_model.py) --
     "tiny-gpt2": ModelConfig(vocab_size=256, hidden_size=64, num_layers=2,
                              num_heads=4, max_seq_len=128,
-                             position_embedding="learned", activation="gelu"),
+                             position_embedding="learned", qkv_bias=True, attn_out_bias=True,
+                             activation="gelu"),
     "tiny-llama": ModelConfig(vocab_size=256, hidden_size=64, num_layers=2,
                               num_heads=4, num_kv_heads=2, max_seq_len=128,
                               position_embedding="rope", norm="rmsnorm",
@@ -191,6 +198,7 @@ PRESETS: dict[str, ModelConfig] = {
     "tiny-bert": ModelConfig(vocab_size=256, hidden_size=64, num_layers=2,
                              num_heads=4, max_seq_len=128,
                              position_embedding="learned", activation="gelu",
+                             qkv_bias=True, attn_out_bias=True,
                              causal=False, pre_norm=False,
                              type_vocab_size=2),
     "tiny-qwen2-moe": ModelConfig(vocab_size=256, hidden_size=64,

@@ -1,0 +1,152 @@
+"""HuggingFace checkpoint import — weights land in the TransformerLM tree.
+
+The role the reference plays via module_inject (policies read HF module
+trees in place — replace_module.py:600): here checkpoints CONVERT instead
+of inject, because the TPU model is its own flax module. ``from_hf_model``
+maps a transformers model's state dict onto the equivalent preset tree;
+the numerics are exact (see tests/test_hf_import.py — logits match the
+torch forward).
+
+Conventions handled:
+- GPT-2 Conv1D stores [in, out] (no transpose needed); torch Linear stores
+  [out, in] (transposed on the way in).
+- Llama-family RoPE uses the half-split rotation (rotate_half); this
+  model's rope pairs even/odd lanes (NeoX-interleaved), so q/k projection
+  head dims are permuted half→interleaved during conversion — attention
+  outputs are invariant under the shared permutation.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+from . import PRESETS
+from .transformer import ModelConfig, TransformerLM
+
+
+def _interleave_perm(d: int) -> np.ndarray:
+    """half-split [0..d/2, d/2..d] pairs → even/odd interleaved pairs."""
+    perm = np.empty(d, np.int64)
+    perm[0::2] = np.arange(d // 2)
+    perm[1::2] = np.arange(d // 2) + d // 2
+    return perm
+
+
+def _gpt2_tree(sd: dict, cfg: ModelConfig) -> dict:
+    E, H, D = cfg.hidden_size, cfg.num_heads, cfg.head_dim
+    t = {"embed": sd["transformer.wte.weight"],
+         "pos_embed": sd["transformer.wpe.weight"],
+         "ln_final": {"scale": sd["transformer.ln_f.weight"],
+                      "bias": sd["transformer.ln_f.bias"]}}
+    for i in range(cfg.num_layers):
+        p = f"transformer.h.{i}."
+        w_qkv = sd[p + "attn.c_attn.weight"]          # Conv1D [E, 3E]
+        b_qkv = sd[p + "attn.c_attn.bias"]
+        wq, wk, wv = np.split(w_qkv, 3, axis=1)
+        bq, bk, bv = np.split(b_qkv, 3)
+        t[f"layer_{i}"] = {
+            "ln_attn": {"scale": sd[p + "ln_1.weight"],
+                        "bias": sd[p + "ln_1.bias"]},
+            "attn": {
+                "wq": wq.reshape(E, H, D), "wk": wk.reshape(E, H, D),
+                "wv": wv.reshape(E, H, D),
+                "bq": bq.reshape(H, D), "bk": bk.reshape(H, D),
+                "bv": bv.reshape(H, D),
+                "wo": sd[p + "attn.c_proj.weight"].reshape(H, D, E),
+                "bo": sd[p + "attn.c_proj.bias"],
+            },
+            "ln_ffn": {"scale": sd[p + "ln_2.weight"],
+                       "bias": sd[p + "ln_2.bias"]},
+            "ffn": {"w_up": sd[p + "mlp.c_fc.weight"],
+                    "b_up": sd[p + "mlp.c_fc.bias"],
+                    "w_down": sd[p + "mlp.c_proj.weight"],
+                    "b_down": sd[p + "mlp.c_proj.bias"]},
+        }
+    return t
+
+
+def _llama_tree(sd: dict, cfg: ModelConfig) -> dict:
+    E, H, KV, D = (cfg.hidden_size, cfg.num_heads, cfg.kv_heads,
+                   cfg.head_dim)
+    perm = _interleave_perm(D)
+    t = {"embed": sd["model.embed_tokens.weight"],
+         "ln_final": {"scale": sd["model.norm.weight"]}}
+    if not cfg.tie_embeddings:       # tied checkpoints never read unembed
+        t["unembed"] = sd["lm_head.weight"].T
+    for i in range(cfg.num_layers):
+        p = f"model.layers.{i}."
+        wq = sd[p + "self_attn.q_proj.weight"].T.reshape(E, H, D)[:, :, perm]
+        wk = sd[p + "self_attn.k_proj.weight"].T.reshape(E, KV, D)[:, :, perm]
+        t[f"layer_{i}"] = {
+            "ln_attn": {"scale": sd[p + "input_layernorm.weight"]},
+            "attn": {
+                "wq": wq, "wk": wk,
+                "wv": sd[p + "self_attn.v_proj.weight"].T.reshape(E, KV, D),
+                "wo": sd[p + "self_attn.o_proj.weight"].T.reshape(H, D, E),
+            },
+            "ln_ffn": {"scale": sd[p + "post_attention_layernorm.weight"]},
+            "ffn": {"w_gate": sd[p + "mlp.gate_proj.weight"].T,
+                    "w_up": sd[p + "mlp.up_proj.weight"].T,
+                    "w_down": sd[p + "mlp.down_proj.weight"].T},
+        }
+    return t
+
+
+_CONVERTERS = {"gpt2": _gpt2_tree, "llama": _llama_tree,
+               "mistral": _llama_tree}
+
+
+def config_from_hf(hf_config) -> ModelConfig:
+    """Map a transformers config onto a ModelConfig for supported archs."""
+    import dataclasses
+
+    mt = hf_config.model_type
+    if mt == "gpt2":
+        return dataclasses.replace(
+            PRESETS["gpt2-125m"],
+            vocab_size=hf_config.vocab_size,
+            hidden_size=hf_config.n_embd, num_layers=hf_config.n_layer,
+            num_heads=hf_config.n_head, max_seq_len=hf_config.n_positions,
+            norm_eps=hf_config.layer_norm_epsilon)
+    if mt in ("llama", "mistral"):
+        sw = getattr(hf_config, "sliding_window", None)
+        if sw is not None and sw < hf_config.max_position_embeddings:
+            raise NotImplementedError(
+                f"sliding_window={sw} attention is not implemented; "
+                f"converted logits would diverge past the window")
+        return dataclasses.replace(
+            PRESETS["llama2-7b"],
+            vocab_size=hf_config.vocab_size,
+            hidden_size=hf_config.hidden_size,
+            num_layers=hf_config.num_hidden_layers,
+            num_heads=hf_config.num_attention_heads,
+            num_kv_heads=hf_config.num_key_value_heads,
+            intermediate_size=hf_config.intermediate_size,
+            max_seq_len=hf_config.max_position_embeddings,
+            rope_theta=float(getattr(hf_config, "rope_theta", 10000.0)),
+            norm_eps=hf_config.rms_norm_eps,
+            tie_embeddings=bool(getattr(hf_config, "tie_word_embeddings",
+                                        False)))
+    raise NotImplementedError(
+        f"no converter for HF model_type '{mt}' (have: "
+        f"{sorted(_CONVERTERS)})")
+
+
+def from_hf_model(hf_model, dtype=None) -> tuple[TransformerLM, dict]:
+    """(TransformerLM, params) from a loaded transformers model (e.g.
+    ``GPT2LMHeadModel.from_pretrained(...)``)."""
+    import dataclasses
+
+    import jax.numpy as jnp
+
+    cfg = config_from_hf(hf_model.config)
+    if dtype is not None:
+        cfg = dataclasses.replace(cfg, dtype=dtype)
+    sd = {k: v.detach().cpu().numpy() for k, v in
+          hf_model.state_dict().items()}
+    tree = _CONVERTERS[hf_model.config.model_type](sd, cfg)
+
+    def to_jnp(x):
+        return {k: to_jnp(v) for k, v in x.items()} \
+            if isinstance(x, dict) else jnp.asarray(x)
+
+    return TransformerLM(cfg), to_jnp(tree)

@@ -100,6 +100,7 @@ class ModelConfig:
     norm_eps: float = 1e-5
     activation: str = "gelu"                 # gelu | relu | silu_glu (SwiGLU)
     qkv_bias: bool = False                   # qwen-style projection biases
+    attn_out_bias: bool = False              # gpt2/bert-style out-proj bias
     parallel_block: bool = False             # falcon/gpt-j/phi: attn ∥ ffn
     parallel_block_norms: int = 1            # 2 = separate ln for ffn branch
                                              # (gpt-neox, falcon-40b)
@@ -151,6 +152,8 @@ class ModelConfig:
         if self.qkv_bias:
             attn += self.num_heads * self.head_dim \
                 + 2 * self.kv_heads * self.head_dim
+        if self.attn_out_bias:
+            attn += h
         per_norm = h if self.norm == "rmsnorm" else 2 * h
         # pre-norm: 2 per layer + ln_final; post-norm: 2 per layer + ln_embed
         norms = (2 * L + 1) * per_norm
@@ -263,6 +266,11 @@ class Attention(nn.Module):
         wo = self.param("wo", nn.with_partitioning(_dense_init(), ("heads", "head_dim", "embed")),
                         (H, D, cfg.hidden_size), jnp.float32)
 
+        bo = None
+        if cfg.attn_out_bias:
+            bo = self.param("bo", nn.with_partitioning(
+                nn.initializers.zeros, ("embed",)),
+                (cfg.hidden_size,), jnp.float32)
         q = jnp.einsum("bse,ehd->bshd", x, wq.astype(cfg.dtype))
         k = jnp.einsum("bse,ehd->bshd", x, wk.astype(cfg.dtype))
         v = jnp.einsum("bse,ehd->bshd", x, wv.astype(cfg.dtype))
@@ -316,6 +324,8 @@ class Attention(nn.Module):
         # back to seq-sharded, heads full
         out = constrain(out, BATCH, SEQ, None, None)
         out = jnp.einsum("bshd,hde->bse", out, wo.astype(cfg.dtype))
+        if bo is not None:
+            out = out + bo.astype(cfg.dtype)
         out = constrain(out, BATCH, SEQ, EMBED)
         if new_cache is not None:
             return out, new_cache

@@ -1,5 +1,9 @@
 """Checkpoint tests: save/resume + universal reshard-on-load
 (contract of reference tests/unit/checkpoint/ suite)."""
+import pytest
+
+pytestmark = pytest.mark.slow  # multi-minute: many engine jit compiles
+
 import numpy as np
 import pytest
 

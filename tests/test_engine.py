@@ -1,5 +1,9 @@
 """Engine tests — the contract of reference runtime/engine.py + ZeRO stack
 (tests/unit/runtime/zero/test_zero.py analogue, virtual 8-device mesh)."""
+import pytest
+
+pytestmark = pytest.mark.slow  # multi-minute: many engine jit compiles
+
 import jax
 import jax.numpy as jnp
 import numpy as np

@@ -1,6 +1,10 @@
 """Model family tests (reference tests/unit/inference/test_inference.py model
 matrix + module_inject containers): every supported architecture trains and
 generates."""
+import pytest
+
+pytestmark = pytest.mark.slow  # multi-minute: many engine jit compiles
+
 import jax
 import jax.numpy as jnp
 import numpy as np

@@ -66,6 +66,7 @@ def _free_port() -> str:
         return str(sock.getsockname()[1])
 
 
+@pytest.mark.multiprocess
 @pytest.mark.skipif(os.environ.get("DS_TPU_TEST_REAL_DEVICES") == "1",
                     reason="multi-process CPU rendezvous only")
 def test_two_process_init_distributed_and_collectives():

@@ -1,6 +1,10 @@
 """Host/NVMe optimizer offload + native ops tests (reference
 tests/unit/ops/adam/test_cpu_adam.py, tests/unit/ops/aio/test_aio.py,
 tests/unit/runtime/zero/test_zero_offloadpp.py analogues)."""
+import pytest
+
+pytestmark = pytest.mark.slow  # multi-minute: many engine jit compiles
+
 import numpy as np
 import pytest
 
