@@ -398,7 +398,7 @@ class InferenceEngineV2:
             new = s.commit_generated([int(t) for t in toks[:, s.slot]], W)
             if new:
                 self._results[s.uid].extend(new)
-                sampled[s.uid] = new[-1]
+                sampled[s.uid] = new
         return sampled
 
     # ------------------------------------------------------------------
@@ -440,10 +440,11 @@ class InferenceEngineV2:
             self.state.release(uid)
         return self._results.pop(uid, [])
 
-    def step(self) -> dict[int, int]:
-        """Run one scheduled forward step; returns {uid: sampled_token} for
-        sequences that produced a token (the last of the window when the
-        multi-step decode path runs). Empty dict = nothing to do."""
+    def step(self) -> dict[int, list[int]]:
+        """Run one scheduled forward step; returns {uid: accepted_tokens}
+        with EVERY token the step produced for that uid (multi-step decode
+        windows emit several) — callers can stream from the return value
+        without losing intra-window tokens. Empty dict = nothing to do."""
         windowed = self._try_decode_window()
         if windowed is not None:
             return windowed
@@ -466,7 +467,7 @@ class InferenceEngineV2:
         for uid, new in accepted.items():   # stop criteria may drop tokens
             if new:
                 self._results[uid].extend(new)
-                emitted[uid] = new[-1]
+                emitted[uid] = new
         return emitted
 
     def generate(self, prompts: list[list[int]], max_new_tokens: int = 32,

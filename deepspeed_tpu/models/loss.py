@@ -20,6 +20,11 @@ IGNORE_INDEX = -100
 #: [chunk, V] instead of [B*S, V] — an OOM escape hatch for huge-vocab /
 #: long-seq configs. Measured ~4% slower end-to-end on v5e (the scan
 #: serializes against XLA's overlap), so it is opt-in, not the default.
+#: Settable via the DS_TPU_CE_CHUNK env var (re-read at every trace, so
+#: setting it after import works and it always wins) or programmatically
+#: via this module attribute (used when the env var is unset). Either way
+#: the value is captured at TRACE time: changing it affects newly traced
+#: programs only — JAX caches compiled train steps.
 CE_CHUNK = int(os.environ.get("DS_TPU_CE_CHUNK", "0"))
 
 
@@ -28,7 +33,9 @@ def _nll_logz(logits2d: jax.Array, labels1d: jax.Array, chunk: int):
     """Per-token (nll, logz) in fp32 from [N, V] bf16 logits, streamed in
     [chunk, V] pieces so the full fp32 logits (and, in the backward, the
     full fp32 dlogits) are never materialized — the role of the reference's
-    fused softmax-cross-entropy kernels. Masked rows (label < 0) get 0."""
+    fused softmax-cross-entropy kernels. Masked rows (label < 0) get 0.
+    A non-divisible tail (N % chunk rows) runs as one short static slice,
+    so the chunk never degrades and no padded copy of the logits is made."""
     (nll, logz), _ = _nll_logz_fwd(logits2d, labels1d, chunk)
     return nll, logz
 
@@ -37,45 +44,60 @@ def _chunk_starts(N: int, chunk: int) -> jax.Array:
     return jnp.arange(0, N, chunk, dtype=jnp.int32)
 
 
+def _fwd_piece(lg, lb):
+    l32 = lg.astype(jnp.float32)
+    mask = lb >= 0
+    lz = jax.nn.logsumexp(l32, axis=-1)
+    true = jnp.take_along_axis(l32, jnp.where(mask, lb, 0)[:, None],
+                               axis=-1)[:, 0]
+    return (lz - true) * mask, lz * mask
+
+
 def _nll_logz_fwd(logits2d, labels1d, chunk):
     N, V = logits2d.shape
+    Nm = (N // chunk) * chunk                    # bulk, tail handled apart
 
     def body(_, start):
-        l32 = jax.lax.dynamic_slice_in_dim(logits2d, start, chunk
-                                           ).astype(jnp.float32)
+        lg = jax.lax.dynamic_slice_in_dim(logits2d, start, chunk)
         lb = jax.lax.dynamic_slice_in_dim(labels1d, start, chunk)
-        mask = lb >= 0
-        lz = jax.nn.logsumexp(l32, axis=-1)
-        true = jnp.take_along_axis(l32, jnp.where(mask, lb, 0)[:, None],
-                                   axis=-1)[:, 0]
-        return None, ((lz - true) * mask, lz * mask)
+        return None, _fwd_piece(lg, lb)
 
-    _, (nll, logz) = jax.lax.scan(body, None, _chunk_starts(N, chunk))
-    out = (nll.reshape(N), logz.reshape(N))
-    return out, (logits2d, labels1d)
+    _, (nll, logz) = jax.lax.scan(body, None, _chunk_starts(Nm, chunk))
+    nll, logz = nll.reshape(Nm), logz.reshape(Nm)
+    if Nm != N:
+        tn, tz = _fwd_piece(logits2d[Nm:], labels1d[Nm:])
+        nll = jnp.concatenate([nll, tn])
+        logz = jnp.concatenate([logz, tz])
+    return (nll, logz), (logits2d, labels1d)
+
+
+def _bwd_piece(lg, lb, gn, gz, V):
+    l32 = lg.astype(jnp.float32)
+    mask = lb >= 0
+    p = jax.nn.softmax(l32, axis=-1)
+    d = p * ((gn + gz) * mask)[:, None]
+    onehot = jax.nn.one_hot(jnp.where(mask, lb, 0), V, dtype=jnp.float32)
+    return (d - onehot * (gn * mask)[:, None]).astype(lg.dtype)
 
 
 def _nll_logz_bwd(chunk, res, grads):
     logits2d, labels1d = res
     dnll, dlogz = grads                                   # [N] fp32 each
     N, V = logits2d.shape
+    Nm = (N // chunk) * chunk
 
     def body(_, start):
-        l32 = jax.lax.dynamic_slice_in_dim(logits2d, start, chunk
-                                           ).astype(jnp.float32)
-        lb = jax.lax.dynamic_slice_in_dim(labels1d, start, chunk)
-        gn = jax.lax.dynamic_slice_in_dim(dnll, start, chunk)
-        gz = jax.lax.dynamic_slice_in_dim(dlogz, start, chunk)
-        mask = lb >= 0
-        p = jax.nn.softmax(l32, axis=-1)
-        coeff = ((gn + gz) * mask)[:, None]
-        d = p * coeff
-        onehot = jax.nn.one_hot(jnp.where(mask, lb, 0), V, dtype=jnp.float32)
-        d = d - onehot * (gn * mask)[:, None]
-        return None, d.astype(logits2d.dtype)
+        sl = lambda a: jax.lax.dynamic_slice_in_dim(a, start, chunk)
+        return None, _bwd_piece(sl(logits2d), sl(labels1d), sl(dnll),
+                                sl(dlogz), V)
 
-    _, dchunks = jax.lax.scan(body, None, _chunk_starts(N, chunk))
-    return dchunks.reshape(N, V), None
+    _, dchunks = jax.lax.scan(body, None, _chunk_starts(Nm, chunk))
+    d = dchunks.reshape(Nm, V)
+    if Nm != N:
+        tail = _bwd_piece(logits2d[Nm:], labels1d[Nm:], dnll[Nm:],
+                          dlogz[Nm:], V)
+        d = jnp.concatenate([d, tail])
+    return d, None
 
 
 _nll_logz.defvjp(_nll_logz_fwd, _nll_logz_bwd)
@@ -92,9 +114,10 @@ def cross_entropy_lm(logits: jax.Array, labels: jax.Array,
     N = math.prod(logits.shape[:-1])
     mask = (labels != ignore_index)
     denom = jnp.maximum(jnp.sum(mask), 1)
-    if CE_CHUNK:
-        # honor the opt-in for any N: largest divisor of N <= CE_CHUNK
-        chunk = next(c for c in range(min(CE_CHUNK, N), 0, -1) if N % c == 0)
+    env = os.environ.get("DS_TPU_CE_CHUNK")
+    ce_chunk = int(env) if env is not None else CE_CHUNK
+    if ce_chunk:
+        chunk = min(ce_chunk, N)
         lab = jnp.where(mask, labels, -1).reshape(N)
         nll, logz = _nll_logz(logits.reshape(N, V), lab, chunk)
         loss = jnp.sum(nll) / denom

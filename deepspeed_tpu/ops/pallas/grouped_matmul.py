@@ -19,8 +19,10 @@ the TPU pipeline model:
   back, so no masking is needed in the kernel.
 
 ``grouped_matmul`` is differentiable: dx is the same kernel contracting
-the other weight axis (``transpose_rhs``); dw is a per-tile outer product
-+ segment-sum over tiles (XLA handles that shape well — no custom kernel).
+the other weight axis (``transpose_rhs``); dw is a second Pallas kernel
+that accumulates x_tile^T @ dy_tile into the owning expert's [E, F] block
+(token tiles innermost, so each expert's accumulation is a consecutive
+grid run) — no [n_tiles, E, F] transient is ever materialized.
 """
 from __future__ import annotations
 
@@ -125,21 +127,68 @@ def _gmm_fwd(x, w, tile_expert, block_m, block_n, block_k, interpret):
     return out, (x, w, tile_expert)
 
 
+def _dw_kernel(te_ref, x_ref, dy_ref, o_ref, acc):
+    t = pl.program_id(2)
+    nt = pl.num_programs(2)
+    te = te_ref[t]
+
+    # first/last tile of this expert's consecutive run (tile_expert is
+    # nondecreasing, so each output block's visits are contiguous in t)
+    @pl.when((t == 0) | (te != te_ref[jnp.maximum(t - 1, 0)]))
+    def _init():
+        acc[:] = jnp.zeros_like(acc)
+
+    acc[:] += jax.lax.dot_general(x_ref[...], dy_ref[...],
+                                  (((0,), (0,)), ((), ())),
+                                  preferred_element_type=jnp.float32)
+
+    @pl.when((t == nt - 1) | (te != te_ref[jnp.minimum(t + 1, nt - 1)]))
+    def _finalize():
+        o_ref[0] = acc[:].astype(o_ref.dtype)
+
+
+def _dw_call(x, dy, tile_expert, n_exp: int, *, block_m: int,
+             interpret: bool | None):
+    """dw[e] = sum_{tiles of e} x_tile^T @ dy_tile, accumulated in VMEM.
+    Peak transient is one [block_e, block_f] fp32 block per grid step —
+    the [n_tiles, E, F] outer-product tensor of the naive formulation
+    (multi-GB at 64k routed rows) never exists."""
+    Tp, E = x.shape
+    F = dy.shape[1]
+    be = _pick(E, 512)
+    bf = _pick(F, 512)
+    if interpret is None:
+        interpret = jax.default_backend() != "tpu"
+    grid = (E // be, F // bf, Tp // block_m)
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=1,
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((block_m, be), lambda e, f, t, te: (t, e)),
+            pl.BlockSpec((block_m, bf), lambda e, f, t, te: (t, f)),
+        ],
+        out_specs=pl.BlockSpec((1, be, bf), lambda e, f, t, te: (te[t], e, f)),
+        scratch_shapes=[pltpu.VMEM((be, bf), jnp.float32)],
+    )
+    dw = pl.pallas_call(
+        _dw_kernel,
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((n_exp, E, F), jnp.float32),
+        interpret=interpret,
+    )(tile_expert.astype(jnp.int32), x, dy)
+    # experts that own no tiles were never written — mask their garbage
+    has = jnp.zeros((n_exp,), bool).at[tile_expert].set(True)
+    return jnp.where(has[:, None, None], dw, 0.0)
+
+
 def _gmm_bwd(block_m, block_n, block_k, interpret, res, dy):
     x, w, tile_expert = res
     n_exp = w.shape[0]
     # dx[t] = dy[t] @ w[e_t]^T — same kernel, contracting w's F axis
     dx = _gmm_call(dy, w, tile_expert, block_m=block_m, transpose_rhs=True,
                    block_n=block_n, block_k=block_k, interpret=interpret)
-    # dw[e] = sum_{tiles of e} x_tile^T @ dy_tile — per-tile outer products
-    # then a tile→expert segment sum; batched-matmul-friendly for XLA.
-    bm = block_m
-    xt = x.reshape(-1, bm, x.shape[1])               # [nt, bm, E]
-    dyt = dy.reshape(-1, bm, dy.shape[1])            # [nt, bm, F]
-    per_tile = jnp.einsum("tme,tmf->tef", xt.astype(jnp.float32),
-                          dyt.astype(jnp.float32))
-    dw = jax.ops.segment_sum(per_tile, tile_expert.astype(jnp.int32),
-                             num_segments=n_exp).astype(w.dtype)
+    dw = _dw_call(x, dy, tile_expert, n_exp, block_m=block_m,
+                  interpret=interpret).astype(w.dtype)
     return dx.astype(x.dtype), dw, None
 
 

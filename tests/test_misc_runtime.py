@@ -208,12 +208,20 @@ def test_chunked_cross_entropy_matches_dense():
         d_val, d_grad = float(f(logits)), np.asarray(jax.grad(f)(logits))
         assert abs(c_val - d_val) < 1e-5
         np.testing.assert_allclose(c_grad, d_grad, atol=1e-6)
-        # non-divisible N (2*8=16 with chunk 5 → largest divisor 4) still
-        # routes through the chunked path
+        # non-divisible N (2*8=16 with chunk 5): 3 full chunks via scan plus
+        # a 1-row static tail — full chunk size kept, no padded logits copy
         L.CE_CHUNK = 5
         f = fresh()
         assert "scan" in str(jax.make_jaxpr(f)(logits))
         assert abs(float(f(logits)) - d_val) < 1e-5
+        np.testing.assert_allclose(np.asarray(jax.grad(f)(logits)),
+                                   d_grad, atol=1e-6)
+        # chunk=7: a divisor search would have degraded to chunk=1
+        L.CE_CHUNK = 7
+        f = fresh()
+        assert abs(float(f(logits)) - d_val) < 1e-5
+        np.testing.assert_allclose(np.asarray(jax.grad(f)(logits)),
+                                   d_grad, atol=1e-6)
     finally:
         L.CE_CHUNK = old
 
