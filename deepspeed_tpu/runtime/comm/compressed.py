@@ -113,6 +113,31 @@ def reduce_scatter_coalesced(tensors: list[jax.Array], axis_name: str,
     return out
 
 
+def quant_reduce_scatter_dim(t: jax.Array, axis_name: str, dim: int,
+                             bits: int = 8, block_size: int = 512,
+                             op: str = "mean") -> jax.Array:
+    """qgZ reduce-scatter along a TENSOR dim: each member keeps its shard
+    of ``dim`` (size / axis members) of the reduced tensor, with int8/int4
+    transport. This is the engine-facing form of
+    :func:`all_to_all_quant_reduce` — the slab layout matches the ZeRO
+    planner's dim-sharded gradient shardings, so the result IS the
+    member's gradient partition (reference coalesced_collectives.py:31,
+    where each rank likewise receives its flat grad partition)."""
+    k = lax.axis_size(axis_name)
+    if t.shape[dim] % k:
+        raise ValueError(f"dim {dim} of {t.shape} not divisible by "
+                         f"axis '{axis_name}'={k}")
+    moved = jnp.moveaxis(t.astype(jnp.float32), dim, 0)
+    slabs = moved.reshape(k, -1)                     # row g = member g's slab
+    m = slabs.shape[1]
+    mp = m + (-m) % block_size                       # per-slab pad keeps the
+    slabs = jnp.pad(slabs, ((0, 0), (0, mp - m)))    # k chunks block-aligned
+    red = all_to_all_quant_reduce(slabs.reshape(-1), axis_name, bits=bits,
+                                  block_size=block_size, op=op)
+    slab = red[:m].reshape((moved.shape[0] // k,) + moved.shape[1:])
+    return jnp.moveaxis(slab, 0, dim)
+
+
 # ---------------------------------------------------------------------------
 # qwZ: quantized weight all-gather
 # ---------------------------------------------------------------------------
@@ -134,6 +159,18 @@ def quantized_all_gather(x: Any, axis_name: str, bits: int = 8,
         full = dequantize(rq).reshape(k, -1)[:, :n]
         return full.reshape((k * shard_shape[0],) + shard_shape[1:]).astype(t.dtype)
     return jax.tree.map(_leaf, x)
+
+
+def quantized_all_gather_dim(t: jax.Array, axis_name: str, dim: int,
+                             bits: int = 8,
+                             block_size: int = 512) -> jax.Array:
+    """qwZ all-gather along a TENSOR dim: rebuild the full parameter from
+    per-member shards of ``dim`` with quantized transport (stage3.py:156's
+    int8 weight all-gather, in the planner's dim-sharded layout)."""
+    moved = jnp.moveaxis(t, dim, 0)
+    full = quantized_all_gather(moved, axis_name, bits=bits,
+                                block_size=block_size)
+    return jnp.moveaxis(full, 0, dim)
 
 
 # ---------------------------------------------------------------------------
