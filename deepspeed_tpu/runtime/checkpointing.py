@@ -108,6 +108,12 @@ def save_checkpoint(engine, save_dir: str, tag: str | None = None,
         # interchangeable (universal-resume across offload modes)
         tree.update(_offload_state_as_tree(
             engine, snapshot=engine.config.checkpoint.async_save))
+    if getattr(engine, "_param_stream", None) is not None:
+        # ZeRO-Infinity: state.params is a live view (cpu) or placeholder
+        # (nvme) — serialize a fresh host copy; snapshot under async saves
+        # so background serialization never races the in-place refresh
+        tree["params"] = engine._param_stream.host_params_tree(
+            snapshot=engine.config.checkpoint.async_save)
     tree = {k: v for k, v in tree.items() if v is not None}
 
     async_save = engine.config.checkpoint.async_save
@@ -333,11 +339,17 @@ def _load_checkpoint_offload(engine, path: str) -> dict:
         "opt_step": np.zeros((), np.int32),
         "global_step": state.global_step,
     }
-    restore_args = {
-        "params": jax.tree.map(
+    if getattr(engine, "_param_stream", None) is not None:
+        # ZeRO-Infinity params are host numpy — restore without a device hop
+        params_args = jax.tree.map(
+            lambda x: ocp.RestoreArgs(restore_type=np.ndarray), state.params)
+    else:
+        params_args = jax.tree.map(
             lambda x, s: ocp.ArrayRestoreArgs(sharding=s, global_shape=x.shape,
                                               dtype=x.dtype),
-            state.params, shardings.params),
+            state.params, shardings.params)
+    restore_args = {
+        "params": params_args,
         "opt_step": ocp.RestoreArgs(restore_type=np.ndarray),
         "global_step": ocp.ArrayRestoreArgs(
             sharding=shardings.global_step,
@@ -367,6 +379,11 @@ def _load_checkpoint_offload(engine, path: str) -> dict:
         by_key(restored["opt_mu"]) if "opt_mu" in restored else None,
         by_key(restored["opt_nu"]) if "opt_nu" in restored else None,
         step)
+    if getattr(engine, "_param_stream", None) is not None:
+        # rebuild the stream cache (and NVMe spill) from the restored
+        # params; state.params re-points at the fresh live view below
+        engine._param_stream.init_from_master(restored["params"])
+        restored["params"] = engine._param_stream.params_view()
     engine.state = state._replace(
         params=restored["params"],
         opt_state=state.opt_state._replace(

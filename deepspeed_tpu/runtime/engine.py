@@ -30,6 +30,7 @@ from typing import Any, Callable, NamedTuple
 import flax.linen as nn
 import jax
 import jax.numpy as jnp
+import numpy as np
 from jax.sharding import NamedSharding, PartitionSpec as P
 
 from ..config import Config
@@ -120,6 +121,7 @@ class DeepSpeedEngine:
 
         _ac_mod.configure(ac)
 
+        self._custom_loss_fn = loss_fn is not None
         if loss_fn is None:
             if model is None:
                 raise ValueError("need a model or a loss_fn")
@@ -206,6 +208,43 @@ class DeepSpeedEngine:
                 else jnp.float32)
         elif off.device not in ("none",):
             raise ValueError(f"offload_optimizer.device '{off.device}' "
+                             f"unsupported (none|cpu|nvme)")
+
+        # ZeRO-Infinity parameter offload: host-resident params streamed
+        # layer-by-layer (reference swap_tensor/partitioned_param_swapper.py:37)
+        self._param_stream = None
+        poff = config.zero_optimization.offload_param
+        if poff.device in ("cpu", "nvme"):
+            if self._offload_opt is None:
+                raise ValueError(
+                    "offload_param requires offload_optimizer (cpu|nvme): "
+                    "streamed params update on the host master")
+            if self._offload_opt.ratio != 1.0:
+                raise ValueError(
+                    "offload_param requires offload_optimizer.ratio == 1.0 "
+                    "(a Twin-Flow device share would keep streamed params "
+                    "resident)")
+            if poff.device == "nvme" and self._offload_opt.device != "nvme":
+                raise ValueError("offload_param.device='nvme' requires "
+                                 "offload_optimizer.device='nvme' (shared "
+                                 "async-I/O engine)")
+            if self._custom_loss_fn or model is None:
+                raise ValueError(
+                    "offload_param drives the model layer-by-layer — pass "
+                    "model= (a TransformerLM) without a custom loss_fn")
+            bad = [a for a in ("tensor", "seq", "pipe", "expert")
+                   if self.topology.size(a) > 1]
+            if bad:
+                raise ValueError(f"offload_param streaming needs a pure DP "
+                                 f"mesh (fsdp x data); axes {bad} have "
+                                 f"size > 1")
+            from .zero.infinity import LayerStreamTrainer
+
+            self._param_stream = LayerStreamTrainer(
+                model, config, self.topology, self._offload_opt,
+                self.compute_dtype if self.mixed_precision else jnp.float32)
+        elif poff.device not in ("none",):
+            raise ValueError(f"offload_param.device '{poff.device}' "
                              f"unsupported (none|cpu|nvme)")
 
         self._validate_zeropp()
@@ -324,6 +363,13 @@ class DeepSpeedEngine:
         master_shardings = self.plan.master_shardings
         param_shardings = self.plan.param_shardings
 
+        if self._param_stream is not None:
+            # ZeRO-Infinity: init on the HOST CPU backend — the full master
+            # never touches HBM (the zero.Init analogue for a model that
+            # doesn't fit it)
+            self._init_state_param_stream(params, init_input, rng)
+            return
+
         if params is None:
             # init directly into the sharded layout — no full replica ever
             # materializes (the role of zero.Init, partition_parameters.py:808)
@@ -383,6 +429,41 @@ class DeepSpeedEngine:
                 lambda _: NamedSharding(topo.mesh, P()), scaler),
             global_step=NamedSharding(topo.mesh, P()),
         )
+
+    def _init_state_param_stream(self, params, init_input, rng):
+        """ZeRO-Infinity state bring-up: master initializes on the host CPU
+        backend, moves into the host optimizer + bf16 stream cache, and
+        ``state.params`` becomes the host-resident numpy tree (checkpoints
+        serialize it like any pytree; no jitted program ever receives it)."""
+        topo = self.topology
+        if params is None:
+            try:
+                cpu0 = jax.devices("cpu")[0]
+            except RuntimeError:
+                cpu0 = None
+            ctx = jax.default_device(cpu0) if cpu0 is not None else \
+                jax.transfer_guard("allow")
+            with ctx:
+                master0 = jax.jit(lambda r: _cast_tree(
+                    unbox_params(self.model.init(r, init_input)["params"]),
+                    jnp.float32))(rng)
+        else:
+            master0 = _cast_tree(unbox_params(params), jnp.float32)
+        master_np = jax.tree.map(lambda a: np.asarray(a), master0)
+        del master0
+        self._offload_opt.init_from_master(master_np)
+        self._param_stream.init_from_master(master_np)
+        del master_np
+        self.state = TrainState(
+            params=self._param_stream.params_view(), master=None,
+            opt_state=OptState(step=jnp.zeros((), jnp.int32), mu=None,
+                               nu=None),
+            scaler=None, global_step=jnp.zeros((), jnp.int32))
+        self._state_shardings = TrainState(
+            params=None, master=None,
+            opt_state=OptState(step=NamedSharding(topo.mesh, P()), mu=None,
+                               nu=None),
+            scaler=None, global_step=NamedSharding(topo.mesh, P()))
 
     def _wrap_opt_init(self, opt_shardings):
         """1-bit error feedback is per-DP-member state. When the compressed
@@ -506,6 +587,13 @@ class DeepSpeedEngine:
     def _build_programs(self):
         cfg = self.config
         topo = self.topology
+        if self._param_stream is not None:
+            # ZeRO-Infinity: the layer streamer owns all device programs;
+            # no whole-model jitted step may exist (it would pull the full
+            # params into HBM)
+            self._train_step = self._apply_step = self._eval_step = None
+            self._grad_step = self._accum_fn = None
+            return
         gas = cfg.gradient_accumulation_steps
         ss = self._state_shardings
         repl = NamedSharding(topo.mesh, P())
@@ -923,12 +1011,48 @@ class DeepSpeedEngine:
         return jax.tree.map(reshape, batch)
 
     # ------------------------------------------------------------------
+    # ZeRO-Infinity streamed step
+    def _train_batch_streamed(self, batch: dict) -> jax.Array:
+        ps = self._param_stream
+        gas = self.config.gradient_accumulation_steps
+        B = self.config.train_batch_size
+
+        def resh(x):
+            x = np.asarray(x)
+            assert x.shape[0] == B, (
+                f"train_batch expects global batch dim {B}, got {x.shape[0]}")
+            return x.reshape((gas, x.shape[0] // gas) + x.shape[1:])
+
+        hb = jax.tree.map(resh, batch)
+        losses = [ps.micro_fwd_bwd(jax.tree.map(lambda x: x[g], hb))
+                  for g in range(gas)]
+        lr = float(self.lr_schedule(self.state.opt_state.step))
+        ps.apply_grads(gas, lr, self.config.gradient_clipping or None)
+        # state.params is a LIVE view of the cpu cache (refreshed in place)
+        # or an NVMe placeholder — never rebuilt per step
+        self.state = self.state._replace(
+            opt_state=self.state.opt_state._replace(
+                step=self.state.opt_state.step + 1),
+            global_step=self.state.global_step + 1)
+        return jnp.mean(jnp.stack(losses))
+
+    # ------------------------------------------------------------------
     # public API
     def train_batch(self, batch: dict) -> jax.Array:
         """Run one full training step over a global batch
         (shape [train_batch_size, ...] per leaf)."""
         self.tput_timer.start()
         self.timers(TRAIN_BATCH_TIMER).start()
+        if self._param_stream is not None:
+            batch = self._apply_curriculum(batch)
+            loss = self._train_batch_streamed(batch)
+            self.global_steps += 1
+            self.timers(TRAIN_BATCH_TIMER).stop(sync_val=loss)
+            self.tput_timer.stop(sync_val=loss)
+            if self.global_steps % self.config.steps_per_print == 0:
+                log_dist(f"step={self.global_steps} loss={float(loss):.4f}")
+            self._last_loss = loss
+            return loss
         batch = self._apply_curriculum(batch)
         batch = self._shard_batch(self._reshape_for_gas(batch), with_gas_dim=True)
         profile_target = self._train_step if self._offload_opt is None \
@@ -961,6 +1085,10 @@ class DeepSpeedEngine:
         return loss
 
     def eval_batch(self, batch: dict) -> jax.Array:
+        if self._param_stream is not None:
+            loss, _, _ = self._param_stream.micro_forward(
+                batch, keep_activations=False)
+            return loss
         batch = self._shard_batch(batch, with_gas_dim=False)
         return self._eval_step(self.state, batch)
 
@@ -968,6 +1096,11 @@ class DeepSpeedEngine:
     def forward(self, batch: dict) -> jax.Array:
         """Forward-only loss on a microbatch (for parity with reference
         ``engine(batch)``; the grad pass happens in ``backward``)."""
+        if self._param_stream is not None:
+            raise NotImplementedError(
+                "offload_param streaming exposes train_batch/eval_batch "
+                "only; the imperative forward/backward/step triplet needs "
+                "device-resident params")
         self.timers(FORWARD_GLOBAL_TIMER).start()
         batch = self._shard_batch(batch, with_gas_dim=False)
         loss = self._eval_step(self.state, batch)
@@ -976,6 +1109,11 @@ class DeepSpeedEngine:
         return loss
 
     def backward(self, batch: dict | None = None, loss=None) -> jax.Array:
+        if self._param_stream is not None:
+            raise NotImplementedError(
+                "offload_param streaming exposes train_batch/eval_batch "
+                "only; the imperative forward/backward/step triplet needs "
+                "device-resident params")
         """Compute grads for a microbatch and accumulate (reference
         engine.backward :1977 + ZeRO IPG accumulation). Accepts the
         DeepSpeed-canonical ``backward(loss)`` call shape: a scalar loss (or

@@ -152,6 +152,50 @@ class HostOffloadOptimizer:
         return st
 
     # ------------------------------------------------------------------
+    def step_keys(self, flat_grads: dict[str, np.ndarray], lr: float,
+                  bump_step: bool = True) -> dict[str, np.ndarray]:
+        """Host optimizer step over a subset of leaves. ``flat_grads`` maps
+        tree-path keys to fp32 gradients (any shape; flattened internally).
+        Returns {key: fp32 master (flat)} — the caller owns the conversion
+        to compute dtype (the ZeRO-Infinity layer streamer keeps params
+        host-side, so no device_put happens here). NVMe staging runs with
+        the same lookahead as :meth:`step_tree`."""
+        if bump_step:
+            self._step += 1
+        keys = [k for k in flat_grads if k in self.state]
+        missing = [k for k in flat_grads if k not in self.state
+                   and k not in self._dev_master]
+        if missing:
+            raise KeyError(f"offload state missing for {missing[:3]}...")
+        inflight: dict[str, dict] = {}
+        if self.device == "nvme":
+            for k in keys[:self.lookahead]:
+                inflight[k] = self._issue_fetch(k)
+        out: dict[str, np.ndarray] = {}
+        write_reqs: list[int] = []
+        for i, key in enumerate(keys):
+            st = self.state[key]
+            if self.device == "nvme":
+                st = self._absorb_fetch(key, inflight.pop(key))
+                nxt = i + self.lookahead
+                if nxt < len(keys):
+                    inflight[keys[nxt]] = self._issue_fetch(keys[nxt])
+            g = np.asarray(flat_grads[key], np.float32).reshape(-1)
+            self.cpu_opt.step(st, g, self._step, lr=lr)
+            out[key] = st.master
+            if self.device == "nvme":
+                for slot, buf in st.buffers().items():
+                    write_reqs.append(
+                        self.aio.async_pwrite(buf, self._path(key, slot)))
+        if self.device == "nvme":
+            for r in write_reqs:
+                self.aio.wait(r)
+            for key in keys:
+                # out[] views were consumed by the caller synchronously in
+                # the infinity path; disk is authoritative again
+                self.state[key].drop_buffers()
+        return out
+
     def step_tree(self, grads_tree: Pytree, param_shardings: Pytree,
                   lr: float) -> Pytree:
         """One optimizer step: returns the new compute-dtype param pytree,
