@@ -44,7 +44,8 @@ from ..models.transformer import (
 from ..parallel.topology import MeshConfig, MeshTopology
 from ..utils.logging import logger
 from ..ops.pallas.paged_attention import (paged_attention_usable,
-                                          paged_decode_attention)
+                                          paged_decode_attention,
+                                          paged_prefill_attention)
 from .ragged import StateManager, StepPlan
 from .sampling import sample_logits
 from .scheduler import SplitFuseScheduler
@@ -67,8 +68,9 @@ class RaggedInferenceConfig:
     temperature: float = 1.0
     top_k: int = 0
     top_p: float = 1.0
-    #: use the Pallas paged-attention kernel for decode steps; None = auto
-    #: (on whenever the kernel supports the model's head geometry)
+    #: use the Pallas paged-attention kernels (decode AND chunked-prefill
+    #: steps); None = auto (on whenever the kernel supports the model's
+    #: head geometry). False forces the XLA gather formulation for both.
     use_pallas_decode: bool | None = None
     #: when every live sequence is decoding, run up to this many decode
     #: iterations inside ONE jitted program (lax.scan) — one host→device
@@ -128,7 +130,8 @@ class InferenceEngineV2:
                      and (topology.mesh.size == 1 or tp_ok))
         if cfg.use_pallas_decode and not pallas_ok:
             raise ValueError(
-                "use_pallas_decode=True but the paged decode kernel does not "
+                "use_pallas_decode=True but the paged attention kernels "
+                "(decode + prefill) do not "
                 "support this setup (needs head_dim in {64,128,256}, "
                 "block_size % 8 == 0, heads % kv_heads == 0, no alibi, and "
                 "a mesh that is single-device or tensor-only with head "
@@ -238,9 +241,36 @@ class InferenceEngineV2:
                     o = paged_decode_attention(
                         q[:, 0], kv[0], kv[1], block_tables, seq_lens,
                         block_size=bs)[:, None]                    # [S,1,H,D]
+            elif T > 1 and self._pallas_decode:
+                # prefill chunks: blocked flash over the paged pool (the
+                # reference's blocked_flash.py:64 role). SplitFuse chunks
+                # are contiguous token ranges per slot, so positions[:, 0]
+                # fully determines every query position inside the kernel.
+                starts = positions[:, 0]
+                mesh = self.topology.mesh
+                if mesh.size > 1:
+                    from jax import shard_map
+
+                    o = shard_map(
+                        lambda qq, kk, vv, bt, sl, st:
+                        paged_prefill_attention(qq, kk, vv, bt, sl, st,
+                                                block_size=bs),
+                        mesh=mesh,
+                        in_specs=(P(None, None, "tensor", None),
+                                  P("tensor", None, None),
+                                  P("tensor", None, None),
+                                  P(None, None), P(None), P(None)),
+                        out_specs=P(None, None, "tensor", None),
+                        check_vma=False,
+                    )(q, kv[0], kv[1], block_tables, seq_lens, starts)
+                else:
+                    o = paged_prefill_attention(
+                        q, kv[0], kv[1], block_tables, seq_lens, starts,
+                        block_size=bs)
             else:
-                # prefill/mixed: gather each slot's pages. Advanced-index
-                # placement again: result is [S, ctx, KV, D] directly.
+                # fallback (alibi / odd geometries): gather each slot's
+                # pages. Advanced-index placement: result is
+                # [S, ctx, KV, D] directly.
                 K = kv[0, :, page_index]
                 V = kv[1, :, page_index]
                 if KV != H:

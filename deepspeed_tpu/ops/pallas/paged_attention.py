@@ -1,4 +1,4 @@
-"""Paged (block-table) attention as a Pallas TPU kernel — the decode path.
+"""Paged (block-table) attention as a Pallas TPU kernel — decode + prefill.
 
 TPU-native equivalent of the reference's blocked-flash ragged attention
 (/root/reference/deepspeed/inference/v2/kernels/ragged_ops/blocked_flash/
@@ -53,8 +53,12 @@ def paged_attention_usable(num_heads: int, kv_heads: int, head_dim: int,
     return head_dim in (64, 128, 256)
 
 
-def _decode_kernel(tables_ref, lens_ref, q_ref, k_ref, v_ref, o_ref,
-                   m_scr, l_scr, acc_scr, *, block_size: int, scale: float):
+def _paged_attn_kernel(tables_ref, lens_ref, starts_ref, q_ref, k_ref, v_ref,
+                       o_ref, m_scr, l_scr, acc_scr, *, block_size: int,
+                       scale: float, G: int):
+    """One online-softmax kernel serves prefill AND decode: decode is the
+    T=1 special case (starts = seq_len - 1 makes the causal mask collapse
+    to the plain validity mask ctx < seq_len)."""
     s = pl.program_id(0)
     j = pl.program_id(2)
     nj = pl.num_programs(2)
@@ -66,24 +70,32 @@ def _decode_kernel(tables_ref, lens_ref, q_ref, k_ref, v_ref, o_ref,
         acc_scr[:] = jnp.zeros_like(acc_scr)
 
     seq_len = lens_ref[s]
+    start = starts_ref[s]
     page_start = j * block_size
 
     @pl.when(page_start < seq_len)
     def _body():
-        q = q_ref[0, 0]                                     # [G, D]
+        q = q_ref[0, 0]                                     # [T*G, D]
         k = k_ref[0, 0]                                     # [bs, D]
         v = v_ref[0, 0]
         scores = jax.lax.dot_general(
             q, k, (((1,), (1,)), ((), ())),
-            preferred_element_type=jnp.float32) * scale      # [G, bs]
-        pos = page_start + jax.lax.broadcasted_iota(
+            preferred_element_type=jnp.float32) * scale      # [TG, bs]
+        # rows are t*G + g; chunk tokens sit at consecutive absolute
+        # positions start..start+T-1 (the SplitFuse contract), so the
+        # query position is recoverable from the row index — no per-token
+        # position input needed
+        qpos = start + jax.lax.broadcasted_iota(
+            jnp.int32, scores.shape, 0) // G
+        ctx = page_start + jax.lax.broadcasted_iota(
             jnp.int32, scores.shape, 1)
-        scores = jnp.where(pos < seq_len, scores, NEG_INF)
+        mask = (ctx <= qpos) & (ctx < seq_len)
+        scores = jnp.where(mask, scores, NEG_INF)
 
-        m_prev = m_scr[:]                                    # [G, 1]
+        m_prev = m_scr[:]                                    # [TG, 1]
         m_new = jnp.maximum(m_prev, jnp.max(scores, axis=-1, keepdims=True))
         alpha = jnp.exp(m_prev - m_new)
-        p = jnp.exp(scores - m_new)                          # [G, bs]
+        p = jnp.exp(scores - m_new)                          # [TG, bs]
         l_scr[:] = alpha * l_scr[:] + jnp.sum(p, axis=-1, keepdims=True)
         acc_scr[:] = acc_scr[:] * alpha + jax.lax.dot_general(
             p.astype(v.dtype), v, (((1,), (0,)), ((), ())),
@@ -97,18 +109,26 @@ def _decode_kernel(tables_ref, lens_ref, q_ref, k_ref, v_ref, o_ref,
         o_ref[0, 0] = (acc_scr[:] / l_safe).astype(o_ref.dtype)
 
 
-def paged_decode_attention(q, k_pool, v_pool, block_tables, seq_lens, *,
-                           block_size: int, scale: float | None = None,
-                           interpret: bool | None = None):
-    """One-token-per-sequence attention against a paged KV pool.
+def paged_prefill_attention(q, k_pool, v_pool, block_tables, seq_lens,
+                            chunk_starts, *, block_size: int,
+                            scale: float | None = None,
+                            interpret: bool | None = None):
+    """Chunked-prefill attention against a paged KV pool — the blocked-
+    flash half of the reference's ragged attention
+    (inference/v2/kernels/ragged_ops/blocked_flash/blocked_flash.py:64).
 
-    q:            [S, H, D] — the new token's query per sequence slot
-    k_pool/v_pool:[KV, P, D] with P = num_blocks * block_size
-    block_tables: [S, max_pages] int32 (pad entries with the trash block)
-    seq_lens:     [S] int32 — valid context incl. the new token (0 = empty)
-    Returns [S, H, D].
+    q:            [S, T, H, D] — each slot's T-token SplitFuse chunk, whose
+                  K/V were already scattered into the pool; positions are
+                  chunk_starts[s]..chunk_starts[s]+T-1 (contiguous)
+    k_pool/v_pool:[KV, P, D]
+    block_tables: [S, max_pages] int32
+    seq_lens:     [S] int32 — valid ctx incl. this chunk's tokens
+    chunk_starts: [S] int32 — absolute position of each slot's first token
+    Returns [S, T, H, D]. Peak memory per grid step is one [T*G, bs]
+    score tile + one page — never the [S, ctx, KV, D] gather of the XLA
+    formulation.
     """
-    S, H, D = q.shape
+    S, T, H, D = q.shape
     KV, P, _ = k_pool.shape
     if P % block_size:
         raise ValueError(f"pool tokens {P} not divisible by block_size "
@@ -122,36 +142,58 @@ def paged_decode_attention(q, k_pool, v_pool, block_tables, seq_lens, *,
     if interpret is None:
         interpret = jax.default_backend() != "tpu"
 
-    qg = q.reshape(S, KV, G, D)
+    # [S, T, H, D] -> [S, KV, T*G, D], rows t*G + g
+    qg = (q.reshape(S, T, KV, G, D).transpose(0, 2, 1, 3, 4)
+          .reshape(S, KV, T * G, D))
     kp = k_pool.reshape(KV, P // block_size, block_size, D)
     vp = v_pool.reshape(KV, P // block_size, block_size, D)
-    tables = block_tables.astype(jnp.int32)
-    lens = seq_lens.astype(jnp.int32)
 
     grid_spec = pltpu.PrefetchScalarGridSpec(
-        num_scalar_prefetch=2,
+        num_scalar_prefetch=3,
         grid=(S, KV, max_pages),
         in_specs=[
-            pl.BlockSpec((1, 1, G, D),
-                         lambda s, h, j, tables, lens: (s, h, 0, 0)),
+            pl.BlockSpec((1, 1, T * G, D),
+                         lambda s, h, j, tb, ln, st: (s, h, 0, 0)),
             pl.BlockSpec((1, 1, block_size, D),
-                         lambda s, h, j, tables, lens: (h, tables[s, j], 0, 0)),
+                         lambda s, h, j, tb, ln, st: (h, tb[s, j], 0, 0)),
             pl.BlockSpec((1, 1, block_size, D),
-                         lambda s, h, j, tables, lens: (h, tables[s, j], 0, 0)),
+                         lambda s, h, j, tb, ln, st: (h, tb[s, j], 0, 0)),
         ],
-        out_specs=pl.BlockSpec((1, 1, G, D),
-                               lambda s, h, j, tables, lens: (s, h, 0, 0)),
+        out_specs=pl.BlockSpec((1, 1, T * G, D),
+                               lambda s, h, j, tb, ln, st: (s, h, 0, 0)),
         scratch_shapes=[
-            pltpu.VMEM((G, 1), jnp.float32),
-            pltpu.VMEM((G, 1), jnp.float32),
-            pltpu.VMEM((G, D), jnp.float32),
+            pltpu.VMEM((T * G, 1), jnp.float32),
+            pltpu.VMEM((T * G, 1), jnp.float32),
+            pltpu.VMEM((T * G, D), jnp.float32),
         ],
     )
     out = pl.pallas_call(
-        functools.partial(_decode_kernel, block_size=block_size,
-                          scale=float(scale)),
+        functools.partial(_paged_attn_kernel, block_size=block_size,
+                          scale=float(scale), G=G),
         grid_spec=grid_spec,
-        out_shape=jax.ShapeDtypeStruct((S, KV, G, D), q.dtype),
+        out_shape=jax.ShapeDtypeStruct((S, KV, T * G, D), q.dtype),
         interpret=interpret,
-    )(tables, lens, qg, kp, vp)
-    return out.reshape(S, H, D)
+    )(block_tables.astype(jnp.int32), seq_lens.astype(jnp.int32),
+      chunk_starts.astype(jnp.int32), qg, kp, vp)
+    return (out.reshape(S, KV, T, G, D).transpose(0, 2, 1, 3, 4)
+            .reshape(S, T, H, D))
+
+
+def paged_decode_attention(q, k_pool, v_pool, block_tables, seq_lens, *,
+                           block_size: int, scale: float | None = None,
+                           interpret: bool | None = None):
+    """One-token-per-sequence attention against a paged KV pool: the T=1
+    case of :func:`paged_prefill_attention` with the query at position
+    seq_len - 1 (so the causal mask reduces to ctx < seq_len).
+
+    q:            [S, H, D] — the new token's query per sequence slot
+    k_pool/v_pool:[KV, P, D] with P = num_blocks * block_size
+    block_tables: [S, max_pages] int32 (pad entries with the trash block)
+    seq_lens:     [S] int32 — valid context incl. the new token (0 = empty)
+    Returns [S, H, D].
+    """
+    starts = jnp.maximum(seq_lens.astype(jnp.int32) - 1, 0)
+    out = paged_prefill_attention(
+        q[:, None], k_pool, v_pool, block_tables, seq_lens, starts,
+        block_size=block_size, scale=scale, interpret=interpret)
+    return out[:, 0]
