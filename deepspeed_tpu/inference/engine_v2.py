@@ -100,12 +100,33 @@ class InferenceEngineV2:
         self.scheduler = SplitFuseScheduler(self.state, cfg.chunk)
 
         # --- weights: same tree as the trainer, TP-sharded ---------------
-        self.params, _ = load_tp_params(model, params, rng, topology, cfg.dtype)
+        self.params, plan = load_tp_params(model, params, rng, topology,
+                                           cfg.dtype)
+        # stack homogeneous layers [L, ...] so the ragged forward can
+        # lax.scan over depth — compile time stays flat vs num_layers
+        # (reference inference_transformer_base.py:535's per-layer loop is
+        # kernel dispatch; under jit an unrolled loop is per-layer
+        # RECOMPILATION). Heterogeneous moe patterns (freq > 1) keep the
+        # unrolled loop.
+        m = self.mcfg
+        self._scan_layers = (m.num_layers > 1 and
+                             (not m.moe or (m.moe.moe_layer_freq or 1) == 1))
+        if self._scan_layers:
+            is_p = lambda x: isinstance(x, P)
+            stacked_sh = jax.tree.map(
+                lambda p: NamedSharding(topology.mesh, P(None, *p)),
+                plan.param_specs["layer_0"], is_leaf=is_p)
+            layers = [self.params.pop(f"layer_{i}")
+                      for i in range(m.num_layers)]
+            # donate: each per-layer buffer frees as it is copied, so init
+            # never holds 2x the layer weights in HBM
+            self.params["layers_stacked"] = jax.jit(
+                lambda ls: jax.tree.map(lambda *xs: jnp.stack(xs), *ls),
+                out_shardings=stacked_sh, donate_argnums=(0,))(layers)
 
         # --- the paged KV pool -------------------------------------------
         # [L, 2, KV, P, D]: kv-head-major so the Pallas kernel's page DMA
         # ([1, 1, block_size, D] tiles) reads contiguous HBM.
-        m = self.mcfg
         pool_tokens = cfg.num_blocks * cfg.block_size
         tp = max(topology.size("tensor"), 1)
         kv_spec = P(None, None, "tensor", None, None) \
@@ -300,8 +321,7 @@ class InferenceEngineV2:
                 o = o + a["bo"].astype(cfg.dtype)
             return o, kv
 
-        def layer(x, i, p, kv):                                    # kv [2,KV,P,D]
-            use_moe = bool(m.moe) and (i % (m.moe.moe_layer_freq or 1) == 0)
+        def layer(x, p, kv, use_moe):                              # kv [2,KV,P,D]
             h_attn = Norm(m).apply({"params": p["ln_attn"]}, x)
             o, kv = attention(p, kv, h_attn)
             if m.parallel_block:
@@ -312,11 +332,25 @@ class InferenceEngineV2:
             h_ffn = Norm(m).apply({"params": p["ln_ffn"]}, x)
             return x + ffn(p, h_ffn, use_moe), kv
 
-        new_kv = []
-        for i in range(m.num_layers):
-            x, kv_i = layer(x, i, params[f"layer_{i}"], kv_pool[i])
-            new_kv.append(kv_i)
-        kv_pool = jnp.stack(new_kv)
+        if "layers_stacked" in params:
+            # scan over depth: ONE traced layer body regardless of L; the
+            # pool rides as scanned input/output so each step reads and
+            # rewrites only its own [2, KV, P, D] slice
+            def body(xc, inp):
+                p_i, kv_i = inp
+                x2, kv_i2 = layer(xc, p_i, kv_i, bool(m.moe))
+                return x2, kv_i2
+
+            x, kv_pool = jax.lax.scan(
+                body, x, (params["layers_stacked"], kv_pool))
+        else:
+            new_kv = []
+            for i in range(m.num_layers):
+                use_moe = bool(m.moe) and \
+                    (i % (m.moe.moe_layer_freq or 1) == 0)
+                x, kv_i = layer(x, params[f"layer_{i}"], kv_pool[i], use_moe)
+                new_kv.append(kv_i)
+            kv_pool = jnp.stack(new_kv)
 
         x = Norm(m).apply({"params": params["ln_final"]}, x)
         last = jnp.take_along_axis(
