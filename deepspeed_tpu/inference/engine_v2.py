@@ -238,6 +238,12 @@ class InferenceEngineV2:
             kv = kv.at[1, :, flat_slots].set(
                 v.reshape(-1, KV, D).astype(kv.dtype))
 
+            # Sliding windows mask correctly on every path (and the Pallas
+            # kernel skips out-of-window pages), but blocks before the
+            # window are NOT yet reclaimed — a mistral rolling-buffer page
+            # map is future work; the cost is pool capacity, not
+            # correctness.
+            win = m.sliding_window
             if T == 1 and self._pallas_decode:
                 # decode: Pallas kernel pages K/V straight out of the pool
                 mesh = self.topology.mesh
@@ -248,7 +254,7 @@ class InferenceEngineV2:
 
                     o = shard_map(
                         lambda qq, kk, vv, bt, sl: paged_decode_attention(
-                            qq, kk, vv, bt, sl, block_size=bs),
+                            qq, kk, vv, bt, sl, block_size=bs, window=win),
                         mesh=mesh,
                         in_specs=(P(None, "tensor", None),
                                   P("tensor", None, None),
@@ -261,7 +267,7 @@ class InferenceEngineV2:
                 else:
                     o = paged_decode_attention(
                         q[:, 0], kv[0], kv[1], block_tables, seq_lens,
-                        block_size=bs)[:, None]                    # [S,1,H,D]
+                        block_size=bs, window=win)[:, None]        # [S,1,H,D]
             elif T > 1 and self._pallas_decode:
                 # prefill chunks: blocked flash over the paged pool (the
                 # reference's blocked_flash.py:64 role). SplitFuse chunks
@@ -275,7 +281,7 @@ class InferenceEngineV2:
                     o = shard_map(
                         lambda qq, kk, vv, bt, sl, st:
                         paged_prefill_attention(qq, kk, vv, bt, sl, st,
-                                                block_size=bs),
+                                                block_size=bs, window=win),
                         mesh=mesh,
                         in_specs=(P(None, None, "tensor", None),
                                   P("tensor", None, None),
@@ -287,7 +293,7 @@ class InferenceEngineV2:
                 else:
                     o = paged_prefill_attention(
                         q, kv[0], kv[1], block_tables, seq_lens, starts,
-                        block_size=bs)
+                        block_size=bs, window=win)
             else:
                 # fallback (alibi / odd geometries): gather each slot's
                 # pages. Advanced-index placement: result is
@@ -312,6 +318,8 @@ class InferenceEngineV2:
                 cpos = jnp.arange(ctx)[None, :]
                 valid = (cpos < seq_lens[:, None])[:, None, None, :]
                 causal = cpos[:, None, :] <= positions[:, :, None]  # [S,T,ctx]
+                if win:
+                    causal &= cpos[:, None, :] > positions[:, :, None] - win
                 mask = valid & causal[:, None, :, :]
                 scores = jnp.where(mask, scores, jnp.finfo(jnp.float32).min)
                 w = jax.nn.softmax(scores, axis=-1).astype(V.dtype)

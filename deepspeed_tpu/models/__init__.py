@@ -50,7 +50,7 @@ PRESETS: dict[str, ModelConfig] = {
                               num_heads=32, num_kv_heads=8, intermediate_size=14336,
                               max_seq_len=8192, position_embedding="rope",
                               norm="rmsnorm", activation="silu_glu",
-                              tie_embeddings=False),
+                              sliding_window=4096, tie_embeddings=False),
     "mixtral-8x7b": ModelConfig(vocab_size=32000, hidden_size=4096, num_layers=32,
                                 num_heads=32, num_kv_heads=8, intermediate_size=14336,
                                 max_seq_len=8192, position_embedding="rope",

@@ -109,10 +109,8 @@ def config_from_hf(hf_config) -> ModelConfig:
             norm_eps=hf_config.layer_norm_epsilon)
     if mt in ("llama", "mistral"):
         sw = getattr(hf_config, "sliding_window", None)
-        if sw is not None and sw < hf_config.max_position_embeddings:
-            raise NotImplementedError(
-                f"sliding_window={sw} attention is not implemented; "
-                f"converted logits would diverge past the window")
+        if sw is not None and sw >= hf_config.max_position_embeddings:
+            sw = None                     # window never binds → plain causal
         return dataclasses.replace(
             PRESETS["llama2-7b"],
             vocab_size=hf_config.vocab_size,
@@ -124,6 +122,7 @@ def config_from_hf(hf_config) -> ModelConfig:
             max_seq_len=hf_config.max_position_embeddings,
             rope_theta=float(getattr(hf_config, "rope_theta", 10000.0)),
             norm_eps=hf_config.rms_norm_eps,
+            sliding_window=sw,
             tie_embeddings=bool(getattr(hf_config, "tie_word_embeddings",
                                         False)))
     raise NotImplementedError(

@@ -106,6 +106,7 @@ class ModelConfig:
                                              # (gpt-neox, falcon-40b)
     causal: bool = True                      # False → bidirectional encoder
                                              # (bert family)
+    sliding_window: int | None = None        # mistral: attend last W tokens
     pre_norm: bool = True                    # False → post-norm residuals
                                              # (original BERT layout)
     dropout: float = 0.0                     # bert-style residual dropout
@@ -323,7 +324,9 @@ class Attention(nn.Module):
             kv_len=(kv_cache[2] + S) if kv_cache is not None else None,
             mask=attn_mask,
             bias=alibi_bias,
-            impl="xla" if alibi_bias is not None else cfg.attn_impl,
+            window=cfg.sliding_window,
+            impl="xla" if (alibi_bias is not None or cfg.sliding_window)
+            else cfg.attn_impl,
         )
         # back to seq-sharded, heads full
         out = constrain(out, BATCH, SEQ, None, None)

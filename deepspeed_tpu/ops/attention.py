@@ -13,7 +13,8 @@ import jax
 import jax.numpy as jnp
 
 
-def _xla_attention(q, k, v, *, causal, positions, kv_len, mask, bias=None):
+def _xla_attention(q, k, v, *, causal, positions, kv_len, mask, bias=None,
+                   window=None):
     B, Sq, H, D = q.shape
     Skv, KV = k.shape[1], k.shape[2]
     scale = 1.0 / (D ** 0.5)
@@ -32,10 +33,15 @@ def _xla_attention(q, k, v, *, causal, positions, kv_len, mask, bias=None):
         if kv_len is not None:
             allow &= kv_pos < (kv_len if jnp.ndim(kv_len) == 0
                                else kv_len[:, None, None, None])
+        if window:
+            allow &= kv_pos > q_pos - window
         logits = jnp.where(allow, logits, neg)
     elif causal:
         q_pos = jnp.arange(Sq)[None, None, :, None]
-        logits = jnp.where(kv_pos <= q_pos, logits, neg)
+        allow = kv_pos <= q_pos
+        if window:       # mistral sliding window: attend the last W tokens
+            allow &= kv_pos > q_pos - window
+        logits = jnp.where(allow, logits, neg)
     if mask is not None:
         # mask: [B, Skv] (1 = attend) or broadcastable bool
         m = mask[:, None, None, :] if mask.ndim == 2 else mask
@@ -51,8 +57,11 @@ def _xla_attention(q, k, v, *, causal, positions, kv_len, mask, bias=None):
 
 def dot_product_attention(q, k, v, *, causal: bool = True, positions=None,
                           kv_len=None, mask=None, bias=None, impl: str = "auto",
+                          window: int | None = None,
                           allow_multi_device: bool = False):
     """q: [B,Sq,H,D]; k/v: [B,Skv,KV,D] (KV divides H for GQA).
+    ``window``: sliding-window attention — query p attends keys in
+    (p - window, p] (mistral; reference inference/v2 mistral impl).
 
     ``allow_multi_device`` must ONLY be set by callers running per-shard
     inside shard_map (e.g. parallel/sequence.py): pallas_call has no GSPMD
@@ -60,7 +69,10 @@ def dot_product_attention(q, k, v, *, causal: bool = True, positions=None,
     a multi-device mesh would force q/k/v replication. ``impl='pallas'``
     alone does not opt in.
     """
-    if impl in ("auto", "pallas") and bias is None:
+    if window and positions is None and not causal:
+        raise ValueError("sliding_window requires causal attention "
+                         "(bidirectional windows are not a thing here)")
+    if impl in ("auto", "pallas") and bias is None and not window:
         try:
             from .pallas.flash_attention import flash_attention_usable, flash_attention
 
@@ -72,8 +84,8 @@ def dot_product_attention(q, k, v, *, causal: bool = True, positions=None,
             pass
         if impl == "pallas":
             raise ValueError("pallas flash attention not usable for these inputs")
-    elif impl == "pallas" and bias is not None:
-        raise ValueError("pallas flash attention has no additive-bias path "
-                         "(ALiBi models run the XLA attention)")
+    elif impl == "pallas" and (bias is not None or window):
+        raise ValueError("pallas flash attention has no additive-bias or "
+                         "sliding-window path yet (these run XLA attention)")
     return _xla_attention(q, k, v, causal=causal, positions=positions,
-                          kv_len=kv_len, mask=mask, bias=bias)
+                          kv_len=kv_len, mask=mask, bias=bias, window=window)

@@ -55,10 +55,12 @@ def paged_attention_usable(num_heads: int, kv_heads: int, head_dim: int,
 
 def _paged_attn_kernel(tables_ref, lens_ref, starts_ref, q_ref, k_ref, v_ref,
                        o_ref, m_scr, l_scr, acc_scr, *, block_size: int,
-                       scale: float, G: int):
+                       scale: float, G: int, window: int):
     """One online-softmax kernel serves prefill AND decode: decode is the
     T=1 special case (starts = seq_len - 1 makes the causal mask collapse
-    to the plain validity mask ctx < seq_len)."""
+    to the plain validity mask ctx < seq_len). ``window`` > 0 adds the
+    mistral sliding window (query p attends (p - window, p]) and skips
+    pages wholly before any row's window."""
     s = pl.program_id(0)
     j = pl.program_id(2)
     nj = pl.num_programs(2)
@@ -73,7 +75,12 @@ def _paged_attn_kernel(tables_ref, lens_ref, starts_ref, q_ref, k_ref, v_ref,
     start = starts_ref[s]
     page_start = j * block_size
 
-    @pl.when(page_start < seq_len)
+    run = page_start < seq_len
+    if window:
+        # the earliest key any row of this chunk can see is start-window+1
+        run &= page_start + block_size > start - window + 1
+
+    @pl.when(run)
     def _body():
         q = q_ref[0, 0]                                     # [T*G, D]
         k = k_ref[0, 0]                                     # [bs, D]
@@ -90,6 +97,8 @@ def _paged_attn_kernel(tables_ref, lens_ref, starts_ref, q_ref, k_ref, v_ref,
         ctx = page_start + jax.lax.broadcasted_iota(
             jnp.int32, scores.shape, 1)
         mask = (ctx <= qpos) & (ctx < seq_len)
+        if window:
+            mask &= ctx > qpos - window
         scores = jnp.where(mask, scores, NEG_INF)
 
         m_prev = m_scr[:]                                    # [TG, 1]
@@ -112,6 +121,7 @@ def _paged_attn_kernel(tables_ref, lens_ref, starts_ref, q_ref, k_ref, v_ref,
 def paged_prefill_attention(q, k_pool, v_pool, block_tables, seq_lens,
                             chunk_starts, *, block_size: int,
                             scale: float | None = None,
+                            window: int | None = None,
                             interpret: bool | None = None):
     """Chunked-prefill attention against a paged KV pool — the blocked-
     flash half of the reference's ragged attention
@@ -169,7 +179,7 @@ def paged_prefill_attention(q, k_pool, v_pool, block_tables, seq_lens,
     )
     out = pl.pallas_call(
         functools.partial(_paged_attn_kernel, block_size=block_size,
-                          scale=float(scale), G=G),
+                          scale=float(scale), G=G, window=int(window or 0)),
         grid_spec=grid_spec,
         out_shape=jax.ShapeDtypeStruct((S, KV, T * G, D), q.dtype),
         interpret=interpret,
@@ -181,6 +191,7 @@ def paged_prefill_attention(q, k_pool, v_pool, block_tables, seq_lens,
 
 def paged_decode_attention(q, k_pool, v_pool, block_tables, seq_lens, *,
                            block_size: int, scale: float | None = None,
+                           window: int | None = None,
                            interpret: bool | None = None):
     """One-token-per-sequence attention against a paged KV pool: the T=1
     case of :func:`paged_prefill_attention` with the query at position
@@ -195,5 +206,6 @@ def paged_decode_attention(q, k_pool, v_pool, block_tables, seq_lens, *,
     starts = jnp.maximum(seq_lens.astype(jnp.int32) - 1, 0)
     out = paged_prefill_attention(
         q[:, None], k_pool, v_pool, block_tables, seq_lens, starts,
-        block_size=block_size, scale=scale, interpret=interpret)
+        block_size=block_size, scale=scale, window=window,
+        interpret=interpret)
     return out[:, 0]
