@@ -184,6 +184,8 @@ class InferenceEngineV2:
         x = params["embed"].astype(cfg.dtype)[token_ids]           # [S,T,E]
         if m.position_embedding == "learned":
             x = x + params["pos_embed"].astype(cfg.dtype)[positions]
+        if "ln_embed" in params:                                   # bloom
+            x = Norm(m).apply({"params": params["ln_embed"]}, x)
 
         # flat pool slots this step's tokens write to; padded tokens hit the
         # trash block (slot_map==0..bs-1 range of block 0)
@@ -367,6 +369,8 @@ class InferenceEngineV2:
             logits = jnp.einsum("se,ve->sv", last, params["embed"].astype(cfg.dtype))
         else:
             logits = jnp.einsum("se,ev->sv", last, params["unembed"].astype(cfg.dtype))
+        if m.unembed_bias:
+            logits = logits + params["unembed_b"].astype(cfg.dtype)
         return kv_pool, logits
 
     def _program(self, T: int):

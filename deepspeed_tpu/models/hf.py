@@ -65,34 +65,228 @@ def _gpt2_tree(sd: dict, cfg: ModelConfig) -> dict:
 
 
 def _llama_tree(sd: dict, cfg: ModelConfig) -> dict:
+    t = _llama_tree_attn_only(sd, cfg)
+    for i in range(cfg.num_layers):
+        p = f"model.layers.{i}."
+        t[f"layer_{i}"]["ffn"] = {
+            "w_gate": sd[p + "mlp.gate_proj.weight"].T,
+            "w_up": sd[p + "mlp.up_proj.weight"].T,
+            "w_down": sd[p + "mlp.down_proj.weight"].T}
+    return t
+
+
+def _qwen2_tree(sd: dict, cfg: ModelConfig) -> dict:
+    """qwen2 = llama + qkv biases (the biases see RoPE's head-dim layout,
+    so they get the same half→interleaved permutation as the weights)."""
+    H, KV, D = cfg.num_heads, cfg.kv_heads, cfg.head_dim
+    perm = _interleave_perm(D)
+    t = _llama_tree(sd, cfg)
+    for i in range(cfg.num_layers):
+        p = f"model.layers.{i}."
+        a = t[f"layer_{i}"]["attn"]
+        a["bq"] = sd[p + "self_attn.q_proj.bias"].reshape(H, D)[:, perm]
+        a["bk"] = sd[p + "self_attn.k_proj.bias"].reshape(KV, D)[:, perm]
+        a["bv"] = sd[p + "self_attn.v_proj.bias"].reshape(KV, D)
+    return t
+
+
+def _mixtral_tree(sd: dict, cfg: ModelConfig) -> dict:
+    """mixtral = llama attention + stacked-expert MoE FFN (HF w1=gate,
+    w3=up, w2=down per expert; gate.weight is the router)."""
+    E = cfg.hidden_size
+    t = _llama_tree_attn_only(sd, cfg)
+    n_exp = cfg.moe.num_experts
+    for i in range(cfg.num_layers):
+        p = f"model.layers.{i}.block_sparse_moe."
+        t[f"layer_{i}"]["moe"] = {"moe_layer": {
+            "gate": {"wg": sd[p + "gate.weight"].T},            # [E, n_exp]
+            "experts": {
+                "w_gate": np.stack([sd[p + f"experts.{k}.w1.weight"].T
+                                    for k in range(n_exp)]),
+                "w_up": np.stack([sd[p + f"experts.{k}.w3.weight"].T
+                                  for k in range(n_exp)]),
+                "w_down": np.stack([sd[p + f"experts.{k}.w2.weight"].T
+                                    for k in range(n_exp)]),
+            }}}
+    return t
+
+
+def _llama_tree_attn_only(sd: dict, cfg: ModelConfig) -> dict:
+    """The llama embedding/attention/norm skeleton without the dense FFN
+    (mixtral swaps in its MoE block)."""
     E, H, KV, D = (cfg.hidden_size, cfg.num_heads, cfg.kv_heads,
                    cfg.head_dim)
     perm = _interleave_perm(D)
     t = {"embed": sd["model.embed_tokens.weight"],
          "ln_final": {"scale": sd["model.norm.weight"]}}
-    if not cfg.tie_embeddings:       # tied checkpoints never read unembed
+    if not cfg.tie_embeddings:
         t["unembed"] = sd["lm_head.weight"].T
     for i in range(cfg.num_layers):
         p = f"model.layers.{i}."
-        wq = sd[p + "self_attn.q_proj.weight"].T.reshape(E, H, D)[:, :, perm]
-        wk = sd[p + "self_attn.k_proj.weight"].T.reshape(E, KV, D)[:, :, perm]
         t[f"layer_{i}"] = {
             "ln_attn": {"scale": sd[p + "input_layernorm.weight"]},
             "attn": {
-                "wq": wq, "wk": wk,
+                "wq": sd[p + "self_attn.q_proj.weight"].T
+                .reshape(E, H, D)[:, :, perm],
+                "wk": sd[p + "self_attn.k_proj.weight"].T
+                .reshape(E, KV, D)[:, :, perm],
                 "wv": sd[p + "self_attn.v_proj.weight"].T.reshape(E, KV, D),
                 "wo": sd[p + "self_attn.o_proj.weight"].T.reshape(H, D, E),
             },
             "ln_ffn": {"scale": sd[p + "post_attention_layernorm.weight"]},
-            "ffn": {"w_gate": sd[p + "mlp.gate_proj.weight"].T,
-                    "w_up": sd[p + "mlp.up_proj.weight"].T,
-                    "w_down": sd[p + "mlp.down_proj.weight"].T},
+        }
+    return t
+
+
+def _falcon_tree(sd: dict, cfg: ModelConfig) -> dict:
+    """falcon-7b layout: fused query_key_value with multi-query K/V tail
+    ([H*D + 2*D, E]: H query heads, then one K and one V head), parallel
+    attn/FFN with ONE input layernorm, no linear biases."""
+    E, H, KV, D = (cfg.hidden_size, cfg.num_heads, cfg.kv_heads,
+                   cfg.head_dim)
+    perm = _interleave_perm(D)
+    t = {"embed": sd["transformer.word_embeddings.weight"],
+         "ln_final": {"scale": sd["transformer.ln_f.weight"],
+                      "bias": sd["transformer.ln_f.bias"]}}
+    if not cfg.tie_embeddings:
+        t["unembed"] = sd["lm_head.weight"].T
+    F = cfg.ffn_size
+    for i in range(cfg.num_layers):
+        p = f"transformer.h.{i}."
+        w = sd[p + "self_attention.query_key_value.weight"].T  # [E, (H+2K)D]
+        wq = w[:, :H * D].reshape(E, H, D)[:, :, perm]
+        wk = w[:, H * D:(H + KV) * D].reshape(E, KV, D)[:, :, perm]
+        wv = w[:, (H + KV) * D:].reshape(E, KV, D)
+        t[f"layer_{i}"] = {
+            "ln_attn": {"scale": sd[p + "input_layernorm.weight"],
+                        "bias": sd[p + "input_layernorm.bias"]},
+            "attn": {
+                "wq": wq, "wk": wk, "wv": wv,
+                "wo": sd[p + "self_attention.dense.weight"].T
+                .reshape(H, D, E),
+            },
+            "ffn": {"w_up": sd[p + "mlp.dense_h_to_4h.weight"].T,
+                    "b_up": np.zeros(F, np.float32),       # falcon: no bias
+                    "w_down": sd[p + "mlp.dense_4h_to_h.weight"].T,
+                    "b_down": np.zeros(E, np.float32)},
+        }
+    return t
+
+
+def _bloom_tree(sd: dict, cfg: ModelConfig) -> dict:
+    """bloom layout: embedding layernorm, fused per-head-interleaved QKV
+    ([H, 3, D, E] after reshape), ALiBi (no position params)."""
+    E, H, D = cfg.hidden_size, cfg.num_heads, cfg.head_dim
+    t = {"embed": sd["transformer.word_embeddings.weight"],
+         "ln_embed": {"scale": sd["transformer.word_embeddings_layernorm.weight"],
+                      "bias": sd["transformer.word_embeddings_layernorm.bias"]},
+         "ln_final": {"scale": sd["transformer.ln_f.weight"],
+                      "bias": sd["transformer.ln_f.bias"]}}
+    for i in range(cfg.num_layers):
+        p = f"transformer.h.{i}."
+        w = sd[p + "self_attention.query_key_value.weight"]  # [3HD, E]
+        b = sd[p + "self_attention.query_key_value.bias"]
+        w = w.reshape(H, 3, D, E)
+        b = b.reshape(H, 3, D)
+        t[f"layer_{i}"] = {
+            "ln_attn": {"scale": sd[p + "input_layernorm.weight"],
+                        "bias": sd[p + "input_layernorm.bias"]},
+            "attn": {
+                "wq": w[:, 0].transpose(2, 0, 1), "bq": b[:, 0],
+                "wk": w[:, 1].transpose(2, 0, 1), "bk": b[:, 1],
+                "wv": w[:, 2].transpose(2, 0, 1), "bv": b[:, 2],
+                "wo": sd[p + "self_attention.dense.weight"].T
+                .reshape(H, D, E),
+                "bo": sd[p + "self_attention.dense.bias"],
+            },
+            "ln_ffn": {"scale": sd[p + "post_attention_layernorm.weight"],
+                       "bias": sd[p + "post_attention_layernorm.bias"]},
+            "ffn": {"w_up": sd[p + "mlp.dense_h_to_4h.weight"].T,
+                    "b_up": sd[p + "mlp.dense_h_to_4h.bias"],
+                    "w_down": sd[p + "mlp.dense_4h_to_h.weight"].T,
+                    "b_down": sd[p + "mlp.dense_4h_to_h.bias"]},
+        }
+    return t
+
+
+def _opt_tree(sd: dict, cfg: ModelConfig) -> dict:
+    """OPT layout: learned positions with a +2 offset (sliced off here),
+    separate q/k/v/out projections with biases, ReLU FFN with biases."""
+    E, H, D = cfg.hidden_size, cfg.num_heads, cfg.head_dim
+    t = {"embed": sd["model.decoder.embed_tokens.weight"],
+         # OPT feeds positions + 2 into its table; drop the offset rows
+         "pos_embed": sd["model.decoder.embed_positions.weight"][2:],
+         "ln_final": {"scale": sd["model.decoder.final_layer_norm.weight"],
+                      "bias": sd["model.decoder.final_layer_norm.bias"]}}
+    if not cfg.tie_embeddings:
+        t["unembed"] = sd["lm_head.weight"].T
+    for i in range(cfg.num_layers):
+        p = f"model.decoder.layers.{i}."
+        t[f"layer_{i}"] = {
+            "ln_attn": {"scale": sd[p + "self_attn_layer_norm.weight"],
+                        "bias": sd[p + "self_attn_layer_norm.bias"]},
+            "attn": {
+                "wq": sd[p + "self_attn.q_proj.weight"].T.reshape(E, H, D),
+                "bq": sd[p + "self_attn.q_proj.bias"].reshape(H, D),
+                "wk": sd[p + "self_attn.k_proj.weight"].T.reshape(E, H, D),
+                "bk": sd[p + "self_attn.k_proj.bias"].reshape(H, D),
+                "wv": sd[p + "self_attn.v_proj.weight"].T.reshape(E, H, D),
+                "bv": sd[p + "self_attn.v_proj.bias"].reshape(H, D),
+                "wo": sd[p + "self_attn.out_proj.weight"].T.reshape(H, D, E),
+                "bo": sd[p + "self_attn.out_proj.bias"],
+            },
+            "ln_ffn": {"scale": sd[p + "final_layer_norm.weight"],
+                       "bias": sd[p + "final_layer_norm.bias"]},
+            "ffn": {"w_up": sd[p + "fc1.weight"].T,
+                    "b_up": sd[p + "fc1.bias"],
+                    "w_down": sd[p + "fc2.weight"].T,
+                    "b_down": sd[p + "fc2.bias"]},
+        }
+    return t
+
+
+def _phi_tree(sd: dict, cfg: ModelConfig) -> dict:
+    """phi-2 layout: parallel attn/FFN under ONE layernorm, PARTIAL rotary
+    (the interleave permutation applies only to the rotary slice of each
+    head), biases everywhere incl. the lm_head."""
+    E, H, D = cfg.hidden_size, cfg.num_heads, cfg.head_dim
+    d_rot = (int(D * cfg.rotary_pct) // 2) * 2
+    perm = np.concatenate([_interleave_perm(d_rot),
+                           np.arange(d_rot, D)])
+    t = {"embed": sd["model.embed_tokens.weight"],
+         "ln_final": {"scale": sd["model.final_layernorm.weight"],
+                      "bias": sd["model.final_layernorm.bias"]},
+         "unembed": sd["lm_head.weight"].T,
+         "unembed_b": sd["lm_head.bias"]}
+    for i in range(cfg.num_layers):
+        p = f"model.layers.{i}."
+        t[f"layer_{i}"] = {
+            "ln_attn": {"scale": sd[p + "input_layernorm.weight"],
+                        "bias": sd[p + "input_layernorm.bias"]},
+            "attn": {
+                "wq": sd[p + "self_attn.q_proj.weight"].T
+                .reshape(E, H, D)[:, :, perm],
+                "bq": sd[p + "self_attn.q_proj.bias"].reshape(H, D)[:, perm],
+                "wk": sd[p + "self_attn.k_proj.weight"].T
+                .reshape(E, H, D)[:, :, perm],
+                "bk": sd[p + "self_attn.k_proj.bias"].reshape(H, D)[:, perm],
+                "wv": sd[p + "self_attn.v_proj.weight"].T.reshape(E, H, D),
+                "bv": sd[p + "self_attn.v_proj.bias"].reshape(H, D),
+                "wo": sd[p + "self_attn.dense.weight"].T.reshape(H, D, E),
+                "bo": sd[p + "self_attn.dense.bias"],
+            },
+            "ffn": {"w_up": sd[p + "mlp.fc1.weight"].T,
+                    "b_up": sd[p + "mlp.fc1.bias"],
+                    "w_down": sd[p + "mlp.fc2.weight"].T,
+                    "b_down": sd[p + "mlp.fc2.bias"]},
         }
     return t
 
 
 _CONVERTERS = {"gpt2": _gpt2_tree, "llama": _llama_tree,
-               "mistral": _llama_tree}
+               "mistral": _llama_tree, "qwen2": _qwen2_tree,
+               "mixtral": _mixtral_tree, "falcon": _falcon_tree,
+               "bloom": _bloom_tree, "opt": _opt_tree, "phi": _phi_tree}
 
 
 def config_from_hf(hf_config) -> ModelConfig:
@@ -123,6 +317,126 @@ def config_from_hf(hf_config) -> ModelConfig:
             rope_theta=float(getattr(hf_config, "rope_theta", 10000.0)),
             norm_eps=hf_config.rms_norm_eps,
             sliding_window=sw,
+            tie_embeddings=bool(getattr(hf_config, "tie_word_embeddings",
+                                        False)))
+    if mt == "qwen2":
+        sw = hf_config.sliding_window if getattr(
+            hf_config, "use_sliding_window", False) else None
+        if sw is not None and sw >= hf_config.max_position_embeddings:
+            sw = None
+        return dataclasses.replace(
+            PRESETS["qwen2-7b"],
+            vocab_size=hf_config.vocab_size,
+            hidden_size=hf_config.hidden_size,
+            num_layers=hf_config.num_hidden_layers,
+            num_heads=hf_config.num_attention_heads,
+            num_kv_heads=hf_config.num_key_value_heads,
+            intermediate_size=hf_config.intermediate_size,
+            max_seq_len=hf_config.max_position_embeddings,
+            rope_theta=float(getattr(hf_config, "rope_theta", 10000.0)),
+            norm_eps=hf_config.rms_norm_eps, sliding_window=sw,
+            tie_embeddings=bool(getattr(hf_config, "tie_word_embeddings",
+                                        False)))
+    if mt == "mixtral":
+        from .transformer import MoEConfig
+
+        n_exp = hf_config.num_local_experts
+        k = hf_config.num_experts_per_tok
+        sw = getattr(hf_config, "sliding_window", None)
+        if sw is not None and sw >= hf_config.max_position_embeddings:
+            sw = None
+        return dataclasses.replace(
+            PRESETS["mixtral-8x7b"],
+            sliding_window=sw,
+            vocab_size=hf_config.vocab_size,
+            hidden_size=hf_config.hidden_size,
+            num_layers=hf_config.num_hidden_layers,
+            num_heads=hf_config.num_attention_heads,
+            num_kv_heads=hf_config.num_key_value_heads,
+            intermediate_size=hf_config.intermediate_size,
+            max_seq_len=hf_config.max_position_embeddings,
+            rope_theta=float(getattr(hf_config, "rope_theta", 10000.0)),
+            norm_eps=hf_config.rms_norm_eps,
+            tie_embeddings=bool(getattr(hf_config, "tie_word_embeddings",
+                                        False)),
+            # eval capacity >= n/k so no token ever drops — HF mixtral
+            # routes every token, and import parity requires the same
+            moe=MoEConfig(num_experts=n_exp, top_k=k,
+                          eval_capacity_factor=float(n_exp) / k,
+                          aux_loss_weight=float(getattr(
+                              hf_config, "router_aux_loss_coef", 0.01))))
+    if mt == "falcon":
+        if getattr(hf_config, "new_decoder_architecture", False):
+            raise NotImplementedError(
+                "falcon new_decoder_architecture (40b/180b grouped layout) "
+                "conversion is not implemented yet; 7b-style multi_query "
+                "checkpoints convert")
+        if not getattr(hf_config, "parallel_attn", True):
+            raise NotImplementedError("non-parallel falcon variants are "
+                                      "not converted")
+        if getattr(hf_config, "alibi", False):
+            raise NotImplementedError("alibi falcon variants are not "
+                                      "converted (rope falcons are)")
+        if not hf_config.multi_query:
+            raise NotImplementedError(
+                "falcon multi_query=False stores fused QKV per-head "
+                "interleaved — that layout is not converted")
+        if getattr(hf_config, "bias", False):
+            raise NotImplementedError("falcon bias=True checkpoints are "
+                                      "not converted (7b-style bias-free "
+                                      "ones are)")
+        return dataclasses.replace(
+            PRESETS["falcon-7b"],
+            vocab_size=hf_config.vocab_size,
+            hidden_size=hf_config.hidden_size,
+            num_layers=hf_config.num_hidden_layers,
+            num_heads=hf_config.num_attention_heads,
+            num_kv_heads=1 if hf_config.multi_query
+            else hf_config.num_attention_heads,
+            max_seq_len=getattr(hf_config, "max_position_embeddings", 2048),
+            rope_theta=float(getattr(hf_config, "rope_theta", 10000.0)),
+            norm_eps=hf_config.layer_norm_epsilon,
+            tie_embeddings=bool(getattr(hf_config, "tie_word_embeddings",
+                                        True)))
+    if mt == "bloom":
+        return dataclasses.replace(
+            PRESETS["bloom-7b1"],
+            vocab_size=hf_config.vocab_size,
+            hidden_size=hf_config.hidden_size,
+            num_layers=hf_config.n_layer, num_heads=hf_config.n_head,
+            max_seq_len=2048,                  # ALiBi: no positional table
+            norm_eps=hf_config.layer_norm_epsilon)
+    if mt == "opt":
+        if not getattr(hf_config, "do_layer_norm_before", True):
+            raise NotImplementedError("opt-350m's post-norm layout is not "
+                                      "converted")
+        if hf_config.word_embed_proj_dim != hf_config.hidden_size:
+            raise NotImplementedError("opt embed-projection checkpoints "
+                                      "(word_embed_proj_dim != hidden) are "
+                                      "not converted")
+        return dataclasses.replace(
+            PRESETS["opt-125m"],
+            vocab_size=hf_config.vocab_size,
+            hidden_size=hf_config.hidden_size,
+            num_layers=hf_config.num_hidden_layers,
+            num_heads=hf_config.num_attention_heads,
+            intermediate_size=hf_config.ffn_dim,
+            max_seq_len=hf_config.max_position_embeddings,
+            tie_embeddings=bool(getattr(hf_config, "tie_word_embeddings",
+                                        True)))
+    if mt == "phi":
+        return dataclasses.replace(
+            PRESETS["phi-2"],
+            vocab_size=hf_config.vocab_size,
+            hidden_size=hf_config.hidden_size,
+            num_layers=hf_config.num_hidden_layers,
+            num_heads=hf_config.num_attention_heads,
+            intermediate_size=hf_config.intermediate_size,
+            max_seq_len=hf_config.max_position_embeddings,
+            rope_theta=float(getattr(hf_config, "rope_theta", 10000.0)),
+            rotary_pct=float(getattr(hf_config, "partial_rotary_factor",
+                                     0.5)),
+            norm_eps=hf_config.layer_norm_eps,
             tie_embeddings=bool(getattr(hf_config, "tie_word_embeddings",
                                         False)))
     raise NotImplementedError(

@@ -109,6 +109,9 @@ class ModelConfig:
     sliding_window: int | None = None        # mistral: attend last W tokens
     pre_norm: bool = True                    # False → post-norm residuals
                                              # (original BERT layout)
+    embed_norm: bool = False                 # bloom: LayerNorm right after
+                                             # the embedding (pre-norm too)
+    unembed_bias: bool = False               # phi: lm_head carries a bias
     dropout: float = 0.0                     # bert-style residual dropout
     type_vocab_size: int = 0                 # >0 → bert segment embeddings
     tie_embeddings: bool = True
@@ -158,8 +161,14 @@ class ModelConfig:
         per_norm = h if self.norm == "rmsnorm" else 2 * h
         # pre-norm: 2 per layer + ln_final; post-norm: 2 per layer + ln_embed
         norms = (2 * L + 1) * per_norm
+        if self.embed_norm and self.pre_norm:   # bloom: ln_embed on top
+            norms += per_norm
+        if self.parallel_block and self.parallel_block_norms == 1:
+            norms -= L * per_norm               # one ln per layer, not two
         emb = v * h + (0 if self.tie_embeddings else v * h)
         emb += self.type_vocab_size * h
+        if self.unembed_bias:
+            emb += v
         pos = self.max_seq_len * h if self.position_embedding == "learned" else 0
         return emb + pos + L * (attn + ffn) + norms
 
@@ -521,8 +530,9 @@ class TransformerLM(nn.Module):
             if token_type_ids is None:
                 token_type_ids = jnp.zeros_like(input_ids)
             x = x + type_emb.astype(cfg.dtype)[token_type_ids]
-        if not cfg.pre_norm:
-            # bert: layernorm + dropout on the embedding sum
+        if cfg.embed_norm or not cfg.pre_norm:
+            # bert: layernorm + dropout on the embedding sum; bloom:
+            # word_embeddings_layernorm ahead of pre-norm blocks
             x = Norm(cfg, name="ln_embed")(x)
         if cfg.dropout > 0:
             x = nn.Dropout(cfg.dropout, deterministic=deterministic)(x)
@@ -557,6 +567,11 @@ class TransformerLM(nn.Module):
                 nn.initializers.normal(0.02), ("embed", "vocab")),
                 (cfg.hidden_size, cfg.vocab_size), jnp.float32)
             logits = jnp.einsum("bse,ev->bsv", x, unembed.astype(cfg.dtype))
+        if cfg.unembed_bias:
+            ub = self.param("unembed_b", nn.with_partitioning(
+                nn.initializers.zeros, ("vocab",)),
+                (cfg.vocab_size,), jnp.float32)
+            logits = logits + ub.astype(cfg.dtype)
         logits = constrain(logits, BATCH, SEQ, None)
         if kv_caches is not None:
             return logits, new_caches

@@ -98,7 +98,7 @@ class LayerStreamTrainer:
     def group_of(self, top_key: str) -> str:
         if top_key.startswith("layer_"):
             return top_key
-        if top_key in ("ln_final", "unembed"):
+        if top_key in ("ln_final", "unembed", "unembed_b"):
             return "head"
         return "pre"   # embed / pos_embed / type_embed / ln_embed
 
@@ -265,6 +265,8 @@ class LayerStreamTrainer:
             else:
                 logits = jnp.einsum("bse,ev->bsv", x,
                                     hp["unembed"].astype(dt))
+            if m.unembed_bias:
+                logits = logits + hp["unembed_b"].astype(dt)
             return cross_entropy_lm(logits, labels)
 
         return head

@@ -121,3 +121,116 @@ def test_non_binding_sliding_window_accepted():
         num_hidden_layers=2, num_attention_heads=4, num_key_value_heads=2,
         max_position_embeddings=4096, sliding_window=4096)
     assert config_from_hf(cfg).sliding_window is None
+
+
+def test_qwen2_import_matches_torch_forward():
+    from deepspeed_tpu.models.hf import from_hf_model
+
+    hf_cfg = transformers.Qwen2Config(
+        vocab_size=128, hidden_size=64, intermediate_size=128,
+        num_hidden_layers=2, num_attention_heads=4, num_key_value_heads=2,
+        max_position_embeddings=64, rms_norm_eps=1e-5,
+        tie_word_embeddings=False, use_sliding_window=False)
+    hf = transformers.Qwen2ForCausalLM(hf_cfg).eval()
+    model, params = from_hf_model(hf, dtype=jnp.float32)
+    assert model.config.qkv_bias
+
+    ids = np.random.default_rng(5).integers(0, 128, (2, 16)).astype(np.int32)
+    with torch.no_grad():
+        ref = hf(torch.from_numpy(ids).long()).logits.numpy()
+    got = _logits_ours(model, params, ids)
+    np.testing.assert_allclose(got, ref, atol=2e-4)
+
+
+def test_mixtral_import_matches_torch_forward():
+    from deepspeed_tpu.models.hf import from_hf_model
+
+    hf_cfg = transformers.MixtralConfig(
+        vocab_size=128, hidden_size=64, intermediate_size=128,
+        num_hidden_layers=2, num_attention_heads=4, num_key_value_heads=2,
+        max_position_embeddings=64, rms_norm_eps=1e-5,
+        tie_word_embeddings=False, num_local_experts=4,
+        num_experts_per_tok=2, sliding_window=None)
+    hf = transformers.MixtralForCausalLM(hf_cfg).eval()
+    model, params = from_hf_model(hf, dtype=jnp.float32)
+
+    ids = np.random.default_rng(6).integers(0, 128, (1, 16)).astype(np.int32)
+    with torch.no_grad():
+        ref = hf(torch.from_numpy(ids).long()).logits.numpy()
+    got = _logits_ours(model, params, ids)
+    np.testing.assert_allclose(got, ref, atol=3e-4)
+
+
+def test_falcon_import_matches_torch_forward():
+    from deepspeed_tpu.models.hf import from_hf_model
+
+    hf_cfg = transformers.FalconConfig(
+        vocab_size=128, hidden_size=64, num_hidden_layers=2,
+        num_attention_heads=4, multi_query=True, parallel_attn=True,
+        new_decoder_architecture=False, bias=False, alibi=False,
+        max_position_embeddings=64, layer_norm_epsilon=1e-5)
+    hf = transformers.FalconForCausalLM(hf_cfg).eval()
+    model, params = from_hf_model(hf, dtype=jnp.float32)
+    assert model.config.kv_heads == 1 and model.config.parallel_block
+
+    ids = np.random.default_rng(7).integers(0, 128, (2, 16)).astype(np.int32)
+    with torch.no_grad():
+        ref = hf(torch.from_numpy(ids).long()).logits.numpy()
+    got = _logits_ours(model, params, ids)
+    np.testing.assert_allclose(got, ref, atol=2e-4)
+
+
+def test_bloom_import_matches_torch_forward():
+    from deepspeed_tpu.models.hf import from_hf_model
+
+    hf_cfg = transformers.BloomConfig(
+        vocab_size=128, hidden_size=64, n_layer=2, n_head=4,
+        layer_norm_epsilon=1e-5)
+    hf = transformers.BloomForCausalLM(hf_cfg).eval()
+    model, params = from_hf_model(hf, dtype=jnp.float32)
+    assert model.config.position_embedding == "alibi"
+    assert model.config.embed_norm and "ln_embed" in params
+
+    ids = np.random.default_rng(8).integers(0, 128, (2, 16)).astype(np.int32)
+    with torch.no_grad():
+        ref = hf(torch.from_numpy(ids).long()).logits.numpy()
+    got = _logits_ours(model, params, ids)
+    np.testing.assert_allclose(got, ref, atol=2e-4)
+
+
+def test_opt_import_matches_torch_forward():
+    from deepspeed_tpu.models.hf import from_hf_model
+
+    hf_cfg = transformers.OPTConfig(
+        vocab_size=128, hidden_size=64, ffn_dim=128, num_hidden_layers=2,
+        num_attention_heads=4, max_position_embeddings=64,
+        word_embed_proj_dim=64, do_layer_norm_before=True)
+    hf = transformers.OPTForCausalLM(hf_cfg).eval()
+    model, params = from_hf_model(hf, dtype=jnp.float32)
+    assert model.config.activation == "relu"
+
+    ids = np.random.default_rng(9).integers(0, 128, (2, 16)).astype(np.int32)
+    with torch.no_grad():
+        ref = hf(torch.from_numpy(ids).long()).logits.numpy()
+    got = _logits_ours(model, params, ids)
+    np.testing.assert_allclose(got, ref, atol=2e-4)
+
+
+def test_phi_import_matches_torch_forward():
+    from deepspeed_tpu.models.hf import from_hf_model
+
+    hf_cfg = transformers.PhiConfig(
+        vocab_size=128, hidden_size=64, intermediate_size=128,
+        num_hidden_layers=2, num_attention_heads=4,
+        max_position_embeddings=64, partial_rotary_factor=0.5,
+        layer_norm_eps=1e-5, tie_word_embeddings=False)
+    hf = transformers.PhiForCausalLM(hf_cfg).eval()
+    model, params = from_hf_model(hf, dtype=jnp.float32)
+    assert model.config.unembed_bias and "unembed_b" in params
+    assert model.config.rotary_pct == 0.5
+
+    ids = np.random.default_rng(10).integers(0, 128, (2, 16)).astype(np.int32)
+    with torch.no_grad():
+        ref = hf(torch.from_numpy(ids).long()).logits.numpy()
+    got = _logits_ours(model, params, ids)
+    np.testing.assert_allclose(got, ref, atol=2e-4)
