@@ -76,6 +76,11 @@ class RaggedInferenceConfig:
     #: iterations inside ONE jitted program (lax.scan) — one host→device
     #: dispatch per window instead of per token. 1 disables windowing.
     decode_window: int = 8
+    #: weight-only quantization (int8|int4): matmul weights live in HBM as
+    #: codes + group scales and dequantize TILE-BY-TILE inside the Pallas
+    #: quant matmul (ops/pallas/quant_matmul.py — the reference
+    #: mixed_gemm/cutlass role); norms/biases/embeddings stay exact.
+    quant_bits: int | None = None
 
 
 class InferenceEngineV2:
@@ -102,6 +107,15 @@ class InferenceEngineV2:
         # --- weights: same tree as the trainer, TP-sharded ---------------
         self.params, plan = load_tp_params(model, params, rng, topology,
                                            cfg.dtype)
+        if cfg.quant_bits:
+            if topology.mesh.size > 1:
+                raise ValueError("quant_bits serving requires a "
+                                 "single-device mesh (group quantization "
+                                 "is incompatible with TP sharding)")
+            if cfg.quant_bits not in (4, 8):
+                raise ValueError(f"quant_bits must be 4 or 8, got "
+                                 f"{cfg.quant_bits}")
+            self._quantize_weights(cfg.quant_bits)
         # stack homogeneous layers [L, ...] so the ragged forward can
         # lax.scan over depth — compile time stays flat vs num_layers
         # (reference inference_transformer_base.py:535's per-layer loop is
@@ -112,17 +126,23 @@ class InferenceEngineV2:
         self._scan_layers = (m.num_layers > 1 and
                              (not m.moe or (m.moe.moe_layer_freq or 1) == 1))
         if self._scan_layers:
-            is_p = lambda x: isinstance(x, P)
-            stacked_sh = jax.tree.map(
-                lambda p: NamedSharding(topology.mesh, P(None, *p)),
-                plan.param_specs["layer_0"], is_leaf=is_p)
             layers = [self.params.pop(f"layer_{i}")
                       for i in range(m.num_layers)]
-            # donate: each per-layer buffer frees as it is copied, so init
-            # never holds 2x the layer weights in HBM
+            stack_kw = {}
+            if not cfg.quant_bits:
+                # quantized trees changed structure vs the plan's specs,
+                # and their int8/uint8 buffers can't alias the stack —
+                # sharding/donation hints apply to the bf16 case only
+                is_p = lambda x: isinstance(x, P)
+                stack_kw["out_shardings"] = jax.tree.map(
+                    lambda p: NamedSharding(topology.mesh, P(None, *p)),
+                    plan.param_specs["layer_0"], is_leaf=is_p)
+                # donate: each per-layer buffer frees as it is copied, so
+                # init never holds 2x the layer weights in HBM
+                stack_kw["donate_argnums"] = (0,)
             self.params["layers_stacked"] = jax.jit(
                 lambda ls: jax.tree.map(lambda *xs: jnp.stack(xs), *ls),
-                out_shardings=stacked_sh, donate_argnums=(0,))(layers)
+                **stack_kw)(layers)
 
         # --- the paged KV pool -------------------------------------------
         # [L, 2, KV, P, D]: kv-head-major so the Pallas kernel's page DMA
@@ -169,6 +189,42 @@ class InferenceEngineV2:
             f"chunk={cfg.chunk} tp={topology.size('tensor')}")
 
     # ------------------------------------------------------------------
+    def _quantize_weights(self, bits: int) -> None:
+        """ZeRO-Inference for the ragged engine: matmul weights become
+        QuantLinear codes+scales consumed by the in-tile-dequant Pallas
+        GEMM (reference inference/v2/kernels/cutlass_ops/mixed_gemm/).
+        MoE expert weights stay bf16 (grouped GEMM path; not quantized
+        yet). The untied unembedding quantizes too; the embedding table
+        stays exact (it is gathered, not matmul'd)."""
+        from ..ops.pallas.quant_matmul import quantize_weight
+
+        m = self.mcfg
+
+        def q2d(w, K: int) -> Any:
+            w2 = jnp.asarray(w, jnp.float32).reshape(K, -1)
+            return quantize_weight(w2, bits=bits)
+
+        before = sum(l.nbytes for l in jax.tree.leaves(self.params))
+        E = m.hidden_size
+        for i in range(m.num_layers):
+            layer = self.params[f"layer_{i}"]
+            a = layer["attn"]
+            for k in ("wq", "wk", "wv"):
+                a[k] = q2d(a[k], E)                       # [E, (H|KV)*D]
+            a["wo"] = q2d(a["wo"], m.num_heads * m.head_dim)
+            if "ffn" in layer:
+                f = layer["ffn"]
+                for k in ("w_gate", "w_up"):
+                    if k in f:
+                        f[k] = q2d(f[k], E)
+                f["w_down"] = q2d(f["w_down"], m.ffn_size)
+        if not m.tie_embeddings:
+            self.params["unembed"] = q2d(self.params["unembed"], E)
+        after = sum(l.nbytes for l in jax.tree.leaves(self.params))
+        logger.info(f"engine_v2 int{bits} weights: "
+                    f"{before / 1e6:.0f}MB -> {after / 1e6:.0f}MB")
+
+    # ------------------------------------------------------------------
     # ragged forward (reads the TransformerLM param tree directly;
     # reference model_implementations/inference_transformer_base.py:48)
     # ------------------------------------------------------------------
@@ -180,6 +236,22 @@ class InferenceEngineV2:
         bs = cfg.block_size
         ctx = self.state.max_blocks_per_seq * bs
         H, KV, D = m.num_heads, m.kv_heads, m.head_dim
+
+        from ..ops.pallas.quant_matmul import QuantLinear, quant_matmul
+
+        def proj_in(h, w, nh):
+            """[S,T,E] @ [E,(nh,D)] -> [S,T,nh,D]; QuantLinear weights run
+            the in-tile-dequant Pallas GEMM."""
+            if isinstance(w, QuantLinear):
+                y = quant_matmul(h.reshape(-1, h.shape[-1]), w)
+                return y.reshape(S, T, nh, -1).astype(cfg.dtype)
+            return jnp.einsum("ste,ehd->sthd", h, w.astype(cfg.dtype))
+
+        def proj_out(o, w):
+            if isinstance(w, QuantLinear):
+                y = quant_matmul(o.reshape(S * T, -1), w)
+                return y.reshape(S, T, -1).astype(cfg.dtype)
+            return jnp.einsum("sthd,hde->ste", o, w.astype(cfg.dtype))
 
         x = params["embed"].astype(cfg.dtype)[token_ids]           # [S,T,E]
         if m.position_embedding == "learned":
@@ -217,14 +289,32 @@ class InferenceEngineV2:
                         p["moe"]["shared_gate"].astype(jnp.float32)))
                     out = out + g.astype(out.dtype) * shared
                 return out
-            return DenseFFN(m).apply({"params": p["ffn"]}, h)
+            f = p["ffn"]
+            if isinstance(f.get("w_up"), QuantLinear):
+                # NB: mirrors DenseFFN.__call__ (models/transformer.py) with
+                # the matmuls swapped for quant_matmul — keep the two in
+                # sync when touching activations/biases
+                h2d = h.reshape(-1, h.shape[-1])
+                if m.activation == "silu_glu":
+                    z = jax.nn.silu(quant_matmul(h2d, f["w_gate"])) \
+                        * quant_matmul(h2d, f["w_up"])
+                    out = quant_matmul(z.astype(cfg.dtype), f["w_down"])
+                else:
+                    z = quant_matmul(h2d, f["w_up"]) \
+                        + f["b_up"].astype(cfg.dtype)
+                    act = jax.nn.relu if m.activation == "relu" else jax.nn.gelu
+                    out = quant_matmul(act(z).astype(cfg.dtype),
+                                       f["w_down"]) \
+                        + f["b_down"].astype(cfg.dtype)
+                return out.reshape(h.shape).astype(cfg.dtype)
+            return DenseFFN(m).apply({"params": f}, h)
 
         def attention(p, kv, h):
             """QKV → scatter into pool → paged attention. Returns (o, kv)."""
             a = p["attn"]
-            q = jnp.einsum("ste,ehd->sthd", h, a["wq"].astype(cfg.dtype))
-            k = jnp.einsum("ste,ehd->sthd", h, a["wk"].astype(cfg.dtype))
-            v = jnp.einsum("ste,ehd->sthd", h, a["wv"].astype(cfg.dtype))
+            q = proj_in(h, a["wq"], H)
+            k = proj_in(h, a["wk"], KV)
+            v = proj_in(h, a["wv"], KV)
             if m.qkv_bias:
                 q = q + a["bq"].astype(cfg.dtype)
                 k = k + a["bk"].astype(cfg.dtype)
@@ -326,7 +416,7 @@ class InferenceEngineV2:
                 scores = jnp.where(mask, scores, jnp.finfo(jnp.float32).min)
                 w = jax.nn.softmax(scores, axis=-1).astype(V.dtype)
                 o = jnp.einsum("shtc,schd->sthd", w, V)
-            o = jnp.einsum("sthd,hde->ste", o, a["wo"].astype(cfg.dtype))
+            o = proj_out(o, a["wo"])
             if m.attn_out_bias:
                 o = o + a["bo"].astype(cfg.dtype)
             return o, kv
@@ -367,6 +457,8 @@ class InferenceEngineV2:
             x, sample_idx[:, None, None].astype(jnp.int32), axis=1)[:, 0]  # [S,E]
         if m.tie_embeddings:
             logits = jnp.einsum("se,ve->sv", last, params["embed"].astype(cfg.dtype))
+        elif isinstance(params["unembed"], QuantLinear):
+            logits = quant_matmul(last, params["unembed"])
         else:
             logits = jnp.einsum("se,ev->sv", last, params["unembed"].astype(cfg.dtype))
         if m.unembed_bias:
