@@ -6,6 +6,7 @@ TPU analogue of the reference elasticity package
 allowed chip count trains with the SAME global batch size, so restarts are
 mathematically transparent to convergence.
 """
+from .agent import ElasticAgent, elastic_batch_args  # noqa: F401
 from .elasticity import (  # noqa: F401
     ElasticityError,
     compute_elastic_config,

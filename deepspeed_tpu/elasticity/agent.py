@@ -1,0 +1,139 @@
+"""Elastic restart agent — failure detection + re-solve + relaunch.
+
+TPU-native analogue of the reference's ``DSElasticAgent``
+(/root/reference/deepspeed/elasticity/elastic_agent.py:32, which subclasses
+torch-elastic's LocalElasticAgent: on membership change, torch.distributed
+rendezvous restarts workers and training resumes from checkpoints). Under a
+single-controller SPMD runtime there is no per-worker rendezvous to heal —
+elasticity IS restart semantics: a supervisor process watches the training
+job, and on failure re-solves the device count against what is still
+available, relaunches, and the job auto-resumes from its latest checkpoint
+(runtime/checkpointing.py reshard-on-load makes the new topology a
+non-event).
+
+Contract with the training script: read the ``DS_TPU_ELASTIC_*`` env vars
+the agent exports (chip count + the batch split that keeps the global batch
+constant, straight from the elasticity solver), build the mesh accordingly,
+and ``load_checkpoint(ckpt_dir)`` if a ``latest`` tag exists.
+"""
+from __future__ import annotations
+
+import os
+import subprocess
+import time
+from typing import Callable
+
+from ..utils.logging import logger
+from .elasticity import (ElasticityConfig, ElasticityError,
+                         compute_elastic_config)
+
+
+def _batch_split(ds_config: dict, batch: int, valid: list[int],
+                 n_dp: int) -> dict:
+    """(micro, GAS) for ``n_dp`` data-parallel replicas preserving the
+    solved global batch: micro * gas * n_dp == final_batch_size.
+    Micro candidates come through ElasticityConfig so dataclass defaults
+    apply exactly as they did in the solver."""
+    if n_dp not in valid:
+        raise ElasticityError(f"dp={n_dp} not in valid set {valid}")
+    per_replica = batch // n_dp
+    micros = sorted(ElasticityConfig.from_dict(
+        ds_config["elasticity"]).micro_batch_sizes)
+    fitting = [m for m in micros if per_replica % m == 0]
+    if not fitting:
+        raise ElasticityError(
+            f"no configured micro batch divides per-replica batch "
+            f"{per_replica}")
+    micro = fitting[-1]
+    return {"train_batch_size": batch,
+            "train_micro_batch_size_per_gpu": micro,
+            "gradient_accumulation_steps": per_replica // micro}
+
+
+def elastic_batch_args(ds_config: dict, n_dp: int) -> dict:
+    """Public helper: the batch split for ``n_dp`` DP replicas (equal to
+    the chip count when model_parallel_size is 1)."""
+    batch, valid = compute_elastic_config(ds_config)[:2]
+    return _batch_split(ds_config, batch, valid, n_dp)
+
+
+class ElasticAgent:
+    """Supervise a training command with restart-based elasticity.
+
+    ``available_chips_fn`` is polled before every (re)launch — in
+    production it reflects the live resource pool (hostfile re-parse,
+    slice health probe); tests simulate shrink/grow.
+    """
+
+    def __init__(self, cmd, ds_config: dict, *,
+                 available_chips_fn: Callable[[], int],
+                 max_restarts: int = 10, backoff_s: float = 1.0,
+                 env: dict | None = None):
+        """``cmd``: the launch argv, or a callable ``solved_dict ->
+        argv`` so process topology (e.g. --nproc_per_node) tracks each
+        re-solve."""
+        self.cmd = cmd
+        self.ds_config = ds_config
+        self.available_chips_fn = available_chips_fn
+        self.max_restarts = max_restarts
+        self.backoff_s = backoff_s
+        self.extra_env = dict(env or {})
+        self.restart_count = 0
+        self.history: list[dict] = []     # per-incarnation records
+
+    # ------------------------------------------------------------------
+    def _resolve(self) -> dict:
+        """Largest valid topology fitting the live pool. The solver works
+        in DP units; physical chips = dp * model_parallel_size."""
+        avail = int(self.available_chips_fn())
+        mp = max(1, ElasticityConfig.from_dict(
+            self.ds_config["elasticity"]).model_parallel_size)
+        batch, valid = compute_elastic_config(self.ds_config)[:2]
+        usable = [d for d in valid if d * mp <= avail]
+        if not usable:
+            raise ElasticityError(
+                f"no valid topology fits the {avail} available chips "
+                f"(valid dp sizes: {valid}, model parallel {mp})")
+        dp = max(usable)
+        args = _batch_split(self.ds_config, batch, valid, dp)
+        return {"chips": dp * mp, "dp": dp, **args}
+
+    def _child_env(self, solved: dict) -> dict:
+        env = {**os.environ, **self.extra_env}
+        env["DS_TPU_ELASTIC_CHIPS"] = str(solved["chips"])
+        env["DS_TPU_ELASTIC_BATCH"] = str(solved["train_batch_size"])
+        env["DS_TPU_ELASTIC_MICRO_BS"] = str(
+            solved["train_micro_batch_size_per_gpu"])
+        env["DS_TPU_ELASTIC_GAS"] = str(
+            solved["gradient_accumulation_steps"])
+        env["DS_TPU_ELASTIC_RESTART"] = str(self.restart_count)
+        return env
+
+    def run(self) -> int:
+        """Launch; on failure re-solve + relaunch until success or the
+        restart budget is spent. Returns the final exit code."""
+        while True:
+            solved = self._resolve()
+            self.history.append({"restart": self.restart_count, **solved})
+            logger.info(
+                f"elastic agent: launching with {solved['chips']} chips "
+                f"(global batch {solved['train_batch_size']} = "
+                f"{solved['train_micro_batch_size_per_gpu']} micro x "
+                f"{solved['gradient_accumulation_steps']} gas x "
+                f"{solved['dp']} dp), restart {self.restart_count}")
+            argv = self.cmd(solved) if callable(self.cmd) else list(self.cmd)
+            proc = subprocess.run(argv, env=self._child_env(solved))
+            if proc.returncode == 0:
+                logger.info("elastic agent: job completed")
+                return 0
+            self.restart_count += 1
+            if self.restart_count > self.max_restarts:
+                logger.error(
+                    f"elastic agent: giving up after {self.max_restarts} "
+                    f"restarts (last exit code {proc.returncode})")
+                return proc.returncode
+            logger.warning(
+                f"elastic agent: worker exited {proc.returncode}; "
+                f"re-solving and relaunching "
+                f"({self.restart_count}/{self.max_restarts})")
+            time.sleep(self.backoff_s)

@@ -52,6 +52,10 @@ def parse_args(argv=None):
     p.add_argument("--no_python", action="store_true")
     p.add_argument("--force_multi", action="store_true")
     p.add_argument("--elastic_training", action="store_true")
+    p.add_argument("--elastic_restarts", type=int, default=0,
+                   help="supervise the job with the elastic restart agent: "
+                        "on failure, re-parse the hostfile, re-solve the "
+                        "chip count, relaunch (resume from checkpoints)")
     p.add_argument("--deepspeed_config", type=str, default=None)
     p.add_argument("user_script", type=str)
     p.add_argument("user_args", nargs=argparse.REMAINDER)
@@ -175,14 +179,23 @@ def resolve_elastic_nodes(args, resources) -> "OrderedDict[str, int]":
     return OrderedDict(list(resources.items())[:n])
 
 
-def main(argv=None) -> int:
-    args = parse_args(argv)
-    resources = fetch_hostfile_or_local(args)
-    active = parse_inclusion_exclusion(resources, args.include, args.exclude)
+def _resolve_pool(args) -> "OrderedDict[str, int]":
+    """Hostfile + --include/--exclude + --num_nodes/--num_gpus overrides —
+    the SAME pool derivation for the initial launch and every elastic
+    re-solve (the agent re-parses through this, so hostfile edits shrink
+    or grow the live pool)."""
+    active = parse_inclusion_exclusion(fetch_hostfile_or_local(args),
+                                       args.include, args.exclude)
     if args.num_nodes > 0:
         active = OrderedDict(list(active.items())[:args.num_nodes])
     if args.num_gpus > 0:
         active = OrderedDict((h, args.num_gpus) for h in active)
+    return active
+
+
+def main(argv=None) -> int:
+    args = parse_args(argv)
+    active = _resolve_pool(args)
     active = resolve_elastic_nodes(args, active)
     if not active:
         raise ValueError("no usable hosts after filtering")
@@ -193,16 +206,47 @@ def main(argv=None) -> int:
 
     if not multi_node:
         host, nproc = next(iter(active.items()))
-        cmd = [sys.executable, "-u", "-m", "deepspeed_tpu.launcher.launch",
-               "--nnodes", "1", "--node_rank", "0",
-               "--nproc_per_node", str(nproc),
-               "--master_addr", args.master_addr,
-               "--master_port", str(args.master_port)] \
-            + (["--module"] if args.module else []) \
-            + (["--no_python"] if args.no_python else []) \
-            + [args.user_script] + list(args.user_args)
+
+        def build_cmd(n_proc: int) -> list[str]:
+            return [sys.executable, "-u", "-m",
+                    "deepspeed_tpu.launcher.launch",
+                    "--nnodes", "1", "--node_rank", "0",
+                    "--nproc_per_node", str(n_proc),
+                    "--master_addr", args.master_addr,
+                    "--master_port", str(args.master_port)] \
+                + (["--module"] if args.module else []) \
+                + (["--no_python"] if args.no_python else []) \
+                + [args.user_script] + list(args.user_args)
+
+        cmd = build_cmd(nproc)
         logger.info(f"single-node launch on {host}: {' '.join(cmd)}")
+        if args.elastic_restarts > 0:
+            if args.deepspeed_config is None:
+                raise ValueError("--elastic_restarts needs --deepspeed_config")
+            from ..elasticity import ElasticAgent
+
+            with open(args.deepspeed_config) as f:
+                ds_config = json.load(f)
+
+            def available():
+                # re-derive the (possibly shrunken) pool per launch, with
+                # the same overrides the initial launch applied
+                return sum(_resolve_pool(args).values())
+
+            # process topology tracks each re-solve (worker count ==
+            # solved chip count on the single-node path)
+            return ElasticAgent(
+                lambda solved: build_cmd(min(solved["chips"], nproc)),
+                ds_config, available_chips_fn=available,
+                max_restarts=args.elastic_restarts).run()
         return subprocess.call(cmd)
+
+    if args.elastic_restarts > 0:
+        raise NotImplementedError(
+            "--elastic_restarts supervises the single-node path only for "
+            "now; multi-node jobs need the agent running beside the "
+            "MultiNodeRunner backend — run without it rather than "
+            "believing restarts are armed")
 
     nprocs = set(active.values())
     if len(nprocs) > 1:
