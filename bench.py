@@ -166,6 +166,11 @@ def main():
     warmup = int(os.environ.get("BENCH_WARMUP", "3"))
     attn = os.environ.get("BENCH_ATTN", "auto")   # auto | pallas | xla
     remat = os.environ.get("BENCH_REMAT", "0") == "1"
+    # large-model configs (BASELINE north star is 7B-class): offload the
+    # optimizer to the host (ZeRO-Offload) so params far beyond the
+    # device-optimizer budget train on one chip, e.g.
+    #   BENCH_MODEL=gpt2-1.5b BENCH_REMAT=1 BENCH_OFFLOAD=cpu
+    offload = os.environ.get("BENCH_OFFLOAD", "none")  # none | cpu | nvme
 
     n_dev = len(jax.devices())
     overrides = {"attn_impl": attn}
@@ -180,7 +185,10 @@ def main():
             "gradient_accumulation_steps": 1,
             "optimizer": {"type": "AdamW", "params": {"lr": 1e-4,
                                                       "weight_decay": 0.01}},
-            "zero_optimization": {"stage": 3 if n_dev > 1 else 1},
+            "zero_optimization": {
+                "stage": 3 if n_dev > 1 else 1,
+                **({"offload_optimizer": {"device": offload}}
+                   if offload != "none" else {})},
             "steps_per_print": 10_000,
         },
         topology=topo,

@@ -71,6 +71,40 @@ def op_breakdown(log_dir: str, *, by_base_name: bool = True,
     return dict(totals)
 
 
+#: HLO name fragments → collective kind (CommsLogger op names)
+_COLLECTIVE_KINDS = (
+    ("all-reduce", "all_reduce"),
+    ("reduce-scatter", "reduce_scatter"),
+    ("all-gather", "all_gather"),
+    ("all-to-all", "all_to_all"),
+    ("collective-permute", "ppermute"),
+)
+
+
+def collective_breakdown(log_dir: str | None = None, *,
+                         totals: dict[str, float] | None = None,
+                         device_substr: str = "TPU") -> dict[str, float]:
+    """Measured device milliseconds per collective KIND from the newest
+    trace — the half of the comms-logging story the bandwidth model can't
+    see (XLA owns wall time; CommsLogger owns sizes). Feed the result to
+    ``comm.validate_against_trace`` to compare model vs reality.
+
+    Only device planes carry per-op timings: real-TPU traces have them;
+    CPU-backend traces expose host threads only, so the result is empty
+    there (the model side of the validation still works).
+    ``totals`` bypasses the trace read (tests / pre-aggregated data)."""
+    if totals is None:
+        totals = op_breakdown(log_dir, device_substr=device_substr)
+    out: dict[str, float] = collections.Counter()
+    for name, ms in totals.items():
+        low = name.lower()
+        for frag, kind in _COLLECTIVE_KINDS:
+            if frag in low:
+                out[kind] += ms
+                break
+    return dict(out)
+
+
 def print_breakdown(log_dir: str, top: int = 20, steps: int = 1,
                     device_substr: str = "TPU") -> str:
     """Human-readable top-N op table (ms per step)."""

@@ -6,6 +6,8 @@ import jax
 import jax.numpy as jnp
 import pytest
 
+pytestmark = pytest.mark.slow  # multi-minute: engine jit compiles
+
 import deepspeed_tpu as ds
 from deepspeed_tpu.models import build_model
 from deepspeed_tpu.parallel.topology import MeshTopology

@@ -3,6 +3,8 @@ analogue)."""
 import numpy as np
 import pytest
 
+pytestmark = pytest.mark.slow  # multi-minute: engine jit compiles
+
 import deepspeed_tpu as ds
 from deepspeed_tpu.autotuning import (Autotuner, GridSearchTuner,
                                       ModelBasedTuner, RandomTuner, autotune)

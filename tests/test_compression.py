@@ -5,6 +5,8 @@ import jax.numpy as jnp
 import numpy as np
 import pytest
 
+pytestmark = pytest.mark.slow  # multi-minute: engine jit compiles
+
 import deepspeed_tpu as ds
 from deepspeed_tpu.compression import (CompressionConfig, CompressionManager,
                                        group_fake_quantize, head_prune_mask,

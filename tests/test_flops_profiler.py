@@ -6,6 +6,8 @@ import jax
 import jax.numpy as jnp
 import pytest
 
+pytestmark = pytest.mark.slow  # multi-minute: engine jit compiles
+
 from deepspeed_tpu.models import build_model
 from deepspeed_tpu.profiling import (FlopsProfiler, cost_analysis,
                                      get_model_profile, human_flops,

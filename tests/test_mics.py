@@ -3,6 +3,8 @@ runtime/zero/mics.py:64 MiCS_Init / :362 MiCS_Optimizer semantics)."""
 import numpy as np
 import pytest
 
+pytestmark = pytest.mark.slow  # multi-minute: engine jit compiles
+
 import deepspeed_tpu as ds
 from deepspeed_tpu.models import build_model
 

@@ -226,6 +226,7 @@ def test_chunked_cross_entropy_matches_dense():
         L.CE_CHUNK = old
 
 
+@pytest.mark.slow  # full engine bring-up (~35s)
 def test_zero_namespace_compat():
     """deepspeed_tpu.zero.Init / GatheredParameters shims: reference-shaped
     call sites run unchanged and training proceeds normally."""

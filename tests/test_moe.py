@@ -4,6 +4,8 @@ import jax.numpy as jnp
 import numpy as np
 import pytest
 
+pytestmark = pytest.mark.slow  # multi-minute: engine jit compiles
+
 from deepspeed_tpu.moe import (
     MoE,
     compute_capacity,

@@ -4,6 +4,8 @@ megatron TP tests)."""
 import numpy as np
 import pytest
 
+pytestmark = pytest.mark.slow  # multi-minute: engine jit compiles
+
 import deepspeed_tpu as ds
 from deepspeed_tpu.models import build_model
 

@@ -5,6 +5,8 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 import pytest
+
+pytestmark = pytest.mark.slow  # multi-minute: engine jit compiles
 from jax.sharding import Mesh
 
 import deepspeed_tpu as ds

@@ -4,6 +4,8 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 import pytest
+
+pytestmark = pytest.mark.slow  # multi-minute: engine jit compiles
 from jax.sharding import Mesh
 
 from deepspeed_tpu.ops.attention import _xla_attention
