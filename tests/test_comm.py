@@ -92,6 +92,7 @@ def test_world_size_helpers():
     assert comm.get_rank() == 0
 
 
+@pytest.mark.slow  # profiler trace capture + parse (~26s)
 def test_comms_model_vs_trace(tmp_path):
     """The bandwidth model cross-checks against a real profiler trace:
     modeled sizes (CommsLogger) pair with measured device time per

@@ -32,6 +32,7 @@ def test_forward_matches_xla(causal, gqa):
                                atol=2e-5, rtol=2e-5)
 
 
+@pytest.mark.slow  # merged-bwd compile (~14s)
 def test_grads_match_xla():
     B, S, H, D = 1, 256, 2, 64
     KV = 1  # GQA group of 2
