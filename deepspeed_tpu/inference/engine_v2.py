@@ -100,6 +100,18 @@ class InferenceEngineV2:
         self._rules = default_activation_rules(topology)
 
         max_blocks_per_seq = -(-cfg.max_seq_len // cfg.block_size)
+        # mistral rolling KV buffer: a sliding-window model only ever needs
+        # the last window (+ the step being written) resident, so the block
+        # table shrinks to a ring of nwin slots and long sequences stop
+        # pinning whole-context KV (reference mistral rolling cache)
+        self._ring_tokens = 0
+        W = model.config.sliding_window
+        if W and W < cfg.max_seq_len:
+            step_max = max(cfg.chunk, max(cfg.decode_window, 1))
+            nwin = -(-(W + step_max) // cfg.block_size) + 1
+            if nwin < max_blocks_per_seq:
+                max_blocks_per_seq = nwin
+                self._ring_tokens = nwin * cfg.block_size
         self.state = StateManager(cfg.num_blocks, cfg.block_size, cfg.max_seqs,
                                   max_blocks_per_seq)
         self.scheduler = SplitFuseScheduler(self.state, cfg.chunk)
@@ -330,12 +342,13 @@ class InferenceEngineV2:
             kv = kv.at[1, :, flat_slots].set(
                 v.reshape(-1, KV, D).astype(kv.dtype))
 
-            # Sliding windows mask correctly on every path (and the Pallas
-            # kernel skips out-of-window pages), but blocks before the
-            # window are NOT yet reclaimed — a mistral rolling-buffer page
-            # map is future work; the cost is pool capacity, not
-            # correctness.
+            # Sliding windows mask on every path; windowed models also
+            # serve from a ROLLING block table (self._ring_tokens > 0) so
+            # out-of-window KV blocks are reused instead of pinned — see
+            # the ring sizing in __init__ and the wrap-position recovery
+            # below/in the kernel.
             win = m.sliding_window
+            ring = self._ring_tokens
             if T == 1 and self._pallas_decode:
                 # decode: Pallas kernel pages K/V straight out of the pool
                 mesh = self.topology.mesh
@@ -346,7 +359,8 @@ class InferenceEngineV2:
 
                     o = shard_map(
                         lambda qq, kk, vv, bt, sl: paged_decode_attention(
-                            qq, kk, vv, bt, sl, block_size=bs, window=win),
+                            qq, kk, vv, bt, sl, block_size=bs, window=win,
+                            ring_tokens=ring),
                         mesh=mesh,
                         in_specs=(P(None, "tensor", None),
                                   P("tensor", None, None),
@@ -359,7 +373,7 @@ class InferenceEngineV2:
                 else:
                     o = paged_decode_attention(
                         q[:, 0], kv[0], kv[1], block_tables, seq_lens,
-                        block_size=bs, window=win)[:, None]        # [S,1,H,D]
+                        block_size=bs, window=win, ring_tokens=ring)[:, None]        # [S,1,H,D]
             elif T > 1 and self._pallas_decode:
                 # prefill chunks: blocked flash over the paged pool (the
                 # reference's blocked_flash.py:64 role). SplitFuse chunks
@@ -373,7 +387,8 @@ class InferenceEngineV2:
                     o = shard_map(
                         lambda qq, kk, vv, bt, sl, st:
                         paged_prefill_attention(qq, kk, vv, bt, sl, st,
-                                                block_size=bs, window=win),
+                                                block_size=bs, window=win,
+                                                ring_tokens=ring),
                         mesh=mesh,
                         in_specs=(P(None, None, "tensor", None),
                                   P("tensor", None, None),
@@ -385,7 +400,7 @@ class InferenceEngineV2:
                 else:
                     o = paged_prefill_attention(
                         q, kv[0], kv[1], block_tables, seq_lens, starts,
-                        block_size=bs, window=win)
+                        block_size=bs, window=win, ring_tokens=ring)
             else:
                 # fallback (alibi / odd geometries): gather each slot's
                 # pages. Advanced-index placement: result is
@@ -398,17 +413,30 @@ class InferenceEngineV2:
 
                 scores = jnp.einsum("sthd,schd->shtc", q, K).astype(jnp.float32)
                 scores = scores / (D ** 0.5)
+                if self._ring_tokens:
+                    # rolling buffer: recover each gathered offset's
+                    # absolute position (same algebra as the kernel)
+                    nwin = self._ring_tokens // bs
+                    b_latest = jnp.maximum(seq_lens - 1, 0)[:, None] // bs
+                    jidx = (jnp.arange(ctx) // bs)[None, :]
+                    b_j = b_latest - (b_latest - jidx) % nwin
+                    raw = b_j * bs + (jnp.arange(ctx) % bs)[None, :]
+                    cpos = jnp.where(raw < seq_lens[:, None], raw,
+                                     raw - self._ring_tokens)       # [S,ctx]
+                    valid = (cpos >= 0)[:, None, None, :]
+                else:
+                    # pages are position-ordered: context index j IS
+                    # absolute position j
+                    cpos = jnp.broadcast_to(jnp.arange(ctx)[None, :],
+                                            (S, ctx))
+                    valid = (cpos < seq_lens[:, None])[:, None, None, :]
                 if m.position_embedding == "alibi":
                     from ..models.transformer import alibi_slopes
 
                     slopes = alibi_slopes(H)                       # [H]
-                    rel = (jnp.arange(ctx, dtype=jnp.float32)[None, None, None, :]
+                    rel = (cpos.astype(jnp.float32)[:, None, None, :]
                            - positions[:, None, :, None].astype(jnp.float32))
                     scores = scores + slopes[None, :, None, None] * rel
-                # pages are position-ordered, so context index j IS absolute
-                # position j: valid iff j < seq_len, causal iff j <= query pos
-                cpos = jnp.arange(ctx)[None, :]
-                valid = (cpos < seq_lens[:, None])[:, None, None, :]
                 causal = cpos[:, None, :] <= positions[:, :, None]  # [S,T,ctx]
                 if win:
                     causal &= cpos[:, None, :] > positions[:, :, None] - win
@@ -498,8 +526,10 @@ class InferenceEngineV2:
                     active, rng):
                 def stepfn(carry, _):
                     kv_pool, tok, pos, lens, rng = carry
+                    mb = self.state.max_blocks_per_seq
                     blk = jnp.take_along_axis(
-                        block_tables, (pos // bs)[:, None], axis=1)[:, 0]
+                        block_tables, ((pos // bs) % mb)[:, None],
+                        axis=1)[:, 0]      # ring slot (mod no-op linear)
                     # inactive slots carry zeroed tables → blk 0 → trash
                     slot = blk * bs + pos % bs
                     with nn.logical_axis_rules(self._rules):

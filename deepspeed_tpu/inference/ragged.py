@@ -99,13 +99,22 @@ class StateManager:
         self.allocator = BlockedAllocator(num_blocks)
         self.block_size = block_size
         self.max_seqs = max_seqs
-        # static block-table width → step programs never recompile
+        # static block-table width → step programs never recompile. For
+        # sliding-window models the engine sizes this to the ROLLING
+        # buffer (ceil((window + step) / bs) + 1 slots): physical slot for
+        # absolute position p is (p // bs) % max_blocks_per_seq, so a
+        # sequence never pins more than one window of KV (the mistral
+        # rolling cache; reference mistral model impl). Linear mode is the
+        # same formula — the mod never fires because p // bs stays below
+        # the table width.
         self.max_blocks_per_seq = max_blocks_per_seq
         self.seqs: dict[int, SequenceDescriptor] = {}
         self._free_slots = list(range(max_seqs))
 
     def _blocks_for(self, n_tokens: int) -> int:
-        return -(-n_tokens // self.block_size)
+        # a sequence can never OWN more slots than the table has — the
+        # rolling buffer reuses them past that point
+        return min(-(-n_tokens // self.block_size), self.max_blocks_per_seq)
 
     def can_admit(self, prompt_len: int, max_new_tokens: int = 0) -> bool:
         """Admission requires the WORST-CASE block budget (prompt + all

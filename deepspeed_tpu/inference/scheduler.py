@@ -49,7 +49,8 @@ class SplitFuseScheduler:
             plan.positions[s, :n] = np.arange(start_pos, start_pos + n)
             for j in range(n):
                 pos = start_pos + j
-                blk = seq.blocks[pos // bs]
+                # rolling-buffer slot (mod is a no-op in linear mode)
+                blk = seq.blocks[(pos // bs) % max_blocks]
                 plan.slot_map[s, j] = blk * bs + pos % bs
             plan.active[s, :n] = True
             plan.block_tables[s, :len(seq.blocks)] = seq.blocks

@@ -55,12 +55,16 @@ def paged_attention_usable(num_heads: int, kv_heads: int, head_dim: int,
 
 def _paged_attn_kernel(tables_ref, lens_ref, starts_ref, q_ref, k_ref, v_ref,
                        o_ref, m_scr, l_scr, acc_scr, *, block_size: int,
-                       scale: float, G: int, window: int):
+                       scale: float, G: int, window: int, ring_tokens: int):
     """One online-softmax kernel serves prefill AND decode: decode is the
     T=1 special case (starts = seq_len - 1 makes the causal mask collapse
     to the plain validity mask ctx < seq_len). ``window`` > 0 adds the
     mistral sliding window (query p attends (p - window, p]) and skips
-    pages wholly before any row's window."""
+    pages wholly before any row's window. ``ring_tokens`` > 0 means the
+    block table is a ROLLING buffer of ring_tokens/block_size slots:
+    table slot j holds the newest block b with b % nwin == j, and offsets
+    past seq_len in the newest block still belong to the previous wrap —
+    their positions are recovered per-offset and masked by the window."""
     s = pl.program_id(0)
     j = pl.program_id(2)
     nj = pl.num_programs(2)
@@ -73,12 +77,18 @@ def _paged_attn_kernel(tables_ref, lens_ref, starts_ref, q_ref, k_ref, v_ref,
 
     seq_len = lens_ref[s]
     start = starts_ref[s]
-    page_start = j * block_size
-
-    run = page_start < seq_len
-    if window:
-        # the earliest key any row of this chunk can see is start-window+1
-        run &= page_start + block_size > start - window + 1
+    if ring_tokens:
+        nwin = ring_tokens // block_size
+        b_latest = jnp.maximum(seq_len - 1, 0) // block_size
+        b_j = b_latest - (b_latest - j) % nwin   # jnp %: floor semantics
+        page_start = b_j * block_size
+        run = (seq_len > 0) & (b_j >= 0)
+    else:
+        page_start = j * block_size
+        run = page_start < seq_len
+        if window:
+            # earliest key any row of this chunk can see is start-window+1
+            run &= page_start + block_size > start - window + 1
 
     @pl.when(run)
     def _body():
@@ -96,7 +106,13 @@ def _paged_attn_kernel(tables_ref, lens_ref, starts_ref, q_ref, k_ref, v_ref,
             jnp.int32, scores.shape, 0) // G
         ctx = page_start + jax.lax.broadcasted_iota(
             jnp.int32, scores.shape, 1)
-        mask = (ctx <= qpos) & (ctx < seq_len)
+        if ring_tokens:
+            # offsets past seq_len in the newest block are the PREVIOUS
+            # wrap (ring_tokens older); never-written offsets land < 0
+            ctx = jnp.where(ctx < seq_len, ctx, ctx - ring_tokens)
+            mask = (ctx >= 0) & (ctx <= qpos)
+        else:
+            mask = (ctx <= qpos) & (ctx < seq_len)
         if window:
             mask &= ctx > qpos - window
         scores = jnp.where(mask, scores, NEG_INF)
@@ -122,6 +138,7 @@ def paged_prefill_attention(q, k_pool, v_pool, block_tables, seq_lens,
                             chunk_starts, *, block_size: int,
                             scale: float | None = None,
                             window: int | None = None,
+                            ring_tokens: int | None = None,
                             interpret: bool | None = None):
     """Chunked-prefill attention against a paged KV pool — the blocked-
     flash half of the reference's ragged attention
@@ -147,6 +164,13 @@ def paged_prefill_attention(q, k_pool, v_pool, block_tables, seq_lens,
         raise ValueError(f"GQA needs H ({H}) divisible by KV ({KV})")
     G = H // KV
     max_pages = block_tables.shape[1]
+    if ring_tokens and not window:
+        raise ValueError("a rolling KV buffer only retains the last "
+                         "ring_tokens positions — it requires a sliding "
+                         "window that masks everything older")
+    if ring_tokens and ring_tokens % block_size:
+        raise ValueError(f"ring_tokens {ring_tokens} must be a multiple of "
+                         f"block_size {block_size}")
     if scale is None:
         scale = 1.0 / (D ** 0.5)
     if interpret is None:
@@ -179,7 +203,8 @@ def paged_prefill_attention(q, k_pool, v_pool, block_tables, seq_lens,
     )
     out = pl.pallas_call(
         functools.partial(_paged_attn_kernel, block_size=block_size,
-                          scale=float(scale), G=G, window=int(window or 0)),
+                          scale=float(scale), G=G, window=int(window or 0),
+                          ring_tokens=int(ring_tokens or 0)),
         grid_spec=grid_spec,
         out_shape=jax.ShapeDtypeStruct((S, KV, T * G, D), q.dtype),
         interpret=interpret,
@@ -192,6 +217,7 @@ def paged_prefill_attention(q, k_pool, v_pool, block_tables, seq_lens,
 def paged_decode_attention(q, k_pool, v_pool, block_tables, seq_lens, *,
                            block_size: int, scale: float | None = None,
                            window: int | None = None,
+                           ring_tokens: int | None = None,
                            interpret: bool | None = None):
     """One-token-per-sequence attention against a paged KV pool: the T=1
     case of :func:`paged_prefill_attention` with the query at position
@@ -207,5 +233,5 @@ def paged_decode_attention(q, k_pool, v_pool, block_tables, seq_lens, *,
     out = paged_prefill_attention(
         q[:, None], k_pool, v_pool, block_tables, seq_lens, starts,
         block_size=block_size, scale=scale, window=window,
-        interpret=interpret)
+        ring_tokens=ring_tokens, interpret=interpret)
     return out[:, 0]
