@@ -76,11 +76,12 @@ class RaggedInferenceConfig:
     #: iterations inside ONE jitted program (lax.scan) — one host→device
     #: dispatch per window instead of per token. 1 disables windowing.
     decode_window: int = 8
-    #: weight-only quantization (int8|int4): matmul weights live in HBM as
-    #: codes + group scales and dequantize TILE-BY-TILE inside the Pallas
-    #: quant matmul (ops/pallas/quant_matmul.py — the reference
-    #: mixed_gemm/cutlass role); norms/biases/embeddings stay exact.
-    quant_bits: int | None = None
+    #: weight-only quantization (8 | 4 | "fp8"): matmul weights live in HBM
+    #: as codes + group scales and dequantize TILE-BY-TILE inside the
+    #: Pallas quant matmul (ops/pallas/quant_matmul.py — the reference
+    #: mixed_gemm / FP6-LLM cuda_linear role); norms/biases/embeddings
+    #: stay exact.
+    quant_bits: int | str | None = None
 
 
 class InferenceEngineV2:
@@ -124,8 +125,8 @@ class InferenceEngineV2:
                 raise ValueError("quant_bits serving requires a "
                                  "single-device mesh (group quantization "
                                  "is incompatible with TP sharding)")
-            if cfg.quant_bits not in (4, 8):
-                raise ValueError(f"quant_bits must be 4 or 8, got "
+            if cfg.quant_bits not in (4, 8, "fp8"):
+                raise ValueError(f"quant_bits must be 4, 8 or 'fp8', got "
                                  f"{cfg.quant_bits}")
             self._quantize_weights(cfg.quant_bits)
         # stack homogeneous layers [L, ...] so the ragged forward can
@@ -171,12 +172,13 @@ class InferenceEngineV2:
 
         # alibi needs a positional bias inside the kernel — XLA path only.
         # pallas_call has no GSPMD rule, so multi-device meshes run the
-        # kernel per-shard through shard_map over the tensor axis: q sharded
-        # on query heads, the pool on kv heads (the TP slicing the weights
-        # already use). Requires head counts divisible by tp and no other
-        # live mesh axes.
-        tp_ok = (topology.mesh.size == tp
-                 and m.num_heads % tp == 0 and m.kv_heads % tp == 0)
+        # kernel per-shard through shard_map over ALL live axes: q sharded
+        # on query heads over 'tensor', the pool on kv heads (the TP
+        # slicing the weights already use), and every other axis manual
+        # with replicated specs — legal because this engine replicates all
+        # serving state across non-tensor axes (each data member computes
+        # the same thing, which is the multi-replica serving layout).
+        tp_ok = (m.num_heads % tp == 0 and m.kv_heads % tp == 0)
         pallas_ok = (paged_attention_usable(m.num_heads, m.kv_heads,
                                             m.head_dim, cfg.block_size)
                      and m.position_embedding != "alibi"
@@ -187,8 +189,7 @@ class InferenceEngineV2:
                 "(decode + prefill) do not "
                 "support this setup (needs head_dim in {64,128,256}, "
                 "block_size % 8 == 0, heads % kv_heads == 0, no alibi, and "
-                "a mesh that is single-device or tensor-only with head "
-                "counts divisible by tp)")
+                "head counts divisible by the tensor axis)")
         self._pallas_decode = pallas_ok if cfg.use_pallas_decode is None \
             else cfg.use_pallas_decode
 

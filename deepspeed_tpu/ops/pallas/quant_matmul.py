@@ -54,10 +54,13 @@ jax.tree_util.register_pytree_node(
 )
 
 
-def quantize_weight(w: jax.Array, bits: int = 8,
+def quantize_weight(w: jax.Array, bits: int | str = 8,
                     group_size: int | None = None) -> QuantLinear:
-    """Symmetric per-(K-group, column) quantization of a [K, N] weight."""
-    assert bits in (4, 8), bits
+    """Symmetric per-(K-group, column) quantization of a [K, N] weight.
+    ``bits``: 8 | 4 | "fp8" (float8_e4m3 codes — same bytes as int8 with
+    per-element dynamic range; the FP6-LLM/fp-quantizer role on a TPU
+    whose native float8 dtype makes bit-packing unnecessary)."""
+    assert bits in (4, 8, "fp8"), bits
     K, N = w.shape
     # pad N to the TPU lane width so every kernel tile is aligned (GPT-2's
     # 50257 vocab etc.); aux shape keeps the LOGICAL N — dequantize and
@@ -77,8 +80,13 @@ def quantize_weight(w: jax.Array, bits: int = 8,
         raise ValueError("int4 needs an even group_size (K-pairs pack)")
     w32 = w.astype(jnp.float32).reshape(K // group_size, group_size,
                                         N + n_pad)
-    qmax = float(2 ** (bits - 1) - 1)
     amax = jnp.max(jnp.abs(w32), axis=1, keepdims=True)
+    if bits == "fp8":
+        scale = jnp.where(amax > 0, amax / 448.0, 1.0)     # e4m3 max
+        q = (w32 / scale).reshape(K, N + n_pad).astype(jnp.float8_e4m3fn)
+        return QuantLinear(q, scale[:, 0, :], bits, group_size, (K, N),
+                           w.dtype)
+    qmax = float(2 ** (bits - 1) - 1)
     scale = jnp.where(amax > 0, amax / qmax, 1.0)          # [K/G, 1, N]
     q = jnp.clip(jnp.round(w32 / scale), -qmax - 1, qmax)
     q = q.reshape(K, N + n_pad).astype(jnp.int8)
@@ -94,7 +102,7 @@ def dequantize_weight(qw: QuantLinear) -> jax.Array:
     K, N = qw.shape
     Np = qw.data.shape[1]            # lane-padded
     G = qw.group_size
-    if qw.bits == 8:
+    if qw.bits in (8, "fp8"):
         codes = qw.data.astype(jnp.float32)
     else:
         u = qw.data.astype(jnp.int32)
@@ -197,7 +205,7 @@ def quant_matmul(x: jax.Array, qw: QuantLinear, *,
     scale3 = qw.scale.reshape(K // bk, bk // G, N)
     s_spec = pl.BlockSpec((1, bk // G, bn), lambda m, n, k: (k, 0, n))
 
-    if qw.bits == 8:
+    if qw.bits in (8, "fp8"):       # the int8 kernel's astype covers fp8
         out = pl.pallas_call(
             functools.partial(_qmm8_kernel, G=G, dtype=mm_dtype),
             grid=grid,

@@ -22,7 +22,7 @@ def test_quant_roundtrip_error_bounded(bits):
     assert qw.nbytes < w.nbytes * (0.55 if bits == 8 else 0.3)
 
 
-@pytest.mark.parametrize("bits", [8, 4])
+@pytest.mark.parametrize("bits", [8, 4, "fp8"])
 @pytest.mark.parametrize("M", [1, 17, 64])
 def test_quant_matmul_matches_dequant_matmul(bits, M):
     """The kernel == dequantize-then-matmul (interpret mode: exact fp32)."""
@@ -38,7 +38,7 @@ def test_quant_matmul_matches_dequant_matmul(bits, M):
 
 
 @pytest.mark.slow  # two engine builds + jit compiles per param
-@pytest.mark.parametrize("bits", [8, 4])
+@pytest.mark.parametrize("bits", [8, 4, "fp8"])
 def test_v2_quant_serving_matches_dequantized_weights(bits):
     """quant_bits engine == the SAME engine fed explicitly round-tripped
     (quantize→dequantize) weights: the Pallas in-tile dequant is the only
