@@ -1,0 +1,13 @@
+#!/bin/sh
+# Elastic training example: the launcher supervises the job with the
+# restart agent — on worker death it re-reads the hostfile, re-solves the
+# chip count against the elasticity section (global batch stays constant
+# across topologies), relaunches, and training resumes from the latest
+# checkpoint. The training script reads DS_TPU_ELASTIC_* (see
+# tests/test_elastic_agent.py's script for the full contract).
+#
+#   sh examples/elastic_train.sh train.py
+exec python -m deepspeed_tpu.launcher.runner \
+    --elastic_training --elastic_restarts 5 \
+    --deepspeed_config ds_config.json \
+    "${1:-train.py}"

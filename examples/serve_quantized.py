@@ -1,0 +1,31 @@
+"""Weight-only-quantized serving example (ZeRO-Inference / mixed-GEMM
+role): matmul weights live in HBM as int8/int4/fp8 codes and dequantize
+tile-by-tile inside the Pallas GEMM — 2x/4x less HBM and weight-read
+bandwidth, the decode bottleneck.
+
+    python examples/serve_quantized.py
+"""
+import jax
+import numpy as np
+
+from deepspeed_tpu.inference import InferenceEngineV2
+from deepspeed_tpu.models import build_model
+
+
+def main():
+    model = build_model("tiny-llama")
+    for bits in (None, 8, 4, "fp8"):
+        eng = InferenceEngineV2(
+            model, rng=jax.random.PRNGKey(0),
+            config={"block_size": 8, "num_blocks": 64, "max_seqs": 2,
+                    "chunk": 16, "max_seq_len": 128, "quant_bits": bits})
+        prompt = list(map(int, np.random.default_rng(0).integers(
+            0, 256, (12,))))
+        out = eng.generate([prompt], max_new_tokens=8)[0]
+        size = sum(l.nbytes for l in jax.tree.leaves(eng.params))
+        print(f"quant_bits={bits!s:>4}: params {size / 1e3:7.1f}KB, "
+              f"generated {out}")
+
+
+if __name__ == "__main__":
+    main()
