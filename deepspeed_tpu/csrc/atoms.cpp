@@ -1,0 +1,55 @@
+// Host-side ragged batch-descriptor builder (the reference's
+// inference/v2/ragged/csrc/ fast host buffer + atom building role).
+// Packs per-sequence token chunks into the fixed-shape StepPlan arrays the
+// jitted serving programs consume: token ids, absolute positions, rolling
+// KV pool slots, activity masks, block tables, lengths and sampling flags.
+// One pass, no Python per-token loop — at high request rates the batch
+// build sits on the serving critical path between device steps.
+//
+// Layout contract (mirrors inference/scheduler.py::_desc exactly; the
+// Python implementation remains as the fallback and the oracle in tests):
+//   entry_meta per entry: [slot, n, start_pos, sample, n_blocks,
+//                          tok_off, blk_off]
+//   tokens:  concatenated int32 token chunks (entry i at tok_off, len n)
+//   blocks:  concatenated int32 block lists (entry i at blk_off, n_blocks)
+// Output arrays are caller-zeroed ([S,T] flattened row-major).
+
+#include <cstdint>
+
+extern "C" {
+
+void dstpu_build_atoms(int n_entries,
+                       const int32_t* tokens,
+                       const int32_t* entry_meta,
+                       const int32_t* blocks,
+                       int T, int max_blocks, int block_size,
+                       int32_t* token_ids, int32_t* positions,
+                       int32_t* slot_map, uint8_t* active,
+                       int32_t* block_tables, int32_t* seq_lens,
+                       int32_t* sample_idx, uint8_t* do_sample) {
+  for (int e = 0; e < n_entries; ++e) {
+    const int32_t* m = entry_meta + e * 7;
+    const int s = m[0], n = m[1], start = m[2], sample = m[3];
+    const int n_blocks = m[4], tok_off = m[5], blk_off = m[6];
+    int32_t* row_tok = token_ids + (int64_t)s * T;
+    int32_t* row_pos = positions + (int64_t)s * T;
+    int32_t* row_slot = slot_map + (int64_t)s * T;
+    uint8_t* row_act = active + (int64_t)s * T;
+    for (int j = 0; j < n; ++j) {
+      const int pos = start + j;
+      // rolling-buffer slot (mod is a no-op in linear mode)
+      const int blk = blocks[blk_off + (pos / block_size) % max_blocks];
+      row_tok[j] = tokens[tok_off + j];
+      row_pos[j] = pos;
+      row_slot[j] = blk * block_size + pos % block_size;
+      row_act[j] = 1;
+    }
+    int32_t* table = block_tables + (int64_t)s * max_blocks;
+    for (int b = 0; b < n_blocks; ++b) table[b] = blocks[blk_off + b];
+    seq_lens[s] = start + n;
+    sample_idx[s] = n - 1;
+    do_sample[s] = (uint8_t)sample;
+  }
+}
+
+}  // extern "C"

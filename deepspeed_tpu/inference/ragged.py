@@ -163,9 +163,9 @@ class StepPlan:
     token_ids: np.ndarray             # [S, T] int32
     positions: np.ndarray             # [S, T] int32 (pad → 0)
     slot_map: np.ndarray              # [S, T] int32 → pool token slot (block*bs+off)
-    active: np.ndarray                # [S, T] bool — real tokens
+    active: np.ndarray                # [S, T] uint8 — real tokens
     block_tables: np.ndarray          # [S, max_blocks] int32
     seq_lens: np.ndarray              # [S] int32, length incl. this step's tokens
     sample_idx: np.ndarray            # [S] int32 index into T of last real token
-    do_sample: np.ndarray             # [S] bool — emit a token for this slot
+    do_sample: np.ndarray             # [S] uint8 — emit a token for this slot
     uids: list[int] = field(default_factory=list)   # uid per slot (-1 = empty)

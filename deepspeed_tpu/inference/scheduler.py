@@ -35,30 +35,60 @@ class SplitFuseScheduler:
             token_ids=np.zeros((S, T), np.int32),
             positions=np.zeros((S, T), np.int32),
             slot_map=np.zeros((S, T), np.int32),     # trash block slot 0
-            active=np.zeros((S, T), bool),
+            active=np.zeros((S, T), np.uint8),
             block_tables=np.zeros((S, max_blocks), np.int32),
             seq_lens=np.zeros(S, np.int32),
             sample_idx=np.zeros(S, np.int32),
-            do_sample=np.zeros(S, bool),
+            do_sample=np.zeros(S, np.uint8),
             uids=[-1] * S,
         )
-        for seq, toks, start_pos, sample in entries:
-            s = seq.slot
-            n = len(toks)
-            plan.token_ids[s, :n] = toks
-            plan.positions[s, :n] = np.arange(start_pos, start_pos + n)
-            for j in range(n):
-                pos = start_pos + j
-                # rolling-buffer slot (mod is a no-op in linear mode)
-                blk = seq.blocks[(pos // bs) % max_blocks]
-                plan.slot_map[s, j] = blk * bs + pos % bs
-            plan.active[s, :n] = True
-            plan.block_tables[s, :len(seq.blocks)] = seq.blocks
-            plan.seq_lens[s] = start_pos + n
-            plan.sample_idx[s] = n - 1
-            plan.do_sample[s] = sample
-            plan.uids[s] = seq.uid
+        if not (entries and self._native_build(plan, T, entries)):
+            for seq, toks, start_pos, sample in entries:
+                s = seq.slot
+                n = len(toks)
+                plan.token_ids[s, :n] = toks
+                plan.positions[s, :n] = np.arange(start_pos, start_pos + n)
+                for j in range(n):
+                    pos = start_pos + j
+                    # rolling-buffer slot (mod is a no-op in linear mode)
+                    blk = seq.blocks[(pos // bs) % max_blocks]
+                    plan.slot_map[s, j] = blk * bs + pos % bs
+                plan.active[s, :n] = True
+                plan.block_tables[s, :len(seq.blocks)] = seq.blocks
+                plan.seq_lens[s] = start_pos + n
+                plan.sample_idx[s] = n - 1
+                plan.do_sample[s] = sample
+        for seq, *_ in entries:
+            plan.uids[seq.slot] = seq.uid
         return plan
+
+    def _native_build(self, plan: StepPlan, T: int, entries) -> bool:
+        """Pack the plan arrays in C++ (csrc/atoms.cpp, the reference
+        ragged/csrc host-buffer role); False → Python fallback."""
+        import ctypes
+
+        from ..ops.native import load_library
+
+        lib = load_library()
+        if lib is None:
+            return False
+        tokens, blocks, meta = [], [], []
+        for seq, toks, start_pos, sample in entries:
+            meta.extend((seq.slot, len(toks), start_pos, int(sample),
+                         len(seq.blocks), len(tokens), len(blocks)))
+            tokens.extend(toks)
+            blocks.extend(seq.blocks)
+        tok = np.asarray(tokens, np.int32)
+        blk = np.asarray(blocks, np.int32)
+        met = np.asarray(meta, np.int32)
+        pp = lambda a: a.ctypes.data_as(ctypes.c_void_p)
+        lib.dstpu_build_atoms(
+            len(entries), pp(tok), pp(met), pp(blk),
+            T, self.state.max_blocks_per_seq, self.state.block_size,
+            pp(plan.token_ids), pp(plan.positions), pp(plan.slot_map),
+            pp(plan.active), pp(plan.block_tables), pp(plan.seq_lens),
+            pp(plan.sample_idx), pp(plan.do_sample))
+        return True
 
     def next_step(self) -> StepPlan | None:
         """Build the next step plan, or None if nothing to run."""

@@ -20,7 +20,7 @@ from ...utils.logging import logger
 
 _CSRC = os.path.join(os.path.dirname(os.path.dirname(os.path.dirname(
     os.path.abspath(__file__)))), "csrc")
-_SOURCES = ("aio.cpp", "cpu_adam.cpp")
+_SOURCES = ("aio.cpp", "cpu_adam.cpp", "atoms.cpp")
 _HEADERS = ("threadpool.h",)
 
 _lock = threading.Lock()
@@ -93,6 +93,8 @@ def _bind(lib: ctypes.CDLL) -> ctypes.CDLL:
     lib.dstpu_lion_step.argtypes = [p, p, p, i64, f32, f32, f32, f32]
     lib.dstpu_f32_to_bf16.argtypes = [p, p, i64]
     lib.dstpu_bf16_to_f32.argtypes = [p, p, i64]
+    lib.dstpu_build_atoms.argtypes = [i32, p, p, p, i32, i32, i32,
+                                      p, p, p, p, p, p, p, p]
     lib.dstpu_num_threads.restype = i32
     return lib
 

@@ -490,3 +490,43 @@ def test_v2_pallas_kernels_on_mixed_data_tensor_mesh():
         ep.scheduler.commit(plan, {1: tok} if plan.do_sample[0] else {})
     for eng in (ex, ep):
         eng.flush(1)
+
+
+def test_native_atom_builder_matches_python(monkeypatch):
+    """The C++ batch-descriptor builder (csrc/atoms.cpp — reference
+    ragged/csrc host-buffer role) produces byte-identical StepPlans to
+    the Python packer, including rolling-ring slot math."""
+    import deepspeed_tpu.ops.native as native
+    from deepspeed_tpu.inference.ragged import StateManager
+    from deepspeed_tpu.inference.scheduler import SplitFuseScheduler
+
+    if native.load_library() is None:
+        pytest.skip("native toolchain unavailable")
+
+    def plans(force_python):
+        st = StateManager(num_blocks=32, block_size=4, max_seqs=3,
+                          max_blocks_per_seq=5)   # ring-sized table
+        sched = SplitFuseScheduler(st, chunk=6)
+        if force_python:
+            monkeypatch.setattr(native, "load_library", lambda: None)
+        st.admit(1, list(range(100, 117)), max_new_tokens=3)   # chunks
+        st.admit(2, [7, 8, 9], max_new_tokens=2)
+        out = []
+        for _ in range(8):
+            p = sched.next_step()
+            if p is None:
+                break
+            out.append(p)
+            sampled = {uid: 42 + len(out) for s, uid in enumerate(p.uids)
+                       if uid >= 0 and p.do_sample[s]}
+            sched.commit(p, sampled)
+        monkeypatch.undo()
+        return out
+
+    nat, py = plans(False), plans(True)
+    assert len(nat) == len(py) and len(nat) >= 4
+    for a, b in zip(nat, py):
+        assert a.kind == b.kind and a.uids == b.uids
+        for f in ("token_ids", "positions", "slot_map", "active",
+                  "block_tables", "seq_lens", "sample_idx", "do_sample"):
+            np.testing.assert_array_equal(getattr(a, f), getattr(b, f), f)
