@@ -31,6 +31,9 @@ void dstpu_build_atoms(int n_entries,
     const int32_t* m = entry_meta + e * 7;
     const int s = m[0], n = m[1], start = m[2], sample = m[3];
     const int n_blocks = m[4], tok_off = m[5], blk_off = m[6];
+    // fail as loudly as the Python fallback's shape error would: a block
+    // list wider than the table must never write past this row
+    if (n_blocks > max_blocks || n > T) __builtin_trap();
     int32_t* row_tok = token_ids + (int64_t)s * T;
     int32_t* row_pos = positions + (int64_t)s * T;
     int32_t* row_slot = slot_map + (int64_t)s * T;
