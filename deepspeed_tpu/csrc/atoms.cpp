@@ -18,22 +18,26 @@
 
 extern "C" {
 
-void dstpu_build_atoms(int n_entries,
-                       const int32_t* tokens,
-                       const int32_t* entry_meta,
-                       const int32_t* blocks,
-                       int T, int max_blocks, int block_size,
-                       int32_t* token_ids, int32_t* positions,
-                       int32_t* slot_map, uint8_t* active,
-                       int32_t* block_tables, int32_t* seq_lens,
-                       int32_t* sample_idx, uint8_t* do_sample) {
+// Returns 0 on success; 1 + e on the first entry whose metadata violates
+// the plan-shape invariants (the caller raises, matching the Python
+// fallback's loud shape errors — no write happens past a row).
+int dstpu_build_atoms(int n_entries,
+                      const int32_t* tokens,
+                      const int32_t* entry_meta,
+                      const int32_t* blocks,
+                      int S, int T, int max_blocks, int block_size,
+                      int32_t* token_ids, int32_t* positions,
+                      int32_t* slot_map, uint8_t* active,
+                      int32_t* block_tables, int32_t* seq_lens,
+                      int32_t* sample_idx, uint8_t* do_sample) {
   for (int e = 0; e < n_entries; ++e) {
     const int32_t* m = entry_meta + e * 7;
     const int s = m[0], n = m[1], start = m[2], sample = m[3];
     const int n_blocks = m[4], tok_off = m[5], blk_off = m[6];
-    // fail as loudly as the Python fallback's shape error would: a block
-    // list wider than the table must never write past this row
-    if (n_blocks > max_blocks || n > T) __builtin_trap();
+    if (s < 0 || s >= S || n < 0 || n > T || start < 0 ||
+        n_blocks < 0 || n_blocks > max_blocks || tok_off < 0 ||
+        blk_off < 0)
+      return 1 + e;
     int32_t* row_tok = token_ids + (int64_t)s * T;
     int32_t* row_pos = positions + (int64_t)s * T;
     int32_t* row_slot = slot_map + (int64_t)s * T;
@@ -53,6 +57,7 @@ void dstpu_build_atoms(int n_entries,
     sample_idx[s] = n - 1;
     do_sample[s] = (uint8_t)sample;
   }
+  return 0;
 }
 
 }  // extern "C"

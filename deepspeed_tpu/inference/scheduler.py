@@ -82,12 +82,17 @@ class SplitFuseScheduler:
         blk = np.asarray(blocks, np.int32)
         met = np.asarray(meta, np.int32)
         pp = lambda a: a.ctypes.data_as(ctypes.c_void_p)
-        lib.dstpu_build_atoms(
+        rc = lib.dstpu_build_atoms(
             len(entries), pp(tok), pp(met), pp(blk),
-            T, self.state.max_blocks_per_seq, self.state.block_size,
+            self.state.max_seqs, T, self.state.max_blocks_per_seq,
+            self.state.block_size,
             pp(plan.token_ids), pp(plan.positions), pp(plan.slot_map),
             pp(plan.active), pp(plan.block_tables), pp(plan.seq_lens),
             pp(plan.sample_idx), pp(plan.do_sample))
+        if rc != 0:
+            raise ValueError(
+                f"atom builder: entry {rc - 1} violates plan-shape "
+                f"invariants (meta {meta[(rc - 1) * 7:rc * 7]})")
         return True
 
     def next_step(self) -> StepPlan | None:

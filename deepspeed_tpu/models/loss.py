@@ -120,10 +120,7 @@ def cross_entropy_lm(logits: jax.Array, labels: jax.Array,
         chunk = min(ce_chunk, N)
         lab = jnp.where(mask, labels, -1).reshape(N)
         nll, logz = _nll_logz(logits.reshape(N, V), lab, chunk)
-        loss = jnp.sum(nll) / denom
-        if z_loss_weight:
-            loss = loss + z_loss_weight * jnp.sum(jnp.square(logz)) / denom
-        return loss
+        return _masked_mean_loss(nll, logz, denom, z_loss_weight)
     logits = logits.astype(jnp.float32)
     safe_labels = jnp.where(mask, labels, 0)
     logz = jax.nn.logsumexp(logits, axis=-1)
@@ -133,6 +130,171 @@ def cross_entropy_lm(logits: jax.Array, labels: jax.Array,
     if z_loss_weight:
         loss = loss + z_loss_weight * jnp.sum(jnp.square(logz) * mask) / denom
     return loss
+
+
+def _masked_mean_loss(nll, logz, denom, z_loss_weight):
+    """Shared CE reduction: mean of pre-masked per-token nll (+ z-loss on
+    pre-masked logz) — the single place the denom/z-loss semantics live
+    for the chunked AND fused head paths."""
+    loss = jnp.sum(nll) / denom
+    if z_loss_weight:
+        loss = loss + z_loss_weight * jnp.sum(jnp.square(logz)) / denom
+    return loss
+
+
+# ---------------------------------------------------------------------------
+# Fused LM head + cross entropy: the unembedding matmul and the softmax
+# CE run together in an online-logsumexp scan over VOCAB chunks, so the
+# [B*S, V] logits tensor never exists — in any precision. This is the
+# step beyond CE_CHUNK (which streams rows but still needs the full
+# logits input): for llama-class vocabs at long sequence the logits are
+# the single largest activation, and this removes them from both the
+# forward and the backward (the reference's fused softmax-CE kernels +
+# vocab-parallel cross entropy play the same memory role). Opt-in via
+# DS_TPU_FUSED_HEAD_CHUNK (vocab columns per chunk) — the engine's
+# default loss uses it automatically when the model runs with
+# ``return_hidden`` support.
+# ---------------------------------------------------------------------------
+
+NEG_INF_F32 = float(jnp.finfo(jnp.float32).min)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5))
+def _fused_nll_logz(x2d, w, bias, labels1d, vchunk: int, w_is_ve: bool):
+    """Per-token (nll, logz) from hidden states and the head weight.
+    x2d [N, E]; w [V, E] (tied embedding) or [E, V] (unembed);
+    bias [V] or None; labels [N] (< 0 = masked). V pads to vchunk."""
+    (out, _) = _fused_fwd(x2d, w, bias, labels1d, vchunk, w_is_ve)
+    return out
+
+
+def _head_chunk(x2d, w, bias, c0, vchunk, w_is_ve, V):
+    """One vocab chunk's logits in fp32, plus the EFFECTIVE start.
+    dynamic_slice clamps starts near the end, so the tail chunk reads
+    [V - vchunk, V); columns outside the LOGICAL range [c0, min(c0+vchunk,
+    V)) are masked to -inf — they were already covered by earlier chunks.
+    Returns (lg [N, vchunk], c0_eff)."""
+    c0_eff = jnp.minimum(c0, V - vchunk)
+    if w_is_ve:
+        wc = jax.lax.dynamic_slice_in_dim(w, c0_eff, vchunk, axis=0)
+        lg = jax.lax.dot_general(x2d, wc, (((1,), (1,)), ((), ())))
+    else:
+        wc = jax.lax.dynamic_slice_in_dim(w, c0_eff, vchunk, axis=1)
+        lg = x2d @ wc
+    lg = lg.astype(jnp.float32)
+    if bias is not None:
+        lg = lg + jax.lax.dynamic_slice_in_dim(
+            bias, c0_eff, vchunk).astype(jnp.float32)[None, :]
+    pos = c0_eff + jnp.arange(vchunk)
+    valid = (pos >= c0) & (pos < V)
+    return jnp.where(valid[None, :], lg, jnp.float32(NEG_INF_F32)), c0_eff
+
+
+def _fused_fwd(x2d, w, bias, labels1d, vchunk, w_is_ve):
+    N = x2d.shape[0]
+    V = w.shape[0] if w_is_ve else w.shape[1]
+    starts = jnp.arange(0, V, vchunk, dtype=jnp.int32)
+    mask = labels1d >= 0
+    safe = jnp.where(mask, labels1d, 0)
+
+    def body(carry, c0):
+        m, l, true = carry
+        lg, c0_eff = _head_chunk(x2d, w, bias, c0, vchunk, w_is_ve, V)
+        m_new = jnp.maximum(m, jnp.max(lg, axis=-1))
+        l = l * jnp.exp(m - m_new) + jnp.sum(
+            jnp.exp(lg - m_new[:, None]), axis=-1)
+        in_chunk = (safe >= c0) & (safe < c0 + vchunk)
+        idx = jnp.clip(safe - c0_eff, 0, vchunk - 1)
+        true = true + jnp.where(
+            in_chunk, jnp.take_along_axis(lg, idx[:, None], axis=1)[:, 0],
+            0.0)
+        return (m_new, l, true), None
+
+    init = (jnp.full((N,), NEG_INF_F32), jnp.zeros((N,), jnp.float32),
+            jnp.zeros((N,), jnp.float32))
+    (m, l, true), _ = jax.lax.scan(body, init, starts)
+    logz = m + jnp.log(l)
+    nll = (logz - true) * mask
+    return (nll, logz * mask), (x2d, w, bias, labels1d, logz)
+
+
+def _fused_bwd(vchunk, w_is_ve, res, grads):
+    x2d, w, bias, labels1d, logz = res
+    dnll, dlogz = grads                                   # [N] fp32
+    N, E = x2d.shape
+    V = w.shape[0] if w_is_ve else w.shape[1]
+    w_axis = 0 if w_is_ve else 1
+    starts = jnp.arange(0, V, vchunk, dtype=jnp.int32)
+    mask = labels1d >= 0
+    safe = jnp.where(mask, labels1d, 0)
+    coeff = ((dnll + dlogz) * mask)
+    gn = dnll * mask
+
+    def body(carry, c0):
+        dx, dw, db = carry
+        lg, c0_eff = _head_chunk(x2d, w, bias, c0, vchunk, w_is_ve, V)
+        p = jnp.exp(lg - logz[:, None])   # softmax chunk (0 at -inf cols)
+        d = p * coeff[:, None]
+        in_chunk = (safe >= c0) & (safe < c0 + vchunk)
+        onehot = jax.nn.one_hot(jnp.where(in_chunk, safe - c0_eff, vchunk),
+                                vchunk, dtype=jnp.float32)
+        d = d - onehot * gn[:, None]                      # [N, Vc] fp32
+        d16 = d.astype(x2d.dtype)
+        wc = jax.lax.dynamic_slice_in_dim(w, c0_eff, vchunk, axis=w_axis)
+        if w_is_ve:
+            dx = dx + jax.lax.dot_general(
+                d16, wc, (((1,), (0,)), ((), ()))).astype(jnp.float32)
+            dwc = jax.lax.dot_general(                    # [Vc, E]
+                d16, x2d, (((0,), (0,)), ((), ())))
+        else:
+            dx = dx + (d16 @ wc.T).astype(jnp.float32)
+            dwc = jax.lax.dot_general(                    # [E, Vc]
+                x2d, d16, (((0,), (0,)), ((), ())))
+        # read-add-write: the clamped tail chunk overlaps earlier columns
+        # (their d is 0 there, but the slot must accumulate, not overwrite)
+        cur = jax.lax.dynamic_slice_in_dim(dw, c0_eff, vchunk, axis=w_axis)
+        dw = jax.lax.dynamic_update_slice_in_dim(dw, cur + dwc, c0_eff,
+                                                 axis=w_axis)
+        if bias is not None:
+            dbc = jnp.sum(d, axis=0)
+            curb = jax.lax.dynamic_slice_in_dim(db, c0_eff, vchunk)
+            db = jax.lax.dynamic_update_slice_in_dim(db, curb + dbc, c0_eff,
+                                                     axis=0)
+        return (dx, dw, db), None
+
+    dx0 = jnp.zeros((N, E), jnp.float32)
+    dw0 = jnp.zeros(w.shape, jnp.float32)
+    db0 = None if bias is None else jnp.zeros((V,), jnp.float32)
+    (dx, dw, db), _ = jax.lax.scan(body, (dx0, dw0, db0), starts)
+    return (dx.astype(x2d.dtype), dw.astype(w.dtype),
+            None if db is None else db.astype(bias.dtype), None)
+
+
+_fused_nll_logz.defvjp(_fused_fwd, _fused_bwd)
+
+
+def fused_lm_head_loss(hidden, w, labels, *, bias=None,
+                       ignore_index: int = IGNORE_INDEX,
+                       z_loss_weight: float = 0.0,
+                       w_is_ve: bool = True,
+                       vchunk: int | None = None) -> jax.Array:
+    """Mean next-token CE straight from hidden states [B, S, E] and the
+    head weight — no logits tensor. ``w_is_ve``: w is the tied embedding
+    [V, E]; else the unembed [E, V]."""
+    import math
+
+    if vchunk is None:
+        vchunk = int(os.environ.get("DS_TPU_FUSED_HEAD_CHUNK", "8192"))
+    E = hidden.shape[-1]
+    N = math.prod(hidden.shape[:-1])
+    V = w.shape[0] if w_is_ve else w.shape[1]
+    vchunk = min(vchunk, V)
+    mask = (labels != ignore_index)
+    denom = jnp.maximum(jnp.sum(mask), 1)
+    lab = jnp.where(mask, labels, -1).reshape(N)
+    nll, logz = _fused_nll_logz(hidden.reshape(N, E), w, bias, lab,
+                                vchunk, w_is_ve)
+    return _masked_mean_loss(nll, logz, denom, z_loss_weight)
 
 
 def _train_mode_kwargs(batch: dict) -> dict:
@@ -149,17 +311,34 @@ def _train_mode_kwargs(batch: dict) -> dict:
 
 def lm_loss_fn(model, params, batch, deterministic: bool = True):
     """Default engine loss: causal LM on {'input_ids', 'labels'} batches.
-    Adds any aux losses the model sowed (MoE balance/z losses)."""
+    Adds any aux losses the model sowed (MoE balance/z losses).
+    DS_TPU_FUSED_HEAD_CHUNK=<vocab cols> routes through the fused
+    vocab-chunked head loss — no [B,S,V] logits tensor."""
     input_ids = batch["input_ids"]
     labels = batch.get("labels")
     if labels is None:
         labels = jnp.concatenate(
             [input_ids[:, 1:], jnp.full_like(input_ids[:, :1], IGNORE_INDEX)], axis=1)
     kwargs = {"deterministic": deterministic} | _train_mode_kwargs(batch)
-    out, variables = model.apply({"params": params}, input_ids,
-                                 mutable=["losses"], **kwargs)
-    logits = out
-    loss = cross_entropy_lm(logits, labels)
+    env = os.environ.get("DS_TPU_FUSED_HEAD_CHUNK")
+    vchunk = int(env) if env else 0
+    if vchunk > 0 and hasattr(model, "config"):
+        cfg = model.config
+        hidden, variables = model.apply({"params": params}, input_ids,
+                                        return_hidden=True,
+                                        mutable=["losses"], **kwargs)
+        if cfg.tie_embeddings:
+            w, w_is_ve = params["embed"].astype(cfg.dtype), True
+        else:
+            w, w_is_ve = params["unembed"].astype(cfg.dtype), False
+        bias = params["unembed_b"] if getattr(cfg, "unembed_bias", False) \
+            else None
+        loss = fused_lm_head_loss(hidden, w, labels, bias=bias,
+                                  w_is_ve=w_is_ve, vchunk=vchunk)
+    else:
+        out, variables = model.apply({"params": params}, input_ids,
+                                     mutable=["losses"], **kwargs)
+        loss = cross_entropy_lm(out, labels)
     for leaf in jax.tree.leaves(variables.get("losses", {})):
         loss = loss + jnp.sum(leaf)
     return loss

@@ -506,7 +506,8 @@ class TransformerLM(nn.Module):
 
     @nn.compact
     def __call__(self, input_ids, positions=None, kv_caches=None, attn_mask=None,
-                 deterministic: bool = True, token_type_ids=None):
+                 deterministic: bool = True, token_type_ids=None,
+                 return_hidden: bool = False):
         cfg = self.config
         B, S = input_ids.shape
         if not cfg.causal and kv_caches is not None:
@@ -560,6 +561,11 @@ class TransformerLM(nn.Module):
 
         if cfg.pre_norm:  # post-norm layers already end normalized
             x = Norm(cfg, name="ln_final")(x)
+        if return_hidden:
+            # pre-head hidden states for the fused vocab-chunked head loss
+            # (models/loss.py fused_lm_head_loss) — the [B,S,V] logits are
+            # never built
+            return x
         if cfg.tie_embeddings:
             logits = jnp.einsum("bse,ve->bsv", x, embed.astype(cfg.dtype))
         else:

@@ -93,8 +93,9 @@ def _bind(lib: ctypes.CDLL) -> ctypes.CDLL:
     lib.dstpu_lion_step.argtypes = [p, p, p, i64, f32, f32, f32, f32]
     lib.dstpu_f32_to_bf16.argtypes = [p, p, i64]
     lib.dstpu_bf16_to_f32.argtypes = [p, p, i64]
-    lib.dstpu_build_atoms.argtypes = [i32, p, p, p, i32, i32, i32,
+    lib.dstpu_build_atoms.argtypes = [i32, p, p, p, i32, i32, i32, i32,
                                       p, p, p, p, p, p, p, p]
+    lib.dstpu_build_atoms.restype = i32
     lib.dstpu_num_threads.restype = i32
     return lib
 

@@ -251,3 +251,94 @@ def test_zero_namespace_compat():
     with ds.zero.GatheredParameters(engine.state.params) as full:
         assert full is engine.state.params
     assert float(engine.train_batch(batch)) < l0
+
+
+def test_fused_head_loss_matches_dense():
+    """Fused vocab-chunked head loss == unembed-matmul + dense CE, values
+    and all grads (fp32 exact; odd vocab exercises the clamped tail chunk;
+    both head orientations + bias)."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    import deepspeed_tpu.models.loss as L
+
+    r = np.random.default_rng(0)
+    E, V = 32, 257
+    x = jnp.asarray(r.standard_normal((2, 7, E)), jnp.float32)
+    labels = r.integers(0, V, (2, 7)).astype(np.int32)
+    labels[0, :2] = L.IGNORE_INDEX
+    labels = jnp.asarray(labels)
+    for w_is_ve in (True, False):
+        w = jnp.asarray(r.standard_normal((V, E) if w_is_ve else (E, V))
+                        * 0.05, jnp.float32)
+        b = jnp.asarray(r.standard_normal((V,)) * 0.1, jnp.float32)
+
+        def dense(x, w, b):
+            lg = (jnp.einsum("bse,ve->bsv", x, w) if w_is_ve
+                  else jnp.einsum("bse,ev->bsv", x, w)) + b
+            return L.cross_entropy_lm(lg, labels, z_loss_weight=1e-3)
+
+        def fused(x, w, b):
+            return L.fused_lm_head_loss(x, w, labels, bias=b,
+                                        w_is_ve=w_is_ve, vchunk=64,
+                                        z_loss_weight=1e-3)
+
+        dv, dg = jax.value_and_grad(dense, argnums=(0, 1, 2))(x, w, b)
+        fv, fg = jax.value_and_grad(fused, argnums=(0, 1, 2))(x, w, b)
+        assert abs(float(dv) - float(fv)) < 1e-5
+        for a, c in zip(fg, dg):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(c),
+                                       atol=1e-6)
+
+
+def test_fused_head_engine_training_matches_dense(monkeypatch):
+    """DS_TPU_FUSED_HEAD_CHUNK routes the engine's default LM loss through
+    the fused head — training trajectory matches the dense path."""
+    import numpy as np
+
+    import deepspeed_tpu as ds
+    from deepspeed_tpu.models import build_model
+
+    def losses():
+        engine, *_ = ds.initialize(
+            model=build_model("tiny-gpt2"),
+            config={"train_micro_batch_size_per_gpu": 2,
+                    "optimizer": {"type": "AdamW", "params": {"lr": 1e-2}},
+                    "steps_per_print": 10_000})
+        rng = np.random.default_rng(0)
+        batch = {"input_ids": rng.integers(
+            0, 256, (engine.config.train_batch_size, 32)).astype(np.int32)}
+        return [float(engine.train_batch(batch)) for _ in range(3)]
+
+    dense = losses()
+    monkeypatch.setenv("DS_TPU_FUSED_HEAD_CHUNK", "96")
+    fused = losses()
+    np.testing.assert_allclose(fused, dense, rtol=2e-2)
+
+
+def test_fused_head_removes_logits_memory():
+    """The compiler's own memory analysis shows the fused head's grad
+    program never materializes the logits: temp bytes fall far below the
+    dense program's (llama-class head at 4k rows: measured ~5x)."""
+    import jax
+    import jax.numpy as jnp
+
+    import deepspeed_tpu.models.loss as L
+
+    E, V = 512, 32000
+    x = jax.ShapeDtypeStruct((4, 1024, E), jnp.bfloat16)
+    w = jax.ShapeDtypeStruct((V, E), jnp.bfloat16)
+    lab = jax.ShapeDtypeStruct((4, 1024), jnp.int32)
+
+    def dense(x, w, labels):
+        return L.cross_entropy_lm(jnp.einsum("bse,ve->bsv", x, w), labels)
+
+    def fused(x, w, labels):
+        return L.fused_lm_head_loss(x, w, labels, w_is_ve=True, vchunk=4096)
+
+    def temp(fn):
+        return jax.jit(jax.grad(fn, argnums=(0, 1))).lower(
+            x, w, lab).compile().memory_analysis().temp_size_in_bytes
+
+    assert temp(fused) < temp(dense) / 2
