@@ -20,11 +20,22 @@ the same memory state is expressed as a *host-driven layer walk*:
   configurable lookahead (``offload_param.buffer_count``), and released
   right after use — peak HBM holds O(lookahead) layers of params, never
   the model (the swapper's available/inflight buffer pool, re-expressed).
-- The backward walk re-stages each layer and runs the fused program, and
-  each layer's gradient is pulled to the host immediately and accumulated
-  in fp32 — full gradients never exist in HBM either. At the GAS boundary
-  the host SIMD optimizer steps group-by-group (composing with the NVMe
-  optimizer-state swapper) and the bf16 cache is refreshed.
+- NVMe reads are pipelined one window AHEAD of device staging: while the
+  walk computes group i with groups [i, i+lookahead) in HBM, the reads
+  for groups [i+lookahead, i+2·lookahead) are in flight on the aio
+  thread pool (``_prefetch_host``), so ``_stage`` waits on reads that
+  were issued ``lookahead`` iterations earlier — the swapper's
+  available/inflight split (partitioned_param_swapper.py:37) on the
+  host side. Host read-ahead buffers cost RAM, never HBM.
+- The backward walk re-stages each layer and runs the fused program;
+  each layer's gradient starts a non-blocking D2H copy immediately
+  (``copy_to_host_async``) and is accumulated into the fp32 host buffers
+  only once it is ``lookahead`` layers stale — the host thread never
+  blocks on a transfer that would stall dispatch of the next layer's
+  backward. Full gradients never exist in HBM (≤ lookahead layers of
+  grads ride the queue). At the GAS boundary the host SIMD optimizer
+  steps group-by-group (composing with the NVMe optimizer-state
+  swapper) and the bf16 cache is refreshed.
 
 DP composes: batch dims are sharded over the mesh's DP axes and staged
 params are replicated, so XLA emits the gradient all-reduce inside each
@@ -50,6 +61,58 @@ Pytree = Any
 
 def _keystr(prefix: str, sub_path) -> str:
     return prefix + jax.tree_util.keystr(sub_path)
+
+
+class NVMeParamPlaceholder:
+    """Stands in for a parameter whose bytes live on NVMe in
+    ``engine.state.params``. Carries the true shape/dtype (so shape-driven
+    consumers — flops profiler, topology checks — keep working) but any
+    VALUE access raises instead of silently reading zeros: the bytes are
+    on disk, fetch them via ``engine._param_stream.host_params_tree()``
+    (the checkpoint path already does). Mirrors the reference's invariant
+    that an NVMe-resident partition has ``param.data`` swapped out
+    (partitioned_param_swapper.py:37) rather than zero-filled."""
+
+    __slots__ = ("shape", "dtype", "_key")
+
+    def __init__(self, shape, dtype, key: str):
+        self.shape = tuple(shape)
+        self.dtype = np.dtype(dtype)
+        self._key = key
+
+    @property
+    def ndim(self) -> int:
+        return len(self.shape)
+
+    @property
+    def size(self) -> int:
+        return int(np.prod(self.shape)) if self.shape else 1
+
+    @property
+    def nbytes(self) -> int:
+        return self.size * self.dtype.itemsize
+
+    def _raise(self, *a, **k):
+        raise RuntimeError(
+            f"parameter '{self._key}' is NVMe-resident (offload_param."
+            f"device='nvme'): engine.state.params carries shape/dtype "
+            f"placeholders only. Read values through "
+            f"engine._param_stream.host_params_tree() — note it loads the "
+            f"FULL model into host RAM.")
+
+    __array__ = _raise
+    __getitem__ = _raise
+    __iter__ = _raise
+    __float__ = _raise
+    __int__ = _raise
+    __bool__ = _raise
+    __add__ = __radd__ = __mul__ = __rmul__ = _raise
+    __sub__ = __rsub__ = __truediv__ = __rtruediv__ = _raise
+    __matmul__ = __rmatmul__ = _raise
+
+    def __repr__(self):
+        return (f"NVMeParamPlaceholder(key={self._key!r}, "
+                f"shape={self.shape}, dtype={self.dtype})")
 
 
 class LayerStreamTrainer:
@@ -91,6 +154,14 @@ class LayerStreamTrainer:
         self._live_bytes = 0
         self._grad_acc: dict[str, np.ndarray] = {}
         self._programs: dict[Any, Any] = {}
+        # NVMe read-ahead: group -> ([(buf, req, shape), ...], treedef)
+        self._inflight: dict[str, tuple] = {}
+        # non-blocking grad D2H: (tree, nbytes) awaiting accumulation
+        self._grad_pending: list[tuple] = []
+        self._grad_live_bytes = 0
+        # peak_staged_bytes counts staged PARAMS; peak_hbm_bytes adds the
+        # grad queue (≤ lookahead+1 layer-grad trees) — the honest total
+        self.peak_hbm_bytes = 0
 
     # ------------------------------------------------------------------
     # host state bring-up
@@ -106,6 +177,8 @@ class LayerStreamTrainer:
         """Take the fp32 master pytree (numpy, host) and build the grouped
         bf16 compute cache. The master itself is handed to the host
         optimizer by the engine."""
+        if self.nvme:
+            self._drain_inflight()      # restore rewrites the NVMe files
         m = self.mcfg
         self.groups = (["pre"] + [f"layer_{i}" for i in range(m.num_layers)]
                        + ["head"])
@@ -168,8 +241,9 @@ class LayerStreamTrainer:
             self.aio.wait(r)
         self.cache[g] = {}     # disk owns the bytes; shapes keep structure
 
-    def _fetch_group(self, g: str) -> dict:
-        """NVMe read of a group's bf16 leaves (async issue, then wait)."""
+    def _issue_fetch(self, g: str) -> tuple:
+        """Issue async NVMe reads for every leaf of group ``g`` (returns
+        without waiting — completion happens in :meth:`_fetch_group`)."""
         shapes = self._group_items(g, self.shapes[g])
         flat, treedef = jax.tree_util.tree_flatten_with_path(
             shapes, is_leaf=lambda x: isinstance(x, jax.ShapeDtypeStruct))
@@ -181,6 +255,23 @@ class LayerStreamTrainer:
             req = self.aio.async_pread(
                 buf, self._param_path(jax.tree_util.keystr(path)))
             bufs.append((buf, req, sds.shape))
+        return bufs, treedef
+
+    def _prefetch_host(self, g: str) -> None:
+        """Start the NVMe reads for ``g`` ahead of its ``_stage`` — the
+        walk calls this one lookahead-window early so the wait inside
+        :meth:`_fetch_group` lands on already-serviced requests. No-op in
+        CPU mode (host cache access is free) and when already staged or
+        in flight."""
+        if not self.nvme or g in self._staged or g in self._inflight:
+            return
+        if self.mcfg.tie_embeddings and g == "head":
+            self._prefetch_host("pre")   # head borrows pre's embed table
+        self._inflight[g] = self._issue_fetch(g)
+
+    def _fetch_group(self, g: str) -> dict:
+        """Complete (or issue-and-complete) the NVMe read of a group."""
+        bufs, treedef = self._inflight.pop(g, None) or self._issue_fetch(g)
         leaves = []
         for buf, req, shape in bufs:
             self.aio.wait(req)
@@ -189,6 +280,16 @@ class LayerStreamTrainer:
         if self.mcfg.tie_embeddings and g == "head":
             out["embed"] = self._host_group("pre")["embed"]
         return out
+
+    def _drain_inflight(self) -> None:
+        """Complete and discard any outstanding prefetch reads. Called
+        before anything rewrites the NVMe files (cache refresh at the GAS
+        boundary, checkpoint restore) — a pending read racing a rewrite
+        of the same file would tear."""
+        for g in list(self._inflight):
+            bufs, _ = self._inflight.pop(g)
+            for _, req, _ in bufs:
+                self.aio.wait(req)
 
     def _host_group(self, g: str) -> dict:
         if self.nvme:
@@ -206,6 +307,8 @@ class LayerStreamTrainer:
             self._live_bytes += nbytes
             self.peak_staged_bytes = max(self.peak_staged_bytes,
                                          self._live_bytes)
+            self.peak_hbm_bytes = max(
+                self.peak_hbm_bytes, self._live_bytes + self._grad_live_bytes)
         return self._staged[g]
 
     def _release(self, g: str) -> None:
@@ -332,7 +435,9 @@ class LayerStreamTrainer:
     # ------------------------------------------------------------------
     def _acc_grads(self, top_prefix_tree: dict) -> None:
         """Accumulate a device grad tree (keyed by top-level param name)
-        into the host fp32 buffers."""
+        into the host fp32 buffers. Blocks on the D2H transfer — the walk
+        routes through :meth:`_enqueue_grads` so this only runs on trees
+        whose async copy started ``lookahead`` layers ago."""
         for top, sub in top_prefix_tree.items():
             flat, _ = jax.tree_util.tree_flatten_with_path(sub)
             for path, leaf in flat:
@@ -342,6 +447,27 @@ class LayerStreamTrainer:
                     self._grad_acc[key] += g
                 else:
                     self._grad_acc[key] = g
+
+    def _enqueue_grads(self, top_prefix_tree: dict) -> None:
+        """Start the non-blocking D2H copy of a layer's gradients and park
+        the tree; the device buffers stay alive (≤ lookahead+1 layers of
+        grads, counted in ``peak_hbm_bytes``) until :meth:`_drain_grads`
+        accumulates them."""
+        nbytes = 0
+        for leaf in jax.tree.leaves(top_prefix_tree):
+            if isinstance(leaf, jax.Array):
+                leaf.copy_to_host_async()
+                nbytes += leaf.nbytes
+        self._grad_pending.append((top_prefix_tree, nbytes))
+        self._grad_live_bytes += nbytes
+        self.peak_hbm_bytes = max(
+            self.peak_hbm_bytes, self._live_bytes + self._grad_live_bytes)
+
+    def _drain_grads(self, keep: int = 0) -> None:
+        while len(self._grad_pending) > keep:
+            tree, nbytes = self._grad_pending.pop(0)
+            self._acc_grads(tree)
+            self._grad_live_bytes -= nbytes
 
     # ------------------------------------------------------------------
     def _prepare_micro(self, mb: dict):
@@ -370,8 +496,12 @@ class LayerStreamTrainer:
         L = m.num_layers
         ids, labels, positions = self._prepare_micro(mb)
 
+        k = self.lookahead
+        self._prefetch_host("pre")
+        for j in range(min(2 * k, L)):       # read-ahead window: 2k deep
+            self._prefetch_host(f"layer_{j}")
         self._stage("pre")
-        for j in range(min(self.lookahead, L)):
+        for j in range(min(k, L)):           # device window: k deep
             self._stage(f"layer_{j}")
         x = self._program("pre_fwd")(self._staged["pre"], ids, positions)
         self._release("pre")
@@ -385,7 +515,12 @@ class LayerStreamTrainer:
             if keep_activations:
                 xs.append(x)
             self._release(g)
-            nxt = i + self.lookahead
+            nxt = i + k
+            pf = i + 2 * k
+            if pf < L:
+                self._prefetch_host(f"layer_{pf}")
+            else:
+                self._prefetch_host("head")
             if nxt < L:
                 self._stage(f"layer_{nxt}")
         head = self._stage("head")
@@ -403,32 +538,41 @@ class LayerStreamTrainer:
         aux_total, xs, (ids, labels, positions) = self.micro_forward(
             mb, keep_activations=True)
 
+        k = self.lookahead
         head = self._staged["head"]
         loss, dhead, dx = self._program("head_bwd")(head, xs[L], labels)
-        self._acc_grads(dhead)
+        self._enqueue_grads(dhead)
         self._release("head")
 
+        for j in range(min(2 * k, L)):       # reverse read-ahead window
+            self._prefetch_host(f"layer_{L - 1 - j}")
         for i in reversed(range(L)):
             g = f"layer_{i}"
             dev = self._stage(g)
-            for j in range(1, self.lookahead):
+            for j in range(1, k):
                 if i - j >= 0:
                     self._stage(f"layer_{i - j}")
+            pf = i - 2 * k
+            self._prefetch_host(f"layer_{pf}" if pf >= 0 else "pre")
             dp, dx = self._program("block_bwd", i)(dev[g], xs[i],
                                                    positions, dx)
-            self._acc_grads({g: dp})
+            self._enqueue_grads({g: dp})
             self._release(g)
             xs[i + 1] = None                      # free the activation
+            self._drain_grads(keep=k)
         pre = self._stage("pre")
         dpre = self._program("pre_bwd")(pre, ids, positions, dx)
-        self._acc_grads(dpre)
+        self._enqueue_grads(dpre)
         self._release("pre")
+        self._drain_grads(keep=0)
         return loss + aux_total
 
     # ------------------------------------------------------------------
     def apply_grads(self, gas: int, lr: float, clip: float | None) -> None:
         """GAS-boundary host optimizer step, group by group, then refresh
         the bf16 compute cache (and NVMe spill)."""
+        self._drain_grads(keep=0)       # normally already empty
+        self._drain_inflight()          # refresh rewrites the NVMe files
         inv = 1.0 / gas
         for g in self._grad_acc.values():
             g *= inv
@@ -501,8 +645,9 @@ class LayerStreamTrainer:
     def params_view(self) -> dict:
         """The tree exposed as ``engine.state.params``. CPU mode: the LIVE
         cache arrays (in-place refresh keeps them current, no copies).
-        NVMe mode: stride-0 placeholders carrying true shapes/dtypes —
-        checkpoint saves substitute :meth:`host_params_tree` output."""
+        NVMe mode: :class:`NVMeParamPlaceholder` leaves carrying true
+        shapes/dtypes that RAISE on any value access — checkpoint saves
+        substitute :meth:`host_params_tree` output."""
         if not self.nvme:
             return self.host_params_tree()
         out: dict = {}
@@ -510,10 +655,12 @@ class LayerStreamTrainer:
             for top, sub in self.shapes[grp].items():
                 if top in out:
                     continue
-                out[top] = jax.tree.map(
-                    lambda s: np.broadcast_to(
-                        np.zeros((), np.dtype(s.dtype)), s.shape),
+                flat, treedef = jax.tree_util.tree_flatten_with_path(
                     sub, is_leaf=lambda x: isinstance(x, jax.ShapeDtypeStruct))
+                out[top] = jax.tree_util.tree_unflatten(treedef, [
+                    NVMeParamPlaceholder(s.shape, s.dtype,
+                                         _keystr(f"['{top}']", p))
+                    for p, s in flat])
         return out
 
 
