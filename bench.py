@@ -2,7 +2,7 @@
 
 Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
 
-Default metric: training tokens/sec/chip for GPT-2-350M (BASELINE.json
+Primary metric: training tokens/sec/chip for GPT-2-350M (BASELINE.json
 config 1 family), full train step (fwd+bwd+AdamW) in bf16 under jit.
 
 vs_baseline: achieved model-FLOPs utilization relative to the strongest
@@ -10,12 +10,21 @@ training-efficiency number the reference publishes — DeepSpeed-Ulysses'
 sustained 54% of peak on A100 (BASELINE.md: ">175 TFLOPs/GPU (54% of
 peak)"). vs_baseline = our_MFU / 0.54, cross-hardware by necessity.
 
-``BENCH_MODE=fastgen`` instead measures the continuous-batching serving
-engine (BASELINE.md north star 2: FastGen throughput + TTFT): generated
-tokens/sec and p50 TTFT over a normally-distributed request mix, with
-vs_baseline = speedup over serving the same requests one at a time — the
-continuous-batching benefit FastGen's headline numbers quantify against
-static-batching systems.
+The same artifact carries (in ``detail``):
+- ``large_model``: a >=1B-param entry (gpt2-1.3b, remat + ZeRO-Offload
+  optimizer on host) — the regime BASELINE.md's "ZeRO-Offload 13B on
+  1 GPU >30 TFLOPs" row is about (reference docs/_pages/training.md:302).
+- ``streamed``: the ZeRO-Infinity ``offload_param`` layer-streaming path
+  (host-resident params, reference partitioned_param_swapper.py:37) —
+  measured tokens/sec, not asserted.
+- ``fastgen``: continuous-batching serving (BASELINE north star 2) at the
+  default mix AND a reference-shaped long-prompt mix (prompt mu~2600,
+  gen mu~60, blogs/deepspeed-fastgen/README.md:123) with an
+  SLA-conditioned effective throughput (README.md:156 convention).
+
+``BENCH_MODE=fastgen`` runs only the serving benchmark standalone.
+Opt-outs: BENCH_SKIP_FASTGEN / BENCH_SKIP_LARGE / BENCH_SKIP_STREAM /
+BENCH_SKIP_LONG_FASTGEN (each =1), for constrained hosts.
 """
 from __future__ import annotations
 
@@ -23,6 +32,10 @@ import json
 import os
 import sys
 import time
+
+# keep stdout parseable: the ONE JSON line is the contract, and the
+# framework logger streams INFO to stdout (reference convention)
+os.environ.setdefault("DS_TPU_LOG_LEVEL", "warning")
 
 import jax
 import jax.numpy as jnp
@@ -36,36 +49,75 @@ PEAK_BF16_TFLOPS = {
 }
 
 
-def fastgen_main(emit: bool = True):
+class BenchInvalid(RuntimeError):
+    """A measurement failed its physicality/replay gate."""
+
+
+def _peak_tflops() -> float | None:
+    kind = str(jax.devices()[0].device_kind)
+    return next((v for k, v in PEAK_BF16_TFLOPS.items() if k in kind), None)
+
+
+def probe_link() -> dict:
+    """Measure host<->device bandwidth with a warm 64MB transfer each way.
+
+    Offload benchmarks move GBs of optimizer state per step; on a tunneled
+    PJRT (device reached over a network link at ~MB/s) they would measure
+    the tunnel, not the framework. The probe result is recorded in the
+    artifact either way, and gates whether the GB-scale offload entries
+    run at full size.
+    """
+    x = np.ones((16, 1024, 1024), np.float32)  # 64MB
+    d = jax.device_put(x)
+    jax.block_until_ready(d)          # warm the path
+    t0 = time.perf_counter()
+    d2 = jax.device_put(x)
+    jax.block_until_ready(d2)
+    h2d = 0.0625 / (time.perf_counter() - t0)
+    t0 = time.perf_counter()
+    np.asarray(d2)                    # d2 has no cached host copy yet
+    d2h = 0.0625 / (time.perf_counter() - t0)
+    return {"h2d_gbps": round(h2d, 4), "d2h_gbps": round(d2h, 4)}
+
+
+def fastgen_main(emit: bool = True, *, n_req=None, prompt_mu=None,
+                 gen_mu=None, max_seqs=None, max_len=None, chunk=None,
+                 with_sequential=True, sla=False):
     """Continuous-batching serving benchmark (reference FastGen workload
-    shape, scaled: normal prompt/gen lengths, blogs/deepspeed-fastgen
+    shape: normal prompt/gen lengths, blogs/deepspeed-fastgen
     README.md:123). ``emit=False`` returns the result dict instead of
     printing (the training bench embeds it so ONE driver artifact carries
-    both north-star metrics)."""
-    import time
+    both north-star metrics).
 
-    import numpy as np
-
+    ``with_sequential`` also serves the same requests one at a time and
+    reports the continuous/sequential ratio — the static-vs-continuous
+    gap FastGen's headline numbers quantify. ``sla`` adds the
+    SLA-conditioned effective throughput of README.md:156: only tokens
+    from requests meeting per-request latency targets count.
+    """
     from deepspeed_tpu.inference import InferenceEngineV2
     from deepspeed_tpu.models import build_model
     from deepspeed_tpu.parallel.topology import MeshTopology
 
     model_name = os.environ.get("BENCH_MODEL", "gpt2-350m")
-    n_req = int(os.environ.get("BENCH_REQUESTS", "24"))  # same workload in
-    # embedded and standalone runs — the numbers stay comparable
-    prompt_mu = int(os.environ.get("BENCH_PROMPT", "256"))
-    gen_mu = int(os.environ.get("BENCH_GEN", "64"))
-    max_seqs = int(os.environ.get("BENCH_MAX_SEQS", "8"))
+    n_req = n_req or int(os.environ.get("BENCH_REQUESTS", "24"))
+    prompt_mu = prompt_mu or int(os.environ.get("BENCH_PROMPT", "256"))
+    gen_mu = gen_mu or int(os.environ.get("BENCH_GEN", "64"))
+    max_seqs = max_seqs or int(os.environ.get("BENCH_MAX_SEQS", "8"))
+    MAX_LEN = max_len or int(os.environ.get("BENCH_MAX_LEN", "2048"))
+    chunk = chunk or int(os.environ.get("BENCH_CHUNK", "128"))
+    # SLA targets (README.md:156 uses TTFT/TBT latency SLAs; thresholds
+    # are hardware-relative so they are env-tunable and recorded)
+    sla_ttft_s = float(os.environ.get("BENCH_SLA_TTFT_S", "4.0"))
+    sla_tbt_s = float(os.environ.get("BENCH_SLA_TBT_S", "0.10"))
 
-    model = build_model(model_name, max_seq_len=2048)
+    model = build_model(model_name, max_seq_len=MAX_LEN)
     r = np.random.default_rng(0)
-
-    MAX_LEN = 2048
 
     def lengths(mu, n, hi):
         return np.clip(r.normal(mu, 0.3 * mu, n).astype(int), 8, hi)
 
-    gens = [int(g) for g in lengths(gen_mu, n_req, MAX_LEN // 4)]
+    gens = [int(g) for g in lengths(gen_mu, n_req, max(8, MAX_LEN // 8))]
     # prompt + its generation budget must fit the context window
     prompts = [list(map(int, r.integers(0, model.config.vocab_size, (L,))))
                for L in lengths(prompt_mu, n_req, MAX_LEN - max(gens) - 1)]
@@ -76,7 +128,7 @@ def fastgen_main(emit: bool = True):
     pool_frac = float(os.environ.get("BENCH_POOL_FRAC", "0.6"))
 
     def serve(max_live):
-        worst = max_live * (2048 // 32)
+        worst = max_live * (MAX_LEN // 32)
         need = max(int(np.ceil((max(len(p) for p in prompts)
                                 + max(gens)) / 32)),
                    int(worst * pool_frac))
@@ -84,7 +136,8 @@ def fastgen_main(emit: bool = True):
         eng = InferenceEngineV2(
             model, rng=jax.random.PRNGKey(0),
             config={"block_size": 32, "num_blocks": n_blocks,
-                    "max_seqs": max_live, "chunk": 128, "max_seq_len": 2048},
+                    "max_seqs": max_live, "chunk": chunk,
+                    "max_seq_len": MAX_LEN},
             topology=MeshTopology({"tensor": 1, "data": 1}))
         # one 2W-token request walks remaining through W, W/2, ..., 1 and
         # compiles prefill + every pow2 window + single-step decode
@@ -95,6 +148,7 @@ def fastgen_main(emit: bool = True):
 
         pending = list(range(n_req))
         live, ttft, admit, ttft_adm = set(), {}, {}, {}
+        first_tok, done_info = {}, {}
         # closed workload: every request "arrives" at t0, so TTFT includes
         # time spent queued for a slot (the FastGen-comparison convention);
         # ttft_adm measures from ADMISSION (prefill+first-token latency)
@@ -113,16 +167,41 @@ def fastgen_main(emit: bool = True):
             for uid in stepped:
                 ttft.setdefault(uid, now - t0)
                 ttft_adm.setdefault(uid, now - admit[uid])
+                first_tok.setdefault(uid, now)
             for uid in list(live):
                 seq = eng.state.seqs.get(uid)
                 if seq is not None and seq.done:
-                    done_tokens += len(eng.flush(uid))
+                    n_tok = len(eng.flush(uid))
+                    done_tokens += n_tok
+                    done_info[uid] = (n_tok, time.perf_counter())
                     live.remove(uid)
-        return (done_tokens / (time.perf_counter() - t0),
-                float(np.percentile(list(ttft.values()), 50)),
-                float(np.percentile(list(ttft_adm.values()), 50)))
+        wall = time.perf_counter() - t0
+        # SLA-conditioned effective throughput: only tokens of requests
+        # whose prefill+first-token latency and mean inter-token latency
+        # meet the targets count. Decode windows deliver tokens in bursts,
+        # so per-token latency is amortized over the whole generation:
+        # (t_done - t_first_token) / (n_tokens - 1).
+        def _tbt(uid):
+            n_tok, t_done = done_info[uid]
+            if n_tok < 2 or uid not in first_tok:
+                return 0.0
+            return (t_done - first_tok[uid]) / (n_tok - 1)
 
-    tok_s, p50_ttft, p50_adm = serve(max_seqs)  # continuous batching
+        met = [uid for uid in done_info
+               if ttft_adm.get(uid, float("inf")) <= sla_ttft_s
+               and _tbt(uid) <= sla_tbt_s]
+        sla_tokens = sum(done_info[uid][0] for uid in met)
+        return {
+            "tok_s": done_tokens / wall,
+            "prompt_tok_s": sum(len(p) for p in prompts) / wall,
+            "p50_ttft": float(np.percentile(list(ttft.values()), 50)),
+            "p50_ttft_adm": float(np.percentile(list(ttft_adm.values()), 50)),
+            "sla_tok_s": sla_tokens / wall,
+            "sla_met": len(met),
+        }
+
+    res = serve(max_seqs)  # continuous batching
+    tok_s = res["tok_s"]
 
     # Physicality gate: each generated token costs >= 2*N_params matmul
     # flops, so tokens/sec/chip cannot exceed peak/(2N). Decode is already
@@ -131,9 +210,7 @@ def fastgen_main(emit: bool = True):
     shapes = jax.eval_shape(model.init, jax.random.PRNGKey(0),
                             jnp.zeros((1, 8), jnp.int32))
     n_params = sum(int(np.prod(p.shape)) for p in jax.tree.leaves(shapes))
-    kind = jax.devices()[0].device_kind
-    peak = next((v for k, v in PEAK_BF16_TFLOPS.items() if k in str(kind)),
-                None)
+    peak = _peak_tflops()
     if peak and tok_s > peak * 1e12 / (2 * n_params):
         msg = (f"{tok_s:.0f} tok/s exceeds physical bound "
                f"{peak * 1e12 / (2 * n_params):.0f} for {n_params} params")
@@ -142,13 +219,25 @@ def fastgen_main(emit: bool = True):
         print("BENCH INVALID: " + msg, file=sys.stderr, flush=True)
         sys.exit(2)
 
+    seq_tok_s = None
+    if with_sequential:
+        seq_tok_s = serve(1)["tok_s"]      # one request at a time
+
+    out = {"generated_tokens_per_s": round(tok_s, 1),
+           "prompt_tokens_per_s": round(res["prompt_tok_s"], 1),
+           "p50_ttft_s": round(res["p50_ttft"], 3),        # incl. queue wait
+           "p50_ttft_admitted_s": round(res["p50_ttft_adm"], 3),
+           "requests": n_req, "prompt_mu": prompt_mu, "gen_mu": gen_mu,
+           "slots": max_seqs, "max_seq_len": MAX_LEN, "chunk": chunk}
+    if seq_tok_s:
+        out["sequential_tokens_per_s"] = round(seq_tok_s, 1)
+        out["vs_sequential"] = round(tok_s / seq_tok_s, 2)
+    if sla:
+        out["sla"] = {"ttft_s": sla_ttft_s, "tbt_s": sla_tbt_s,
+                      "effective_tokens_per_s": round(res["sla_tok_s"], 1),
+                      "requests_meeting_sla": res["sla_met"]}
     if not emit:
-        return {"generated_tokens_per_s": round(tok_s, 1),
-                "p50_ttft_s": round(p50_ttft, 3),           # incl. queue wait
-                "p50_ttft_admitted_s": round(p50_adm, 3),   # prefill+1st tok
-                "requests": n_req, "prompt_mu": prompt_mu, "gen_mu": gen_mu,
-                "slots": max_seqs}
-    seq_tok_s, _, _ = serve(1)                 # one request at a time
+        return out
 
     print(json.dumps({
         "metric": f"{model_name} FastGen serving throughput "
@@ -156,11 +245,8 @@ def fastgen_main(emit: bool = True):
                   f"prompt~{prompt_mu}, gen~{gen_mu}, {max_seqs} slots)",
         "value": round(tok_s, 1),
         "unit": "generated tokens/sec",
-        "vs_baseline": round(tok_s / seq_tok_s, 2),
-        "detail": {
-            "p50_ttft_s": round(p50_ttft, 3),
-            "p50_ttft_admitted_s": round(p50_adm, 3),
-            "sequential_tokens_per_s": round(seq_tok_s, 1),
+        "vs_baseline": round(tok_s / seq_tok_s, 2) if seq_tok_s else 0.0,
+        "detail": out | {
             "baseline": "continuous batching vs one-request-at-a-time on "
                         "the same engine (the static-vs-continuous gap "
                         "FastGen's headline quantifies)",
@@ -168,26 +254,22 @@ def fastgen_main(emit: bool = True):
     }))
 
 
-def main():
+def measure_training(*, model_name: str, seq_len: int, micro_bs: int,
+                     steps: int, warmup: int, attn: str = "auto",
+                     remat: bool = False, offload: str = "none",
+                     offload_param: str | None = None) -> dict:
+    """One replay-proof training throughput measurement.
+
+    Batches are chained through the previous step's loss bits entirely on
+    device (a caching/replaying backend cannot serve them without truly
+    executing every prior step — VERDICT r01: cached replay produced
+    mfu=21.99), and the post-hoc loss trajectory must actually evolve.
+    Raises :class:`BenchInvalid` instead of returning a non-physical
+    number.
+    """
     import deepspeed_tpu as ds
-    from deepspeed_tpu.models import build_model, get_model_config
+    from deepspeed_tpu.models import build_model
     from deepspeed_tpu.parallel.topology import MeshTopology
-
-    if os.environ.get("BENCH_MODE") == "fastgen":
-        return fastgen_main()
-
-    model_name = os.environ.get("BENCH_MODEL", "gpt2-350m")
-    seq_len = int(os.environ.get("BENCH_SEQ", "1024"))
-    micro_bs = int(os.environ.get("BENCH_MICRO_BS", "8"))
-    steps = int(os.environ.get("BENCH_STEPS", "10"))
-    warmup = int(os.environ.get("BENCH_WARMUP", "3"))
-    attn = os.environ.get("BENCH_ATTN", "auto")   # auto | pallas | xla
-    remat = os.environ.get("BENCH_REMAT", "0") == "1"
-    # large-model configs (BASELINE north star is 7B-class): offload the
-    # optimizer to the host (ZeRO-Offload) so params far beyond the
-    # device-optimizer budget train on one chip, e.g.
-    #   BENCH_MODEL=gpt2-1.5b BENCH_REMAT=1 BENCH_OFFLOAD=cpu
-    offload = os.environ.get("BENCH_OFFLOAD", "none")  # none | cpu | nvme
 
     n_dev = len(jax.devices())
     overrides = {"attn_impl": attn}
@@ -195,34 +277,46 @@ def main():
         overrides |= {"remat": True, "remat_policy": "dots_saveable"}
     model = build_model(model_name, max_seq_len=seq_len, **overrides)
     topo = MeshTopology({"fsdp": n_dev, "data": 1})
-    engine, *_ = ds.initialize(
-        model=model,
-        config={
-            "train_micro_batch_size_per_gpu": micro_bs,
-            "gradient_accumulation_steps": 1,
-            "optimizer": {"type": "AdamW", "params": {"lr": 1e-4,
-                                                      "weight_decay": 0.01}},
-            "zero_optimization": {
-                "stage": 3 if n_dev > 1 else 1,
-                **({"offload_optimizer": {"device": offload}}
-                   if offload != "none" else {})},
-            "steps_per_print": 10_000,
-        },
-        topology=topo,
-    )
+    zero_cfg: dict = {"stage": 3 if n_dev > 1 else 1}
+    if offload != "none":
+        zero_cfg["offload_optimizer"] = {"device": offload}
+    if offload_param is not None:
+        zero_cfg["offload_param"] = {"device": offload_param}
+    engine = None
+    try:
+        engine, *_ = ds.initialize(
+            model=model,
+            config={
+                "train_micro_batch_size_per_gpu": micro_bs,
+                "gradient_accumulation_steps": 1,
+                "optimizer": {"type": "AdamW",
+                              "params": {"lr": 1e-4, "weight_decay": 0.01}},
+                "zero_optimization": zero_cfg,
+                "steps_per_print": 10_000,
+            },
+            topology=topo,
+        )
+        return _measure_with_engine(engine, model, seq_len, steps, warmup,
+                                    model_name, remat, offload,
+                                    offload_param, n_dev)
+    finally:
+        # a failed entry must not poison the next one: drop the engine's
+        # device buffers even while the caller still holds the traceback
+        # (which pins this frame and its locals)
+        if engine is not None and hasattr(engine, "close"):
+            engine.close()
+        engine = None
 
+
+def _measure_with_engine(engine, model, seq_len, steps, warmup, model_name,
+                         remat, offload, offload_param, n_dev) -> dict:
     B = engine.config.train_batch_size
     vocab = model.config.vocab_size
     rng = np.random.default_rng(0)
-    base = rng.integers(0, vocab, (B, seq_len)).astype(np.int32)
-
-    base_dev = jnp.asarray(base)
+    base_dev = jnp.asarray(rng.integers(0, vocab, (B, seq_len)),
+                           dtype=jnp.int32)
 
     def derive_batch(prev_loss, i: int) -> dict:
-        """Each step's tokens depend on the previous step's loss BITS — a
-        device-side chain (no host sync, dispatch stays async) that a
-        caching/replaying backend cannot serve without truly executing
-        every prior step (VERDICT r01: cached replay produced mfu=21.99)."""
         bits = jax.lax.bitcast_convert_type(
             jnp.asarray(prev_loss, jnp.float32), jnp.uint32)
         mix = np.uint32((i * 2654435761) % 2**32)
@@ -241,19 +335,11 @@ def main():
     mc = model.config
     attn_flops = 12 * mc.num_layers * seq_len * mc.num_heads * mc.head_dim
     flops_per_token = 6 * n_params + attn_flops
-    kind = jax.devices()[0].device_kind
-    peak = next((v for k, v in PEAK_BF16_TFLOPS.items() if k in str(kind)), None)
+    peak = _peak_tflops()
     tokens_per_step = B * seq_len
 
-    # Replay-proof measurement: batches are chained through the previous
-    # loss entirely on device (see derive_batch; dispatch stays async, one
-    # block at the end), and the post-hoc loss trajectory must actually
-    # evolve. If the number is still unphysical (mfu > 1) after retries,
-    # this is NOT a measurement — exit non-zero, print no JSON.
     if steps < 2:
-        print("BENCH INVALID: need BENCH_STEPS >= 2 for the replay check",
-              file=sys.stderr, flush=True)
-        sys.exit(2)
+        raise BenchInvalid("need steps >= 2 for the replay check")
     suspect = True
     for attempt in range(4):
         loss_arrays = []
@@ -264,7 +350,6 @@ def main():
         jax.block_until_ready(prev)
         dt = time.perf_counter() - t0
         losses = [float(l) for l in loss_arrays]
-        loss = prev
         distinct = len(set(losses))
         tok_s = tokens_per_step * steps / dt
         tok_s_chip = tok_s / n_dev
@@ -278,38 +363,180 @@ def main():
               f"distinct_losses={distinct}/{steps}); retrying",
               file=sys.stderr, flush=True)
 
+    loss = float(losses[-1])
     if suspect:
-        print(f"BENCH INVALID: mfu={mfu:.4f} losses={losses} — refusing to "
-              f"emit a non-physical number", file=sys.stderr, flush=True)
+        raise BenchInvalid(f"mfu={mfu:.4f} losses={losses} — refusing to "
+                           f"emit a non-physical number")
+    return {
+        "model": model_name, "seq_len": seq_len, "batch_size": B,
+        "tokens_per_s_chip": round(tok_s_chip, 1),
+        "tflops_per_chip": round(tflops_chip, 2),
+        "mfu": round(mfu, 4),
+        "params": n_params,
+        "loss": loss,
+        "distinct_losses": f"{distinct}/{steps}",
+        "measure_attempts": attempt + 1,
+        "remat": remat, "offload_optimizer": offload,
+        **({"offload_param": offload_param} if offload_param else {}),
+    }
+
+
+def main():
+    if os.environ.get("BENCH_MODE") == "fastgen":
+        return fastgen_main(with_sequential=True, sla=True)
+
+    model_name = os.environ.get("BENCH_MODEL", "gpt2-350m")
+    seq_len = int(os.environ.get("BENCH_SEQ", "1024"))
+    micro_bs = int(os.environ.get("BENCH_MICRO_BS", "8"))
+    steps = int(os.environ.get("BENCH_STEPS", "10"))
+    warmup = int(os.environ.get("BENCH_WARMUP", "3"))
+    attn = os.environ.get("BENCH_ATTN", "auto")   # auto | pallas | xla
+    remat = os.environ.get("BENCH_REMAT", "0") == "1"
+    offload = os.environ.get("BENCH_OFFLOAD", "none")  # none | cpu | nvme
+
+    kind = jax.devices()[0].device_kind
+    n_dev = len(jax.devices())
+    peak = _peak_tflops()
+
+    # ---- primary: the BASELINE config-1 family (easy regime, peak MFU)
+    try:
+        primary = measure_training(
+            model_name=model_name, seq_len=seq_len, micro_bs=micro_bs,
+            steps=steps, warmup=warmup, attn=attn, remat=remat,
+            offload=offload)
+    except BenchInvalid as e:
+        print(f"BENCH INVALID: {e}", file=sys.stderr, flush=True)
         sys.exit(2)
 
-    # second north-star metric (FastGen throughput + p50 TTFT) rides in
-    # the same artifact; a serving failure must not void the training
-    # number, and BENCH_SKIP_FASTGEN=1 opts out
+    # Offload entries move GBs of state host<->device per step; gate their
+    # size on measured link bandwidth so a tunneled-PJRT host produces an
+    # honest scaled measurement instead of a timeout.
+    link = probe_link()
+    fast_link = min(link["h2d_gbps"], link["d2h_gbps"]) >= 1.0 \
+        or os.environ.get("BENCH_FORCE_LARGE") == "1"
+
+    # ---- >=1B-param entry: remat + host optimizer (ZeRO-Offload regime;
+    # BASELINE.md "ZeRO-Offload 13B on 1 GPU >30 TFLOPs",
+    # reference docs/_pages/training.md:302). Failure is recorded, not
+    # fatal — the primary number must survive a constrained host. On a
+    # slow link the hard regime is long-context instead (activation-bound,
+    # remat + flash attention; no host traffic to confound).
+    def run_entry(fn):
+        """Run a secondary bench entry; one retry on transient runtime
+        errors (the tunneled PJRT occasionally drops a remote_compile mid
+        -flight). A secondary failure is recorded, never fatal."""
+        for attempt in (0, 1):
+            try:
+                return fn()
+            except BenchInvalid as e:
+                return {"error": f"BenchInvalid: {e}"[:200]}
+            except Exception as e:  # noqa: BLE001
+                if attempt == 1:
+                    return {"error": f"{type(e).__name__}: {e}"[:200]}
+                print(f"# secondary entry failed ({type(e).__name__}: "
+                      f"{e}); retrying once", file=sys.stderr, flush=True)
+
+    def large_entry():
+        if fast_link:
+            return measure_training(
+                model_name=os.environ.get("BENCH_LARGE_MODEL", "gpt2-1.3b"),
+                seq_len=int(os.environ.get("BENCH_LARGE_SEQ", "1024")),
+                micro_bs=int(os.environ.get("BENCH_LARGE_MICRO_BS", "4")),
+                steps=int(os.environ.get("BENCH_LARGE_STEPS", "5")),
+                warmup=2, attn=attn, remat=True, offload="cpu")
+        out = measure_training(
+            model_name=os.environ.get("BENCH_LARGE_MODEL", "gpt2-350m"),
+            seq_len=int(os.environ.get("BENCH_LARGE_SEQ", "8192")),
+            micro_bs=int(os.environ.get("BENCH_LARGE_MICRO_BS", "1")),
+            steps=int(os.environ.get("BENCH_LARGE_STEPS", "5")),
+            warmup=2, attn=attn, remat=True)
+        out["note"] = (
+            "long-context hard regime (remat + flash attention); "
+            "the 1.3b ZeRO-Offload entry needs >=1 GB/s "
+            "host-device, measured link is slower (see link_probe)")
+        return out
+
+    large = None
+    if os.environ.get("BENCH_SKIP_LARGE") != "1":
+        large = run_entry(large_entry)
+
+    # ---- ZeRO-Infinity offload_param streamed path: host-resident params
+    # walked layer-by-layer (reference partitioned_param_swapper.py:37).
+    # Measured, not asserted — low is honest, unknown is not. On a slow
+    # link the model scales down so per-step host traffic stays bounded;
+    # the entry still exercises the full streaming machinery.
+    def streamed_entry():
+        out = measure_training(
+            model_name=os.environ.get(
+                "BENCH_STREAM_MODEL",
+                "gpt2-1.3b" if fast_link else "gpt2-125m"),
+            seq_len=int(os.environ.get("BENCH_STREAM_SEQ", "1024")),
+            micro_bs=int(os.environ.get("BENCH_STREAM_MICRO_BS", "4")),
+            steps=int(os.environ.get("BENCH_STREAM_STEPS",
+                                     "3" if fast_link else "2")),
+            warmup=1, attn=attn, remat=True, offload="cpu",
+            offload_param="cpu")
+        if not fast_link:
+            out["note"] = (
+                "scaled to the measured host-device link (see "
+                "link_probe): per-step traffic = full param + grad "
+                "footprint; tokens/sec is link-bound, not HBM-bound")
+        return out
+
+    streamed = None
+    if os.environ.get("BENCH_SKIP_STREAM") != "1":
+        streamed = run_entry(streamed_entry)
+
+    # ---- second north-star metric (FastGen throughput + p50 TTFT) rides
+    # in the same artifact; a serving failure must not void the training
+    # number. Default mix carries the continuous-vs-sequential ratio; the
+    # long-prompt mix (reference benchmark convention, prompt mu~2600)
+    # carries the SLA-conditioned effective throughput.
     fastgen = None
     if os.environ.get("BENCH_SKIP_FASTGEN") != "1":
         try:
-            del engine  # free HBM for the serving engine
-            fastgen = fastgen_main(emit=False)
+            fastgen = fastgen_main(emit=False, with_sequential=True,
+                                   sla=True)
         except Exception as e:  # pragma: no cover
             fastgen = {"error": f"{type(e).__name__}: {e}"[:200]}
 
+    fastgen_long = None
+    if os.environ.get("BENCH_SKIP_FASTGEN") != "1" \
+            and os.environ.get("BENCH_SKIP_LONG_FASTGEN") != "1":
+        try:
+            fastgen_long = fastgen_main(
+                emit=False,
+                n_req=int(os.environ.get("BENCH_LONG_REQUESTS", "12")),
+                prompt_mu=int(os.environ.get("BENCH_LONG_PROMPT", "2600")),
+                gen_mu=int(os.environ.get("BENCH_LONG_GEN", "60")),
+                max_seqs=int(os.environ.get("BENCH_LONG_MAX_SEQS", "8")),
+                max_len=int(os.environ.get("BENCH_LONG_MAX_LEN", "4096")),
+                chunk=int(os.environ.get("BENCH_LONG_CHUNK", "512")),
+                with_sequential=False, sla=True)
+        except Exception as e:  # pragma: no cover
+            fastgen_long = {"error": f"{type(e).__name__}: {e}"[:200]}
+
     print(json.dumps({
         "metric": f"{model_name} ZeRO train throughput "
-                  f"({kind}, seq={seq_len}, bs={B}, {n_dev} chip)",
-        "value": round(tok_s_chip, 1),
+                  f"({kind}, seq={seq_len}, bs={primary['batch_size']}, "
+                  f"{n_dev} chip)",
+        "value": primary["tokens_per_s_chip"],
         "unit": "tokens/sec/chip",
-        "vs_baseline": round(mfu / 0.54, 4) if peak else 0.0,
+        "vs_baseline": round(primary["mfu"] / 0.54, 4) if peak else 0.0,
         "detail": {
             "suspect_cached_replay": False,  # suspect runs exit 2, no JSON
-            "measure_attempts": attempt + 1,
-            "distinct_losses": f"{distinct}/{steps}",
-            "tflops_per_chip": round(tflops_chip, 2),
-            "mfu": round(mfu, 4),
-            "params": n_params,
-            "loss": float(loss),
+            "measure_attempts": primary["measure_attempts"],
+            "distinct_losses": primary["distinct_losses"],
+            "tflops_per_chip": primary["tflops_per_chip"],
+            "mfu": primary["mfu"],
+            "params": primary["params"],
+            "loss": primary["loss"],
             "baseline": "DeepSpeed-Ulysses 54% of peak (BASELINE.md)",
+            "link_probe": link,
+            "large_model": large,
+            "streamed": streamed,
             "fastgen": fastgen,
+            "fastgen_long_prompt": fastgen_long,
         },
     }))
 

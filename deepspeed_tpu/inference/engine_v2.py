@@ -289,7 +289,9 @@ class InferenceEngineV2:
                 # mixtral routes every token); token counts per step are
                 # tiny so the no-drop capacity is cheap. NB this diverges
                 # from the v1/training forward exactly when eval capacity
-                # would bind — there v1 drops overflow tokens, v2 doesn't.
+                # would bind — there v1 drops overflow tokens, v2 doesn't
+                # (enforced by tests/test_moe.py::
+                # test_capacity_divergence_v1_drops_v2_routes_all).
                 mod = MoE(**moe_layer_kwargs(m, drop_tokens=False))
                 out = mod.apply({"params": p["moe"]["moe_layer"]}, h, True)
                 se = m.moe.shared_expert_intermediate

@@ -1199,6 +1199,26 @@ class DeepSpeedEngine:
     def num_parameters(self) -> int:
         return sum(l.size for l in jax.tree.leaves(self.state.params))
 
+    def close(self) -> None:
+        """Release the engine's device buffers immediately.
+
+        A failed or finished engine must not pin HBM while references to it
+        (e.g. a traceback in a caller's except block, or a bench harness
+        moving to its next entry) are still alive — jax frees buffers by
+        refcount, so an explicit delete is the only prompt path. The engine
+        is unusable afterwards.
+        """
+        if self.state is None:
+            return
+        for leaf in jax.tree.leaves(self.state):
+            if isinstance(leaf, jax.Array):
+                try:
+                    leaf.delete()
+                except RuntimeError:
+                    pass  # already deleted (donated into a later step)
+        self.state = None
+        self._param_stream = None
+
     # --- checkpointing (reference engine.py:3109/:2763) -----------------
     def save_checkpoint(self, save_dir: str, tag: str | None = None,
                         client_state: dict | None = None) -> str:

@@ -171,3 +171,18 @@ def test_num_parameters():
     engine, *_ = ds.initialize(model=build_model("tiny-gpt2"),
                                config=base_config(mesh={"data": 8}))
     assert engine.num_parameters() == build_model("tiny-gpt2").config.num_params()
+
+
+def test_close_releases_device_buffers():
+    """close() deletes the state's arrays promptly (bench entries rely on
+    this so a failed run can't pin HBM through a live traceback)."""
+    engine, *_ = ds.initialize(model=build_model("tiny-gpt2"),
+                               config=base_config(mesh={"data": 8}))
+    engine.train_batch(make_batch(engine.config.train_batch_size))
+    leaves = [l for l in jax.tree.leaves(engine.state)
+              if isinstance(l, jax.Array)]
+    assert leaves
+    engine.close()
+    assert engine.state is None
+    assert all(l.is_deleted() for l in leaves)
+    engine.close()  # idempotent

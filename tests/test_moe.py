@@ -56,6 +56,47 @@ def test_capacity_drops_overflow():
     assert (tok_gate > 0).sum() == cap
 
 
+def test_capacity_divergence_v1_drops_v2_routes_all():
+    """Pin the documented training/v1 vs serving/v2 boundary: past expert
+    capacity, the capacity path (drop_tokens=True — training and the v1
+    engine) DROPS overflow tokens while the FastGen v2 forward routes
+    every token (drop_tokens=False, inference/engine_v2.py ``ffn``).
+
+    Same params, same input, capacity binding → kept tokens agree exactly,
+    overflow tokens get a zero FFN delta under v1 and a real one under v2.
+    """
+    # adversarial routing: every token prefers expert 0, so a tiny eval
+    # capacity is guaranteed to bind
+    H, S, n = 8, 16, 4
+    drop = MoE(hidden_size=H, num_experts=n, ffn_size=16, k=1,
+               eval_capacity_factor=0.5, min_capacity=2, drop_tokens=True,
+               aux_loss_weight=0.0, z_loss_weight=0.0)
+    nodrop = MoE(hidden_size=H, num_experts=n, ffn_size=16, k=1,
+                 eval_capacity_factor=0.5, min_capacity=2, drop_tokens=False,
+                 aux_loss_weight=0.0, z_loss_weight=0.0)
+    # positive tokens + a wg column of +10 on expert 0 → every token's
+    # expert-0 logit is large positive → all S tokens route to expert 0
+    x = jnp.asarray(np.abs(np.random.default_rng(0).standard_normal(
+        (1, S, H))) + 0.1, jnp.float32)
+    params = drop.init(jax.random.PRNGKey(0), x)["params"]
+    wg_box = params["gate"]["wg"]
+    wg = np.zeros(wg_box.value.shape, np.float32)
+    wg[:, 0] = 10.0
+    params["gate"]["wg"] = wg_box.replace_boxed(jnp.asarray(wg))
+
+    out_drop, _ = drop.apply({"params": params}, x, True, mutable=["losses"])
+    out_nodrop, _ = nodrop.apply({"params": params}, x, True,
+                                 mutable=["losses"])
+    cap = compute_capacity(S, n, 1, 0.5, 2)
+    d, nd = np.asarray(out_drop[0]), np.asarray(out_nodrop[0])
+    dropped = np.all(d == 0.0, axis=-1)          # zero FFN delta = dropped
+    assert dropped.sum() == S - cap              # capacity bound drops
+    # v2 routes the overflow tokens v1 dropped
+    assert np.all(np.any(nd[dropped] != 0.0, axis=-1))
+    # on kept tokens the two paths agree exactly (same expert, same gate)
+    np.testing.assert_allclose(d[~dropped], nd[~dropped], rtol=1e-6)
+
+
 def test_top2_gates_normalized():
     out = top2gating(_logits(), capacity_factor=4.0)
     tok_gate = np.asarray(out.combine).sum(axis=(2, 3))    # [G,S]
