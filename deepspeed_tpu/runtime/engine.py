@@ -92,12 +92,19 @@ class DeepSpeedEngine:
                  activation_rules: list | None = None):
         self.config = config
         self.model = model
-        if topology is not None and config.zero_optimization.mics_shard_size > 0:
+        if topology is not None and (
+                config.zero_optimization.mics_shard_size > 0
+                or config.zero_optimization.zero_hpz_partition_size > 1):
             raise ValueError(
-                "mics_shard_size requires the engine to build the mesh (the "
-                "MiCS transform re-specs the fsdp/data axes) — pass the mesh "
-                "via config['mesh'] instead of a prebuilt topology")
-        self.topology = topology or self._build_topology(config)
+                "mics_shard_size / zero_hpz_partition_size require the "
+                "engine to build the mesh (both re-spec the fsdp/data "
+                "axes) — pass the mesh via config['mesh'] instead of a "
+                "prebuilt topology")
+        self._hpz_folded = False
+        if topology is not None:
+            self.topology = topology
+        else:
+            self.topology, self._hpz_folded = self._build_topology(config)
         config.resolve_batch_terms(self.topology.dp_world_size)
 
         # activation checkpointing: flip the model zoo's remat switch from the
@@ -302,8 +309,13 @@ class DeepSpeedEngine:
                            "quantized comm is a no-op, running dense")
 
     @staticmethod
-    def _build_topology(config: Config) -> MeshTopology:
-        """Mesh construction with the MiCS transform (reference
+    def _build_topology(config: Config) -> tuple[MeshTopology, bool]:
+        """Mesh construction with the MiCS/hpZ transforms; returns
+        ``(topology, hpz_folded)`` — the second element is the single
+        source of truth for whether hpZ master re-sharding applies (the
+        planner must not re-derive it from config alone).
+
+        MiCS (reference
         runtime/zero/mics.py:64 `MiCS_Init`): ``mics_shard_size=p`` shards
         ZeRO state over sub-groups of p devices and replicates across the
         groups. Under GSPMD that IS a mesh re-spec — the fsdp axis shrinks
@@ -314,23 +326,59 @@ class DeepSpeedEngine:
         allgather code for this; XLA derives it from the sharding."""
         topo = MeshTopology(config.mesh)
         mics = config.zero_optimization.mics_shard_size
+        hpz = config.zero_optimization.zero_hpz_partition_size
+        if mics and mics > 0 and hpz and hpz > 1:
+            raise ValueError(
+                "mics_shard_size and zero_hpz_partition_size both re-spec "
+                "the fsdp axis — pick one (MiCS replicates the whole ZeRO "
+                "state per group; hpZ only the compute param copy)")
+
+        def fold_fsdp(group: int, feature: str) -> MeshTopology:
+            """Shrink fsdp to ``group`` (innermost of the DP axes in
+            AXIS_ORDER = ICI-adjacent) and fold the group count into data.
+            Shared by MiCS and hpZ so both validate identically."""
+            fs = topo.size("fsdp")
+            if fs % group:
+                raise ValueError(f"{feature} {group} must divide the fsdp "
+                                 f"axis ({fs})")
+            sizes = dict(topo.axis_sizes)
+            sizes["fsdp"] = group
+            sizes["data"] = sizes.get("data", 1) * (fs // group)
+            return MeshTopology(sizes)
+
+        if hpz and hpz > 1:
+            # hpZ (ZeRO++ secondary tensor partition, reference
+            # stage3.py:155,495): the COMPUTE param copy shards over an
+            # ICI-adjacent subgroup of hpz devices so forward/backward
+            # all-gathers never leave the fast domain, while master/opt
+            # keep the full primary partition (the planner shards them
+            # over data x fsdp jointly — see build_plan).
+            if config.zero_optimization.stage != 3:
+                raise ValueError("zero_hpz_partition_size needs ZeRO "
+                                 "stage 3 (it re-partitions stage-3 param "
+                                 "gathers)")
+            fs = topo.size("fsdp")
+            if fs == hpz:
+                logger.info("hpZ: partition size equals the fsdp axis — "
+                            "secondary == primary, nothing to re-spec")
+                return topo, False
+            new = fold_fsdp(hpz, "zero_hpz_partition_size")
+            logger.info(f"hpZ: param gathers now span {hpz}-device ICI "
+                        f"groups; primary partition stays {fs}-wide over "
+                        f"data x fsdp (mesh now {new.axis_sizes})")
+            return new, True
         if mics is None or mics <= 0:
-            return topo
+            return topo, False
         if config.zero_optimization.stage < 1:
             raise ValueError("mics_shard_size needs ZeRO stage >= 1")
         fs = topo.size("fsdp")
         if fs == mics:
-            return topo
-        if fs % mics:
-            raise ValueError(f"mics_shard_size {mics} must divide the fsdp "
-                             f"axis ({fs})")
-        sizes = dict(topo.axis_sizes)
-        sizes["fsdp"] = mics
-        sizes["data"] = sizes.get("data", 1) * (fs // mics)
+            return topo, False
+        new = fold_fsdp(mics, "mics_shard_size")
         logger.info(f"MiCS: fsdp {fs} -> shard groups of {mics}, "
                     f"{fs // mics}x replication folded into data "
-                    f"(mesh now {sizes})")
-        return MeshTopology(sizes)
+                    f"(mesh now {new.axis_sizes})")
+        return new, False
 
     def _init_state(self, params, sample_batch, rng):
         cfg = self.config
@@ -355,7 +403,8 @@ class DeepSpeedEngine:
         else:
             raise ValueError("need a model or initial params")
 
-        self.plan: ZeroPlan = build_plan(topo, cfg.zero_optimization, abstract)
+        self.plan: ZeroPlan = build_plan(topo, cfg.zero_optimization, abstract,
+                                         hpz_active=self._hpz_folded)
         self._sample_batch = sample_batch
         self._abstract_master = jax.eval_shape(
             lambda t: _cast_tree(unbox_params(t), jnp.float32), abstract)

@@ -163,25 +163,32 @@ def _add_fsdp(entries: list[Any], shape: tuple[int, ...], topology: MeshTopology
 
 def build_plan(topology: MeshTopology, zero_config: ZeroConfig,
                abstract_params: Pytree,
-               logical_rules: dict[str, str | None] | None = None) -> ZeroPlan:
+               logical_rules: dict[str, str | None] | None = None,
+               hpz_active: bool = False) -> ZeroPlan:
     """Compute the sharding plan from parameter shapes + logical metadata.
 
     ``abstract_params`` may contain flax ``Partitioned`` boxes (preferred) or
     bare arrays / ShapeDtypeStructs (fsdp heuristic only).
+
+    ``hpz_active``: whether the engine folded the mesh for hpZ. Only the
+    engine's fold flag may enable this (hpZ master re-sharding is
+    meaningless on an unfolded mesh), so it defaults to False for direct
+    callers and is never derived from config here.
     """
     stage = zero_config.stage
-    if zero_config.zero_hpz_partition_size > 1:
-        logger.info(
-            "hpZ: secondary intra-node param partitions are an explicit "
-            "cache in the reference (stage3.py:155); under GSPMD the fsdp "
-            "axis already sits on ICI-adjacent devices and XLA schedules "
-            "hierarchical gathers itself — for an explicit ICI-domain "
-            "shard, use mics_shard_size instead")
     rules = dict(DEFAULT_LOGICAL_RULES)
     if logical_rules:
         rules.update(logical_rules)
 
     fsdp_axes: tuple[str, ...] = ("fsdp",)
+    # hpZ (ZeRO++ secondary tensor partition, reference stage3.py:155,495):
+    # the engine has already shrunk the fsdp axis to the hpz partition size
+    # and folded the group count into data. The COMPUTE param copy shards
+    # over fsdp only (gathers stay inside the ICI subgroup); master/opt —
+    # the primary partition — shard over data x fsdp jointly so stage-3
+    # optimizer memory stays divided by the full DP world, not by the
+    # subgroup.
+    master_axes: tuple[str, ...] = ("data", "fsdp") if hpz_active else fsdp_axes
     persistence_threshold = zero_config.stage3_param_persistence_threshold
 
     is_leaf = _is_boxed
@@ -199,7 +206,8 @@ def build_plan(topology: MeshTopology, zero_config: ZeroConfig,
         # master/opt spec: sharded from stage 1 (always worth it: fp32 × 3)
         m_entries = list(base)
         if stage >= 1:
-            m_entries = _add_fsdp(m_entries, shape, topology, fsdp_axes, min_size=0)
+            m_entries = _add_fsdp(m_entries, shape, topology, master_axes,
+                                  min_size=0)
         # grads: stage ≥2 reduce-scattered to master shard, else like params
         g_entries = list(m_entries) if stage >= 2 else list(p_entries)
         return P(*p_entries), P(*m_entries), P(*g_entries)
