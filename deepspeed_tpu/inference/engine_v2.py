@@ -53,6 +53,15 @@ from .weights import load_tp_params
 
 Pytree = Any
 
+#: TP kind -> weight PartitionSpec, the single source for quantize-time
+#: sharding, matmul-time shard_map specs, and stacked-layer shardings.
+#: 2D = dense [K, N] QuantLinear; 3D = grouped [n, K, N] QuantGrouped.
+KIND_SPEC_2D = {"row": P("tensor", None), "col": P(None, "tensor"),
+                "rep": P(None, None)}
+KIND_SPEC_3D = {"row": P(None, "tensor", None),
+                "col": P(None, None, "tensor"),
+                "rep": P(None, None, None)}
+
 
 @dataclass
 class RaggedInferenceConfig:
@@ -85,6 +94,12 @@ class RaggedInferenceConfig:
 
 
 class InferenceEngineV2:
+    #: token-tile size shared by the quantized-MoE sort alignment and the
+    #: grouped quant GEMM — the tile→expert map is only meaningful when
+    #: both use the SAME value (serving steps carry few tokens, so small
+    #: tiles waste less padding than the training default of 128)
+    _MOE_GEMM_BLOCK_M = 32
+
     def __init__(self, model: TransformerLM, params: Pytree | None = None,
                  config: RaggedInferenceConfig | dict | None = None,
                  topology: MeshTopology | None = None,
@@ -121,14 +136,10 @@ class InferenceEngineV2:
         self.params, plan = load_tp_params(model, params, rng, topology,
                                            cfg.dtype)
         if cfg.quant_bits:
-            if topology.mesh.size > 1:
-                raise ValueError("quant_bits serving requires a "
-                                 "single-device mesh (group quantization "
-                                 "is incompatible with TP sharding)")
             if cfg.quant_bits not in (4, 8, "fp8"):
                 raise ValueError(f"quant_bits must be 4, 8 or 'fp8', got "
                                  f"{cfg.quant_bits}")
-            self._quantize_weights(cfg.quant_bits)
+            self._quantize_weights(cfg.quant_bits, plan)
         # stack homogeneous layers [L, ...] so the ragged forward can
         # lax.scan over depth — compile time stays flat vs num_layers
         # (reference inference_transformer_base.py:535's per-layer loop is
@@ -143,9 +154,6 @@ class InferenceEngineV2:
                       for i in range(m.num_layers)]
             stack_kw = {}
             if not cfg.quant_bits:
-                # quantized trees changed structure vs the plan's specs,
-                # and their int8/uint8 buffers can't alias the stack —
-                # sharding/donation hints apply to the bf16 case only
                 is_p = lambda x: isinstance(x, P)
                 stack_kw["out_shardings"] = jax.tree.map(
                     lambda p: NamedSharding(topology.mesh, P(None, *p)),
@@ -153,6 +161,35 @@ class InferenceEngineV2:
                 # donate: each per-layer buffer frees as it is copied, so
                 # init never holds 2x the layer weights in HBM
                 stack_kw["donate_argnums"] = (0,)
+            else:
+                # quantized trees changed structure vs the plan's specs:
+                # QuantLinear leaves take their 2D spec from the recorded
+                # TP kind, everything else walks the original plan by dict
+                # path. (No donation — int8/uint8 buffers can't alias the
+                # stack.)
+                from jax.tree_util import DictKey, tree_map_with_path
+
+                spec0 = plan.param_specs["layer_0"]
+
+                def stacked_sharding(path, leaf):
+                    names = [p.key for p in path if isinstance(p, DictKey)]
+                    last = names[-1] if names else ""
+                    # routed-expert slabs live at moe/moe_layer/experts/*;
+                    # the qwen2-moe shared expert (moe/shared_expert/*)
+                    # stays bf16 and must fall through to the plan walk
+                    if "experts" in names and f"moe_{last}" in self._qkind:
+                        spec = KIND_SPEC_3D[self._qkind[f"moe_{last}"]]
+                    elif "moe" not in names and last in self._qkind:
+                        spec = KIND_SPEC_2D[self._qkind[last]]
+                    else:
+                        node = spec0
+                        for n in names:
+                            node = node[n]
+                        spec = node
+                    return NamedSharding(topology.mesh, P(None, *spec))
+
+                stack_kw["out_shardings"] = tree_map_with_path(
+                    stacked_sharding, layers[0])
             self.params["layers_stacked"] = jax.jit(
                 lambda ls: jax.tree.map(lambda *xs: jnp.stack(xs), *ls),
                 **stack_kw)(layers)
@@ -202,40 +239,164 @@ class InferenceEngineV2:
             f"chunk={cfg.chunk} tp={topology.size('tensor')}")
 
     # ------------------------------------------------------------------
-    def _quantize_weights(self, bits: int) -> None:
+    @staticmethod
+    def _tp_kind(spec) -> str:
+        """Classify a weight's TP sharding for its 2D [K, N] matmul view:
+        ``col`` = output columns sharded (gather-free, per-shard GEMM),
+        ``row`` = contraction dim sharded (per-shard GEMM + psum),
+        ``rep`` = replicated."""
+        def has_t(e):
+            return e == "tensor" or (isinstance(e, (tuple, list))
+                                     and "tensor" in e)
+
+        entries = tuple(spec) if spec is not None else ()
+        if entries and has_t(entries[0]):
+            return "row"
+        if any(has_t(e) for e in entries[1:]):
+            return "col"
+        return "rep"
+
+    def _quantize_weights(self, bits: int, plan) -> None:
         """ZeRO-Inference for the ragged engine: matmul weights become
         QuantLinear codes+scales consumed by the in-tile-dequant Pallas
         GEMM (reference inference/v2/kernels/cutlass_ops/mixed_gemm/).
-        MoE expert weights stay bf16 (grouped GEMM path; not quantized
-        yet). The untied unembedding quantizes too; the embedding table
-        stays exact (it is gathered, not matmul'd)."""
-        from ..ops.pallas.quant_matmul import quantize_weight
+
+        TP-composable (reference model_implementations/sharding/): on a
+        multi-device mesh each tensor shard quantizes ITS slice inside a
+        shard_map, so group boundaries live within shards and the codes/
+        scales carry the same tensor-axis sharding as the bf16 weights
+        they replace. The matmuls then run per-shard via ``_qmm``.
+        MoE routed-expert weights quantize into QuantGrouped slabs served
+        by the grouped in-tile-dequant GEMM (reference cutlass_ops/
+        moe_gemm/) — the gate and the qwen2-moe shared expert stay exact
+        (tiny, and the router is precision-critical). The untied
+        unembedding quantizes too; the embedding table stays exact (it is
+        gathered, not matmul'd)."""
+        from jax import shard_map
+
+        from ..ops.pallas.quant_matmul import (quantize_grouped,
+                                               quantize_weight)
 
         m = self.mcfg
+        mesh = self.topology.mesh
+        tp = self.topology.size("tensor")
+        self._qkind: dict[str, str] = {}
+        spec0 = plan.param_specs.get("layer_0", {})
 
-        def q2d(w, K: int) -> Any:
+        # one jitted per-shard quantize program per (kind, grouped): the
+        # same 7-ish weight shapes repeat every layer, and the jit cache
+        # keys on function identity — a fresh lambda per weight would
+        # compile O(layers x weights) programs
+        quant_fns: dict[tuple, Any] = {}
+
+        def quant_fn(kind: str, grouped: bool):
+            key = (kind, grouped)
+            if key not in quant_fns:
+                ws = (KIND_SPEC_3D if grouped else KIND_SPEC_2D)[kind]
+                qf = quantize_grouped if grouped else quantize_weight
+                quant_fns[key] = jax.jit(shard_map(
+                    lambda wl: qf(wl, bits=bits),
+                    mesh=mesh, in_specs=(ws,), out_specs=ws,
+                    check_vma=False))
+            return quant_fns[key]
+
+        def q2d(w, K: int, name: str, spec) -> Any:
+            kind = self._tp_kind(spec) if tp > 1 else "rep"
+            self._qkind[name] = kind
             w2 = jnp.asarray(w, jnp.float32).reshape(K, -1)
-            return quantize_weight(w2, bits=bits)
+            if mesh.size == 1:
+                return quantize_weight(w2, bits=bits)
+            return quant_fn(kind, grouped=False)(w2)
+
+        def qg3(w, name: str, spec) -> Any:
+            """Stacked expert weights [n, K, N]: kind reads dims 1/2 (dim 0
+            is the expert slab index, never tensor-sharded on a serving
+            mesh)."""
+            kind = self._tp_kind(tuple(spec)[1:]) \
+                if tp > 1 and spec is not None else "rep"
+            self._qkind[name] = kind
+            w3 = jnp.asarray(w, jnp.float32)
+            if mesh.size == 1:
+                return quantize_grouped(w3, bits=bits)
+            return quant_fn(kind, grouped=True)(w3)
 
         before = sum(l.nbytes for l in jax.tree.leaves(self.params))
         E = m.hidden_size
         for i in range(m.num_layers):
             layer = self.params[f"layer_{i}"]
             a = layer["attn"]
+            sa = spec0.get("attn", {})
             for k in ("wq", "wk", "wv"):
-                a[k] = q2d(a[k], E)                       # [E, (H|KV)*D]
-            a["wo"] = q2d(a["wo"], m.num_heads * m.head_dim)
+                a[k] = q2d(a[k], E, k, sa.get(k))         # [E, (H|KV)*D]
+            a["wo"] = q2d(a["wo"], m.num_heads * m.head_dim, "wo",
+                          sa.get("wo"))
             if "ffn" in layer:
                 f = layer["ffn"]
+                sf = spec0.get("ffn", {})
                 for k in ("w_gate", "w_up"):
                     if k in f:
-                        f[k] = q2d(f[k], E)
-                f["w_down"] = q2d(f["w_down"], m.ffn_size)
+                        f[k] = q2d(f[k], E, k, sf.get(k))
+                f["w_down"] = q2d(f["w_down"], m.ffn_size, "w_down",
+                                  sf.get("w_down"))
+            if "moe" in layer:
+                ex = layer["moe"]["moe_layer"]["experts"]
+                se = (spec0.get("moe", {}).get("moe_layer", {})
+                      .get("experts", {}))
+                for k in ("w_gate", "w_up", "w_down"):
+                    if k in ex:
+                        ex[k] = qg3(ex[k], f"moe_{k}", se.get(k))
         if not m.tie_embeddings:
-            self.params["unembed"] = q2d(self.params["unembed"], E)
+            self.params["unembed"] = q2d(
+                self.params["unembed"], E, "unembed",
+                plan.param_specs.get("unembed"))
         after = sum(l.nbytes for l in jax.tree.leaves(self.params))
         logger.info(f"engine_v2 int{bits} weights: "
                     f"{before / 1e6:.0f}MB -> {after / 1e6:.0f}MB")
+
+    def _qmm(self, x2d, qw, name: str):
+        """Quantized matmul dispatch: single device runs the Pallas kernel
+        directly; on a mesh it runs per-shard through shard_map with specs
+        from the weight's TP kind (pallas_call has no GSPMD rule). ``row``
+        weights contract a sharded K, so the partial products psum over
+        the tensor axis — the same collective GSPMD inserts for the dense
+        einsum."""
+        from jax import shard_map
+
+        from ..ops.pallas.quant_matmul import quant_matmul
+
+        mesh = self.topology.mesh
+        if mesh.size == 1:
+            return quant_matmul(x2d, qw)
+        kind = self._qkind[name]
+        ws = KIND_SPEC_2D[kind]
+        xs = P(None, "tensor") if kind == "row" else P(None, None)
+        os_ = P(None, "tensor") if kind == "col" else P(None, None)
+        fn = (lambda xl, ql: jax.lax.psum(quant_matmul(xl, ql), "tensor")) \
+            if kind == "row" else quant_matmul
+        return shard_map(fn, mesh=mesh, in_specs=(xs, ws), out_specs=os_,
+                         check_vma=False)(x2d, qw)
+
+    def _qgmm(self, x2d, qw, tile_expert, name: str):
+        """Grouped (per-expert) quantized matmul dispatch — the MoE
+        analogue of ``_qmm``; the tile→expert map is replicated."""
+        from functools import partial
+
+        from jax import shard_map
+
+        from ..ops.pallas.quant_matmul import quant_grouped_matmul
+
+        gmm = partial(quant_grouped_matmul, block_m=self._MOE_GEMM_BLOCK_M)
+        mesh = self.topology.mesh
+        if mesh.size == 1:
+            return gmm(x2d, qw, tile_expert)
+        kind = self._qkind[name]
+        ws = KIND_SPEC_3D[kind]
+        xs = P(None, "tensor") if kind == "row" else P(None, None)
+        os_ = P(None, "tensor") if kind == "col" else P(None, None)
+        fn = (lambda xl, ql, te: jax.lax.psum(gmm(xl, ql, te), "tensor")) \
+            if kind == "row" else gmm
+        return shard_map(fn, mesh=mesh, in_specs=(xs, ws, P(None)),
+                         out_specs=os_, check_vma=False)(x2d, qw, tile_expert)
 
     # ------------------------------------------------------------------
     # ragged forward (reads the TransformerLM param tree directly;
@@ -252,17 +413,17 @@ class InferenceEngineV2:
 
         from ..ops.pallas.quant_matmul import QuantLinear, quant_matmul
 
-        def proj_in(h, w, nh):
+        def proj_in(h, w, nh, name):
             """[S,T,E] @ [E,(nh,D)] -> [S,T,nh,D]; QuantLinear weights run
-            the in-tile-dequant Pallas GEMM."""
+            the in-tile-dequant Pallas GEMM (per-shard under TP)."""
             if isinstance(w, QuantLinear):
-                y = quant_matmul(h.reshape(-1, h.shape[-1]), w)
+                y = self._qmm(h.reshape(-1, h.shape[-1]), w, name)
                 return y.reshape(S, T, nh, -1).astype(cfg.dtype)
             return jnp.einsum("ste,ehd->sthd", h, w.astype(cfg.dtype))
 
         def proj_out(o, w):
             if isinstance(w, QuantLinear):
-                y = quant_matmul(o.reshape(S * T, -1), w)
+                y = self._qmm(o.reshape(S * T, -1), w, "wo")
                 return y.reshape(S, T, -1).astype(cfg.dtype)
             return jnp.einsum("sthd,hde->ste", o, w.astype(cfg.dtype))
 
@@ -279,10 +440,47 @@ class InferenceEngineV2:
         page_index = (block_tables[:, :, None] * bs +
                       jnp.arange(bs)[None, None, :]).reshape(S, ctx)  # [S,ctx]
 
+        def quant_moe(ml, h):
+            """Routed experts over QuantGrouped slabs: dropless routing +
+            sorted grouped in-tile-dequant GEMMs (reference cutlass_ops/
+            moe_gemm with mixed_gemm quantization). Dropless == the
+            no-drop capacity route semantically — every token reaches all
+            k experts with the same normalized gates. The dispatch/combine
+            algebra is shared with the training dropless path
+            (moe/layer.py ``dropless_dispatch_combine``)."""
+            from ..moe.layer import dropless_dispatch_combine
+            from ..moe.sharded_moe import topk_dropless_gating
+
+            mo = m.moe
+            Tt, E = S * T, h.shape[-1]
+            flat = h.reshape(Tt, E).astype(cfg.dtype)
+            logits = jnp.einsum("te,en->tn", flat.astype(jnp.float32),
+                                ml["gate"]["wg"].astype(jnp.float32))
+            gate = topk_dropless_gating(logits[None], mo.top_k)
+            ex = ml["experts"]
+
+            def gemm(buf, srt):
+                te = srt.tile_expert
+                if m.activation == "silu_glu":
+                    z = jax.nn.silu(self._qgmm(buf, ex["w_gate"], te,
+                                               "moe_w_gate")) \
+                        * self._qgmm(buf, ex["w_up"], te, "moe_w_up")
+                else:
+                    z = jax.nn.gelu(self._qgmm(buf, ex["w_up"], te,
+                                               "moe_w_up"))
+                return self._qgmm(z.astype(cfg.dtype), ex["w_down"], te,
+                                  "moe_w_down")
+
+            out = dropless_dispatch_combine(
+                flat, gate.gates[0], gate.experts[0], mo.num_experts,
+                mo.top_k, self._MOE_GEMM_BLOCK_M, gemm)
+            return out.reshape(S, T, E).astype(cfg.dtype)
+
         def ffn(p, h, use_moe: bool):
             if use_moe:
                 from ..models.transformer import moe_layer_kwargs
                 from ..moe.layer import MoE
+                from ..ops.pallas.quant_matmul import QuantGrouped
 
                 # drop_tokens=False: generation must not drop routed tokens
                 # (the FastGen v2 MoE contract — reference inference/v2
@@ -292,8 +490,12 @@ class InferenceEngineV2:
                 # would bind — there v1 drops overflow tokens, v2 doesn't
                 # (enforced by tests/test_moe.py::
                 # test_capacity_divergence_v1_drops_v2_routes_all).
-                mod = MoE(**moe_layer_kwargs(m, drop_tokens=False))
-                out = mod.apply({"params": p["moe"]["moe_layer"]}, h, True)
+                ml = p["moe"]["moe_layer"]
+                if isinstance(ml["experts"].get("w_up"), QuantGrouped):
+                    out = quant_moe(ml, h)
+                else:
+                    mod = MoE(**moe_layer_kwargs(m, drop_tokens=False))
+                    out = mod.apply({"params": ml}, h, True)
                 se = m.moe.shared_expert_intermediate
                 if se:   # qwen2-moe sigmoid-gated shared expert
                     shared_cfg = dataclasses.replace(m, intermediate_size=se)
@@ -311,15 +513,16 @@ class InferenceEngineV2:
                 # sync when touching activations/biases
                 h2d = h.reshape(-1, h.shape[-1])
                 if m.activation == "silu_glu":
-                    z = jax.nn.silu(quant_matmul(h2d, f["w_gate"])) \
-                        * quant_matmul(h2d, f["w_up"])
-                    out = quant_matmul(z.astype(cfg.dtype), f["w_down"])
+                    z = jax.nn.silu(self._qmm(h2d, f["w_gate"], "w_gate")) \
+                        * self._qmm(h2d, f["w_up"], "w_up")
+                    out = self._qmm(z.astype(cfg.dtype), f["w_down"],
+                                    "w_down")
                 else:
-                    z = quant_matmul(h2d, f["w_up"]) \
+                    z = self._qmm(h2d, f["w_up"], "w_up") \
                         + f["b_up"].astype(cfg.dtype)
                     act = jax.nn.relu if m.activation == "relu" else jax.nn.gelu
-                    out = quant_matmul(act(z).astype(cfg.dtype),
-                                       f["w_down"]) \
+                    out = self._qmm(act(z).astype(cfg.dtype),
+                                    f["w_down"], "w_down") \
                         + f["b_down"].astype(cfg.dtype)
                 return out.reshape(h.shape).astype(cfg.dtype)
             return DenseFFN(m).apply({"params": f}, h)
@@ -327,9 +530,9 @@ class InferenceEngineV2:
         def attention(p, kv, h):
             """QKV → scatter into pool → paged attention. Returns (o, kv)."""
             a = p["attn"]
-            q = proj_in(h, a["wq"], H)
-            k = proj_in(h, a["wk"], KV)
-            v = proj_in(h, a["wv"], KV)
+            q = proj_in(h, a["wq"], H, "wq")
+            k = proj_in(h, a["wk"], KV, "wk")
+            v = proj_in(h, a["wv"], KV, "wv")
             if m.qkv_bias:
                 q = q + a["bq"].astype(cfg.dtype)
                 k = k + a["bk"].astype(cfg.dtype)
@@ -489,7 +692,7 @@ class InferenceEngineV2:
         if m.tie_embeddings:
             logits = jnp.einsum("se,ve->sv", last, params["embed"].astype(cfg.dtype))
         elif isinstance(params["unembed"], QuantLinear):
-            logits = quant_matmul(last, params["unembed"])
+            logits = self._qmm(last, params["unembed"], "unembed")
         else:
             logits = jnp.einsum("se,ev->sv", last, params["unembed"].astype(cfg.dtype))
         if m.unembed_bias:

@@ -56,6 +56,30 @@ class TopKGate(nn.Module):
             self.min_capacity, noise_rng=rng, drop_tokens=self.drop_tokens)
 
 
+def dropless_dispatch_combine(x2d: jax.Array, gates: jax.Array,
+                              experts: jax.Array, num_experts: int, k: int,
+                              block_m: int, gemm: Callable) -> jax.Array:
+    """Shared megablocks-style dispatch/combine (used by the dropless
+    training path below AND the v2 quantized-expert serving path —
+    inference/engine_v2.py ``quant_moe`` — so routing fixes reach both).
+
+    Sort the [T, k] expert choices into a block-aligned buffer, run
+    ``gemm(buf, sort) -> [Tp, F]`` (the only part that differs between
+    callers: bf16 grouped GEMM vs quantized grouped GEMM), gather each
+    token's k rows back and combine with its normalized gates.
+    """
+    from ..ops.pallas.grouped_matmul import sort_tokens_by_expert
+
+    T, E = x2d.shape
+    srt = sort_tokens_by_expert(experts.reshape(T, k), num_experts, block_m)
+    rows = jnp.repeat(x2d, k, axis=0)                      # [T*k, E]
+    buf = jnp.zeros((srt.Tp, E), x2d.dtype).at[srt.dst].set(rows)
+    out_buf = gemm(buf, srt)
+    rows_out = out_buf[srt.dst].reshape(T, k, -1)
+    return jnp.einsum("tk,tke->te",
+                      gates.reshape(T, k).astype(x2d.dtype), rows_out)
+
+
 class Experts(nn.Module):
     """Stacked expert FFNs (reference experts.py:13) as one grouped GEMM.
 
@@ -150,24 +174,16 @@ class MoE(nn.Module):
                  gate.z_loss * self.z_loss_weight)
 
         if self.dropless:
-            from ..ops.pallas.grouped_matmul import sort_tokens_by_expert
-
             bm = self.dropless_block_m
-            flat = x.reshape(B * S, E)                       # [T, E]
-            srt = sort_tokens_by_expert(
-                gate.experts.reshape(B * S, self.k), self.num_experts, bm)
-            rows = jnp.repeat(flat, self.k, axis=0)          # [T*k, E]
-            buf = jnp.zeros((srt.Tp, E), dtype).at[srt.dst].set(rows)
-            out_buf = Experts(
+            experts_mod = Experts(
                 hidden_size=self.hidden_size,
                 ffn_size=self.ffn_size or 4 * self.hidden_size,
                 num_experts=self.num_experts,
-                activation=self.activation, name="experts")(
-                    buf, sort=srt, block_m=bm)
-            rows_out = out_buf[srt.dst].reshape(B * S, self.k, E)
-            y = jnp.einsum("tk,tke->te",
-                           gate.gates.reshape(B * S, self.k).astype(dtype),
-                           rows_out)
+                activation=self.activation, name="experts")
+            y = dropless_dispatch_combine(
+                x.reshape(B * S, E), gate.gates, gate.experts,
+                self.num_experts, self.k, bm,
+                lambda buf, srt: experts_mod(buf, sort=srt, block_m=bm))
             return _constrain(y.reshape(B, S, E), BATCH, SEQ, EMBED)
 
         # dispatch: [B,S,E] tokens → [n, B, cap, E] expert inputs. Under

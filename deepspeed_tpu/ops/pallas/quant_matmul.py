@@ -54,6 +54,58 @@ jax.tree_util.register_pytree_node(
 )
 
 
+def _resolve_group(K: int, bits, group_size: int | None) -> int:
+    if group_size is None:
+        import math
+
+        group_size = 128 if bits == 4 else 512
+        if K % group_size:
+            group_size = math.gcd(K, group_size) or K
+    if K % group_size:
+        raise ValueError(f"K={K} not divisible by group_size={group_size}")
+    if bits == 4 and group_size % 2:
+        raise ValueError("int4 needs an even group_size (K-pairs pack)")
+    return group_size
+
+
+def _quantize_slabs(w3: jax.Array, bits, G: int):
+    """Shared quantization core over [n, K, Np] slabs (lane-padded):
+    symmetric per-(slab, K-group, column). Returns (codes, scale) —
+    int8 [n, K, Np] | uint8 [n, K/2, Np] (int4 K-pair pack) | fp8 codes;
+    scale fp32 [n, K/G, Np]. ``quantize_weight`` is the n=1 view."""
+    n, K, Np = w3.shape
+    w32 = w3.astype(jnp.float32).reshape(n, K // G, G, Np)
+    amax = jnp.max(jnp.abs(w32), axis=2, keepdims=True)
+    if bits == "fp8":
+        scale = jnp.where(amax > 0, amax / 448.0, 1.0)     # e4m3 max
+        q = (w32 / scale).reshape(n, K, Np).astype(jnp.float8_e4m3fn)
+        return q, scale[:, :, 0, :]
+    qmax = float(2 ** (bits - 1) - 1)
+    scale = jnp.where(amax > 0, amax / qmax, 1.0)          # [n, K/G, 1, Np]
+    q = jnp.clip(jnp.round(w32 / scale), -qmax - 1, qmax)
+    q = q.reshape(n, K, Np).astype(jnp.int8)
+    if bits == 4:
+        lo = (q[:, 0::2] + 8).astype(jnp.uint8)            # [n, K/2, Np]
+        hi = (q[:, 1::2] + 8).astype(jnp.uint8)
+        q = (lo | (hi << 4)).astype(jnp.uint8)
+    return q, scale[:, :, 0, :]
+
+
+def _dequantize_slabs(codes: jax.Array, scale: jax.Array, bits,
+                      K: int, G: int) -> jax.Array:
+    """Inverse of :func:`_quantize_slabs` → fp32 [n, K, Np]."""
+    n, Np = codes.shape[0], codes.shape[-1]
+    if bits in (8, "fp8"):
+        c = codes.astype(jnp.float32)
+    else:
+        u = codes.astype(jnp.int32)
+        lo = (u & 15) - 8
+        hi = (u >> 4) - 8
+        c = jnp.stack([lo, hi], axis=2).reshape(n, K, Np).astype(jnp.float32)
+    return (c.reshape(n, K // G, G, Np) * scale[:, :, None, :]
+            ).reshape(n, K, Np)
+
+
 def quantize_weight(w: jax.Array, bits: int | str = 8,
                     group_size: int | None = None) -> QuantLinear:
     """Symmetric per-(K-group, column) quantization of a [K, N] weight.
@@ -68,49 +120,17 @@ def quantize_weight(w: jax.Array, bits: int | str = 8,
     n_pad = (-N) % 128
     if n_pad:
         w = jnp.pad(w, ((0, 0), (0, n_pad)))
-    if group_size is None:
-        import math
-
-        group_size = 128 if bits == 4 else 512
-        if K % group_size:
-            group_size = math.gcd(K, group_size) or K
-    if K % group_size:
-        raise ValueError(f"K={K} not divisible by group_size={group_size}")
-    if bits == 4 and group_size % 2:
-        raise ValueError("int4 needs an even group_size (K-pairs pack)")
-    w32 = w.astype(jnp.float32).reshape(K // group_size, group_size,
-                                        N + n_pad)
-    amax = jnp.max(jnp.abs(w32), axis=1, keepdims=True)
-    if bits == "fp8":
-        scale = jnp.where(amax > 0, amax / 448.0, 1.0)     # e4m3 max
-        q = (w32 / scale).reshape(K, N + n_pad).astype(jnp.float8_e4m3fn)
-        return QuantLinear(q, scale[:, 0, :], bits, group_size, (K, N),
-                           w.dtype)
-    qmax = float(2 ** (bits - 1) - 1)
-    scale = jnp.where(amax > 0, amax / qmax, 1.0)          # [K/G, 1, N]
-    q = jnp.clip(jnp.round(w32 / scale), -qmax - 1, qmax)
-    q = q.reshape(K, N + n_pad).astype(jnp.int8)
-    if bits == 4:
-        lo = (q[0::2] + 8).astype(jnp.uint8)               # [K/2, N]
-        hi = (q[1::2] + 8).astype(jnp.uint8)
-        q = (lo | (hi << 4)).astype(jnp.uint8)
-    return QuantLinear(q, scale[:, 0, :], bits, group_size, (K, N), w.dtype)
+    group_size = _resolve_group(K, bits, group_size)
+    q, scale = _quantize_slabs(w[None], bits, group_size)
+    return QuantLinear(q[0], scale[0], bits, group_size, (K, N), w.dtype)
 
 
 def dequantize_weight(qw: QuantLinear) -> jax.Array:
     """Reference inverse (the XLA path the kernel is benchmarked against)."""
     K, N = qw.shape
-    Np = qw.data.shape[1]            # lane-padded
-    G = qw.group_size
-    if qw.bits in (8, "fp8"):
-        codes = qw.data.astype(jnp.float32)
-    else:
-        u = qw.data.astype(jnp.int32)
-        lo = (u & 15) - 8
-        hi = (u >> 4) - 8
-        codes = jnp.stack([lo, hi], axis=1).reshape(K, Np).astype(jnp.float32)
-    w = codes.reshape(K // G, G, Np) * qw.scale[:, None, :]
-    return w.reshape(K, Np)[:, :N].astype(qw.dtype)
+    w = _dequantize_slabs(qw.data[None], qw.scale[None], qw.bits, K,
+                          qw.group_size)[0]
+    return w[:, :N].astype(qw.dtype)
 
 
 def _qmm8_kernel(x_ref, d_ref, s_ref, o_ref, acc, *, G: int, dtype):
@@ -236,3 +256,179 @@ def quant_matmul(x: jax.Array, qw: QuantLinear, *,
             interpret=interpret,
         )(xe, xo, qw.data, scale3)
     return out[:M, :N_logical]
+
+
+# ---------------------------------------------------------------------------
+# Grouped (per-expert) quantized GEMM — the reference's quantized MoE GEMM
+# (/root/reference/deepspeed/inference/v2/kernels/cutlass_ops/moe_gemm/ with
+# mixed_gemm's weight-only quantization applied to the expert weights).
+# Same schedule as ops/pallas/grouped_matmul.py (expert-sorted token tiles,
+# tile→expert scalar prefetch) with the in-tile dequant of the kernels
+# above. Serving-only: no VJP.
+# ---------------------------------------------------------------------------
+
+class QuantGrouped(NamedTuple):
+    """Weight-only-quantized stacked expert weights [n, K, N] (pytree)."""
+    data: jax.Array          # int8 [n, K, N] | uint8 [n, K/2, N] (int4)
+    scale: jax.Array         # fp32 [n, K/group, N]
+    bits: int
+    group_size: int
+    shape: tuple[int, int, int]   # (n, K, N) logical
+    dtype: Any
+
+    @property
+    def nbytes(self) -> int:
+        return self.data.nbytes + self.scale.nbytes
+
+
+jax.tree_util.register_pytree_node(
+    QuantGrouped,
+    lambda q: ((q.data, q.scale), (q.bits, q.group_size, q.shape, q.dtype)),
+    lambda aux, ch: QuantGrouped(*ch, *aux),
+)
+
+
+def quantize_grouped(w: jax.Array, bits: int | str = 8,
+                     group_size: int | None = None) -> QuantGrouped:
+    """Symmetric per-(expert, K-group, column) quantization of stacked
+    expert weights [n, K, N] — :func:`quantize_weight`'s grid applied per
+    expert (same ``_quantize_slabs`` core)."""
+    assert bits in (4, 8, "fp8"), bits
+    n, K, N = w.shape
+    n_pad = (-N) % 128
+    if n_pad:
+        w = jnp.pad(w, ((0, 0), (0, 0), (0, n_pad)))
+    group_size = _resolve_group(K, bits, group_size)
+    q, scale = _quantize_slabs(w, bits, group_size)
+    return QuantGrouped(q, scale, bits, group_size, (n, K, N), w.dtype)
+
+
+def dequantize_grouped(qw: QuantGrouped) -> jax.Array:
+    """XLA reference inverse (tests + no-Pallas fallback)."""
+    n, K, N = qw.shape
+    w = _dequantize_slabs(qw.data, qw.scale, qw.bits, K, qw.group_size)
+    return w[:, :, :N].astype(qw.dtype)
+
+
+def _qgmm8_kernel(te_ref, x_ref, d_ref, s_ref, o_ref, acc, *, G: int, dtype):
+    k, nk = pl.program_id(2), pl.num_programs(2)
+
+    @pl.when(k == 0)
+    def _init():
+        acc[:] = jnp.zeros_like(acc)
+
+    bk = x_ref.shape[1]
+    for g in range(bk // G):
+        w = (d_ref[0, g * G:(g + 1) * G, :].astype(jnp.float32)
+             * s_ref[0, 0, g:g + 1, :]).astype(dtype)      # [G, bn]
+        acc[:] += jax.lax.dot_general(
+            x_ref[:, g * G:(g + 1) * G].astype(dtype), w,
+            (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+
+    @pl.when(k == nk - 1)
+    def _fin():
+        o_ref[:] = acc[:].astype(o_ref.dtype)
+
+
+def _qgmm4_kernel(te_ref, xe_ref, xo_ref, d_ref, s_ref, o_ref, acc, *,
+                  G: int, dtype):
+    k, nk = pl.program_id(2), pl.num_programs(2)
+
+    @pl.when(k == 0)
+    def _init():
+        acc[:] = jnp.zeros_like(acc)
+
+    h = G // 2
+    for g in range(xe_ref.shape[1] // h):
+        u = d_ref[0, g * h:(g + 1) * h, :].astype(jnp.int32)
+        s = s_ref[0, 0, g:g + 1, :]
+        lo = (((u & 15) - 8).astype(jnp.float32) * s).astype(dtype)
+        hi = (((u >> 4) - 8).astype(jnp.float32) * s).astype(dtype)
+        acc[:] += jax.lax.dot_general(
+            xe_ref[:, g * h:(g + 1) * h].astype(dtype), lo,
+            (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        acc[:] += jax.lax.dot_general(
+            xo_ref[:, g * h:(g + 1) * h].astype(dtype), hi,
+            (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+
+    @pl.when(k == nk - 1)
+    def _fin():
+        o_ref[:] = acc[:].astype(o_ref.dtype)
+
+
+def quant_grouped_matmul(x: jax.Array, qw: QuantGrouped,
+                         tile_expert: jax.Array, *, block_m: int = 128,
+                         block_n: int = 512, block_k: int = 512,
+                         interpret: bool | None = None) -> jax.Array:
+    """x [Tp, K] expert-sorted+aligned tokens (Tp % block_m == 0, every
+    block_m tile owned by ONE expert, see ``sort_tokens_by_expert``)
+    @ dequant(qw[e]) -> [Tp, N]. The tile→expert map rides as a scalar
+    prefetch; each weight tile DMAs from its owner's slab and dequantizes
+    in VMEM right before the MXU dot."""
+    Tp, K = x.shape
+    n_exp, Kw, N_logical = qw.shape
+    N = qw.data.shape[2]             # lane-padded
+    if K != Kw:
+        raise ValueError(f"contract mismatch: x {x.shape} w {qw.shape}")
+    if Tp % block_m:
+        raise ValueError(f"tokens {Tp} not a multiple of block_m {block_m}")
+    if pltpu is None:
+        full = dequantize_grouped(qw).astype(x.dtype)      # [n, K, N]
+        te = jnp.repeat(tile_expert, block_m)
+        return jnp.einsum("tk,tkn->tn", x, full[te])[:, :N_logical]
+    if interpret is None:
+        interpret = jax.default_backend() != "tpu"
+    G = qw.group_size
+    bk = _pick(K, max(block_k, G))
+    if bk % G:
+        raise ValueError(f"block_k {bk} must be a multiple of group_size {G}")
+    bn = _pick(N, block_n)
+    grid = (Tp // block_m, N // bn, K // bk)
+    mm_dtype = jnp.float32 if interpret else x.dtype
+    scale4 = qw.scale.reshape(n_exp, K // bk, bk // G, N)
+    s_spec = pl.BlockSpec((1, 1, bk // G, bn),
+                          lambda t, f, k, te: (te[t], k, 0, f))
+
+    if qw.bits in (8, "fp8"):
+        grid_spec = pltpu.PrefetchScalarGridSpec(
+            num_scalar_prefetch=1,
+            grid=grid,
+            in_specs=[
+                pl.BlockSpec((block_m, bk), lambda t, f, k, te: (t, k)),
+                pl.BlockSpec((1, bk, bn), lambda t, f, k, te: (te[t], k, f)),
+                s_spec,
+            ],
+            out_specs=pl.BlockSpec((block_m, bn), lambda t, f, k, te: (t, f)),
+            scratch_shapes=[pltpu.VMEM((block_m, bn), jnp.float32)],
+        )
+        out = pl.pallas_call(
+            functools.partial(_qgmm8_kernel, G=G, dtype=mm_dtype),
+            grid_spec=grid_spec,
+            out_shape=jax.ShapeDtypeStruct((Tp, N), x.dtype),
+            interpret=interpret,
+        )(tile_expert.astype(jnp.int32), x, qw.data, scale4)
+    else:
+        xe, xo = x[:, 0::2], x[:, 1::2]
+        grid_spec = pltpu.PrefetchScalarGridSpec(
+            num_scalar_prefetch=1,
+            grid=grid,
+            in_specs=[
+                pl.BlockSpec((block_m, bk // 2), lambda t, f, k, te: (t, k)),
+                pl.BlockSpec((block_m, bk // 2), lambda t, f, k, te: (t, k)),
+                pl.BlockSpec((1, bk // 2, bn),
+                             lambda t, f, k, te: (te[t], k, f)),
+                s_spec,
+            ],
+            out_specs=pl.BlockSpec((block_m, bn), lambda t, f, k, te: (t, f)),
+            scratch_shapes=[pltpu.VMEM((block_m, bn), jnp.float32)],
+        )
+        out = pl.pallas_call(
+            functools.partial(_qgmm4_kernel, G=G, dtype=mm_dtype),
+            grid_spec=grid_spec,
+            out_shape=jax.ShapeDtypeStruct((Tp, N), x.dtype),
+            interpret=interpret,
+        )(tile_expert.astype(jnp.int32), xe, xo, qw.data, scale4)
+    return out[:, :N_logical]
