@@ -456,7 +456,8 @@ class InferenceEngineV2:
             flat = h.reshape(Tt, E).astype(cfg.dtype)
             logits = jnp.einsum("te,en->tn", flat.astype(jnp.float32),
                                 ml["gate"]["wg"].astype(jnp.float32))
-            gate = topk_dropless_gating(logits[None], mo.top_k)
+            gate = topk_dropless_gating(logits[None], mo.top_k,
+                                        normalize_gates=mo.normalize_gates)
             ex = ml["experts"]
 
             def gemm(buf, srt):
@@ -518,9 +519,11 @@ class InferenceEngineV2:
                     out = self._qmm(z.astype(cfg.dtype), f["w_down"],
                                     "w_down")
                 else:
+                    from ..models.transformer import _ACTS
+
                     z = self._qmm(h2d, f["w_up"], "w_up") \
                         + f["b_up"].astype(cfg.dtype)
-                    act = jax.nn.relu if m.activation == "relu" else jax.nn.gelu
+                    act = _ACTS[m.activation]
                     out = self._qmm(act(z).astype(cfg.dtype),
                                     f["w_down"], "w_down") \
                         + f["b_down"].astype(cfg.dtype)

@@ -283,10 +283,131 @@ def _phi_tree(sd: dict, cfg: ModelConfig) -> dict:
     return t
 
 
+def _phi3_tree(sd: dict, cfg: ModelConfig) -> dict:
+    """phi-3 layout (reference inference/v2 model_implementations/phi3):
+    llama skeleton with FUSED qkv_proj ([(H+2KV)D, E] — q, then k, then v)
+    and FUSED gate_up_proj ([2F, E] — gate half then up half)."""
+    E, H, KV, D = (cfg.hidden_size, cfg.num_heads, cfg.kv_heads,
+                   cfg.head_dim)
+    F = cfg.ffn_size
+    perm = _interleave_perm(D)
+    t = {"embed": sd["model.embed_tokens.weight"],
+         "ln_final": {"scale": sd["model.norm.weight"]}}
+    if not cfg.tie_embeddings:
+        t["unembed"] = sd["lm_head.weight"].T
+    for i in range(cfg.num_layers):
+        p = f"model.layers.{i}."
+        w = sd[p + "self_attn.qkv_proj.weight"].T         # [E, (H+2KV)D]
+        gu = sd[p + "mlp.gate_up_proj.weight"].T          # [E, 2F]
+        t[f"layer_{i}"] = {
+            "ln_attn": {"scale": sd[p + "input_layernorm.weight"]},
+            "attn": {
+                "wq": w[:, :H * D].reshape(E, H, D)[:, :, perm],
+                "wk": w[:, H * D:(H + KV) * D].reshape(E, KV, D)[:, :, perm],
+                "wv": w[:, (H + KV) * D:].reshape(E, KV, D),
+                "wo": sd[p + "self_attn.o_proj.weight"].T.reshape(H, D, E),
+            },
+            "ln_ffn": {"scale": sd[p + "post_attention_layernorm.weight"]},
+            "ffn": {"w_gate": gu[:, :F], "w_up": gu[:, F:],
+                    "w_down": sd[p + "mlp.down_proj.weight"].T},
+        }
+    return t
+
+
+def _qwen_tree(sd: dict, cfg: ModelConfig) -> dict:
+    """qwen v1 layout (reference inference/v2 model_implementations/qwen):
+    gpt2-style module names over llama-style math — RMSNorm ln_1/ln_2,
+    FUSED c_attn ([3E, E] torch Linear: q, k, v stacked) WITH bias,
+    bias-free c_proj, and a SwiGLU MLP where HF's ``w2`` is the gate
+    (silu) branch and ``w1`` the up branch (modeling_qwen.py:
+    ``c_proj(a1 * silu(a2))`` with a1=w1(x), a2=w2(x))."""
+    E, H, D = cfg.hidden_size, cfg.num_heads, cfg.head_dim
+    perm = _interleave_perm(D)
+    t = {"embed": sd["transformer.wte.weight"],
+         "ln_final": {"scale": sd["transformer.ln_f.weight"]}}
+    if not cfg.tie_embeddings:
+        t["unembed"] = sd["lm_head.weight"].T
+    for i in range(cfg.num_layers):
+        p = f"transformer.h.{i}."
+        w = sd[p + "attn.c_attn.weight"].T                # [E, 3E]
+        b = sd[p + "attn.c_attn.bias"]                    # [3E]
+        wq, wk, wv = np.split(w, 3, axis=1)
+        bq, bk, bv = np.split(b, 3)
+        t[f"layer_{i}"] = {
+            "ln_attn": {"scale": sd[p + "ln_1.weight"]},
+            "attn": {
+                "wq": wq.reshape(E, H, D)[:, :, perm],
+                "bq": bq.reshape(H, D)[:, perm],
+                "wk": wk.reshape(E, H, D)[:, :, perm],
+                "bk": bk.reshape(H, D)[:, perm],
+                "wv": wv.reshape(E, H, D),
+                "bv": bv.reshape(H, D),
+                "wo": sd[p + "attn.c_proj.weight"].T.reshape(H, D, E),
+            },
+            "ln_ffn": {"scale": sd[p + "ln_2.weight"]},
+            "ffn": {"w_gate": sd[p + "mlp.w2.weight"].T,
+                    "w_up": sd[p + "mlp.w1.weight"].T,
+                    "w_down": sd[p + "mlp.c_proj.weight"].T},
+        }
+    return t
+
+
+def _qwen2_moe_tree(sd: dict, cfg: ModelConfig) -> dict:
+    """qwen2-moe layout (reference inference/v2 qwen_v2_moe): qwen2
+    attention (qkv bias) + per-layer MoE with HF-named experts
+    (gate_proj/up_proj/down_proj), a router ``mlp.gate``, and the
+    sigmoid-gated shared expert (``mlp.shared_expert[_gate]``)."""
+    t = _llama_tree_attn_only(sd, cfg)
+    H, KV, D = cfg.num_heads, cfg.kv_heads, cfg.head_dim
+    perm = _interleave_perm(D)
+    n_exp = cfg.moe.num_experts
+    for i in range(cfg.num_layers):
+        p = f"model.layers.{i}."
+        a = t[f"layer_{i}"]["attn"]
+        a["bq"] = sd[p + "self_attn.q_proj.bias"].reshape(H, D)[:, perm]
+        a["bk"] = sd[p + "self_attn.k_proj.bias"].reshape(KV, D)[:, perm]
+        a["bv"] = sd[p + "self_attn.v_proj.bias"].reshape(KV, D)
+        mp = p + "mlp."
+        t[f"layer_{i}"]["moe"] = {
+            "moe_layer": {
+                "gate": {"wg": sd[mp + "gate.weight"].T},   # [E, n_exp]
+                "experts": {
+                    "w_gate": np.stack(
+                        [sd[mp + f"experts.{k}.gate_proj.weight"].T
+                         for k in range(n_exp)]),
+                    "w_up": np.stack(
+                        [sd[mp + f"experts.{k}.up_proj.weight"].T
+                         for k in range(n_exp)]),
+                    "w_down": np.stack(
+                        [sd[mp + f"experts.{k}.down_proj.weight"].T
+                         for k in range(n_exp)]),
+                }},
+            "shared_expert": {
+                "w_gate": sd[mp + "shared_expert.gate_proj.weight"].T,
+                "w_up": sd[mp + "shared_expert.up_proj.weight"].T,
+                "w_down": sd[mp + "shared_expert.down_proj.weight"].T,
+            },
+            "shared_gate": sd[mp + "shared_expert_gate.weight"].T,  # [E, 1]
+        }
+    return t
+
+
 _CONVERTERS = {"gpt2": _gpt2_tree, "llama": _llama_tree,
                "mistral": _llama_tree, "qwen2": _qwen2_tree,
                "mixtral": _mixtral_tree, "falcon": _falcon_tree,
-               "bloom": _bloom_tree, "opt": _opt_tree, "phi": _phi_tree}
+               "bloom": _bloom_tree, "opt": _opt_tree, "phi": _phi_tree,
+               "phi3": _phi3_tree, "qwen": _qwen_tree,
+               "qwen2_moe": _qwen2_moe_tree}
+
+
+def _reject_rope_scaling(hf_config) -> None:
+    """Scaled-RoPE checkpoints (llama3/yarn/longrope factors) would import
+    with plain RoPE and silently wrong position math — raise instead."""
+    rs = getattr(hf_config, "rope_scaling", None)
+    if rs:
+        raise NotImplementedError(
+            f"rope_scaling={rs} is not converted (plain-RoPE checkpoints "
+            f"are); scaled-rope position math would silently diverge")
 
 
 def config_from_hf(hf_config) -> ModelConfig:
@@ -294,6 +415,9 @@ def config_from_hf(hf_config) -> ModelConfig:
     import dataclasses
 
     mt = hf_config.model_type
+    if mt in ("llama", "mistral", "qwen2", "mixtral", "phi3", "qwen2_moe",
+              "phi"):
+        _reject_rope_scaling(hf_config)
     if mt == "gpt2":
         return dataclasses.replace(
             PRESETS["gpt2-125m"],
@@ -387,6 +511,7 @@ def config_from_hf(hf_config) -> ModelConfig:
                                       "ones are)")
         return dataclasses.replace(
             PRESETS["falcon-7b"],
+            activation="gelu_exact",     # FalconMLP uses nn.GELU (erf)
             vocab_size=hf_config.vocab_size,
             hidden_size=hf_config.hidden_size,
             num_layers=hf_config.num_hidden_layers,
@@ -439,24 +564,364 @@ def config_from_hf(hf_config) -> ModelConfig:
             norm_eps=hf_config.layer_norm_eps,
             tie_embeddings=bool(getattr(hf_config, "tie_word_embeddings",
                                         False)))
+    if mt == "phi3":
+        sw = getattr(hf_config, "sliding_window", None)
+        if sw is not None and sw >= hf_config.max_position_embeddings:
+            sw = None
+        return dataclasses.replace(
+            PRESETS["phi-3-mini"],
+            vocab_size=hf_config.vocab_size,
+            hidden_size=hf_config.hidden_size,
+            num_layers=hf_config.num_hidden_layers,
+            num_heads=hf_config.num_attention_heads,
+            num_kv_heads=hf_config.num_key_value_heads,
+            intermediate_size=hf_config.intermediate_size,
+            max_seq_len=hf_config.max_position_embeddings,
+            rope_theta=float(getattr(hf_config, "rope_theta", 10000.0)),
+            norm_eps=hf_config.rms_norm_eps, sliding_window=sw,
+            tie_embeddings=bool(getattr(hf_config, "tie_word_embeddings",
+                                        False)))
+    if mt == "qwen":
+        # qwen v1 (remote-code arch): intermediate_size counts BOTH swiglu
+        # branches — each of w1/w2 is half (modeling_qwen.py QWenMLP)
+        return dataclasses.replace(
+            PRESETS["qwen-7b"],
+            vocab_size=hf_config.vocab_size,
+            hidden_size=hf_config.hidden_size,
+            num_layers=hf_config.num_hidden_layers,
+            num_heads=hf_config.num_attention_heads,
+            intermediate_size=hf_config.intermediate_size // 2,
+            max_seq_len=getattr(hf_config, "seq_length", 8192),
+            rope_theta=float(getattr(hf_config, "rotary_emb_base", 10000.0)),
+            norm_eps=hf_config.layer_norm_epsilon,
+            tie_embeddings=bool(getattr(hf_config, "tie_word_embeddings",
+                                        False)))
+    if mt == "qwen2_moe":
+        from .transformer import MoEConfig
+
+        if getattr(hf_config, "mlp_only_layers", None):
+            raise NotImplementedError(
+                "qwen2-moe mlp_only_layers (mixed dense/MoE stacks) is not "
+                "converted — homogeneous-MoE checkpoints are")
+        if getattr(hf_config, "decoder_sparse_step", 1) != 1:
+            raise NotImplementedError(
+                "qwen2-moe decoder_sparse_step > 1 is not converted")
+        sw = hf_config.sliding_window if getattr(
+            hf_config, "use_sliding_window", False) else None
+        if sw is not None and sw >= hf_config.max_position_embeddings:
+            sw = None
+        return dataclasses.replace(
+            PRESETS["qwen2-moe-a2.7b"],
+            vocab_size=hf_config.vocab_size,
+            hidden_size=hf_config.hidden_size,
+            num_layers=hf_config.num_hidden_layers,
+            num_heads=hf_config.num_attention_heads,
+            num_kv_heads=hf_config.num_key_value_heads,
+            # intermediate_size is the EXPERT ffn width here; the shared
+            # expert carries its own
+            intermediate_size=hf_config.moe_intermediate_size,
+            max_seq_len=hf_config.max_position_embeddings,
+            rope_theta=float(getattr(hf_config, "rope_theta", 10000.0)),
+            norm_eps=hf_config.rms_norm_eps, sliding_window=sw,
+            tie_embeddings=bool(getattr(hf_config, "tie_word_embeddings",
+                                        False)),
+            moe=MoEConfig(
+                num_experts=hf_config.num_experts,
+                top_k=hf_config.num_experts_per_tok,
+                # HF routes every token (no capacity); eval capacity n/k
+                # guarantees the same
+                eval_capacity_factor=float(hf_config.num_experts)
+                / hf_config.num_experts_per_tok,
+                shared_expert_intermediate=
+                hf_config.shared_expert_intermediate_size,
+                normalize_gates=bool(getattr(hf_config, "norm_topk_prob",
+                                             False)),
+                aux_loss_weight=float(getattr(
+                    hf_config, "router_aux_loss_coef", 0.001))))
     raise NotImplementedError(
         f"no converter for HF model_type '{mt}' (have: "
         f"{sorted(_CONVERTERS)})")
 
 
+# ---------------------------------------------------------------------------
+# Generic fallback — the AutoTP role (reference module_inject/auto_tp.py:189
+# shards ANY HF module tree by walking it; here the equivalent promise is
+# "any llama/neox-shaped causal LM converts by name+shape heuristics").
+# Fails loudly listing every tensor it could not place.
+# ---------------------------------------------------------------------------
+
+#: per-layer suffix → role. First match wins; names follow the common HF
+#: conventions across gpt-neox / stablelm / internlm / persimmon-style
+#: decoders. Fused ``query_key_value`` is per-head-interleaved ([H, 3, D]
+#: rows — the neox/bloom convention); ``qkv_proj`` is sequential q|k|v.
+_G_ATTN_Q = ("self_attn.q_proj", "attention.q_proj", "attn.q_proj")
+_G_ATTN_K = ("self_attn.k_proj", "attention.k_proj", "attn.k_proj")
+_G_ATTN_V = ("self_attn.v_proj", "attention.v_proj", "attn.v_proj")
+_G_ATTN_FUSED_HEADWISE = ("attention.query_key_value",
+                          "self_attention.query_key_value")
+_G_ATTN_FUSED_SEQ = ("self_attn.qkv_proj", "attn.qkv_proj")
+_G_ATTN_O = ("self_attn.o_proj", "attention.dense", "self_attn.dense",
+             "self_attn.out_proj", "attention.o_proj")
+_G_MLP_GATE = ("mlp.gate_proj",)
+_G_MLP_UP = ("mlp.up_proj", "mlp.dense_h_to_4h", "mlp.fc1", "mlp.fc_in")
+_G_MLP_DOWN = ("mlp.down_proj", "mlp.dense_4h_to_h", "mlp.fc2",
+               "mlp.fc_out")
+_G_LN_ATTN = ("input_layernorm", "ln_1", "attention_norm")
+_G_LN_FFN = ("post_attention_layernorm", "ln_2", "ffn_norm")
+#: buffers that carry no weights (causal masks, rope caches)
+_G_IGNORE = ("rotary_emb.inv_freq", "masked_bias", ".attn.bias",
+             ".attention.bias", "rotary_pos_emb", "position_ids")
+
+
+def generic_config_and_tree(hf_config, sd: dict):
+    """Heuristic conversion for causal-LM archs WITHOUT a hand-written
+    tree. Locates embedding / layers / norms / projections by module name
+    and shape, derives the ModelConfig from the HF config plus what the
+    state dict proves (norm family from bias presence, biases from key
+    presence, parallel residual from config), and raises listing the
+    unmatched tensors for genuinely alien layouts."""
+    import dataclasses
+    import re
+
+    def attr(*names, default=None):
+        for n in names:
+            v = getattr(hf_config, n, None)
+            if v is not None:
+                return v
+        return default
+
+    used: set[str] = set()
+
+    def take(key):
+        used.add(key)
+        return sd[key]
+
+    def find_top(*suffixes):
+        for k in sd:
+            depth = k.count(".")
+            for s in suffixes:
+                if k.endswith(s) and depth <= 2 and ".layers." not in k \
+                        and ".h." not in k:
+                    return k
+        return None
+
+    embed_key = find_top("embed_in.weight", "embed_tokens.weight",
+                         "wte.weight", "word_embeddings.weight")
+    if embed_key is None:
+        raise NotImplementedError(
+            f"generic HF import: no token embedding found (model_type "
+            f"'{hf_config.model_type}'); top-level keys: "
+            f"{sorted(k for k in sd if k.count('.') <= 2)[:20]}")
+    lnf_key = find_top("final_layer_norm.weight", "ln_f.weight",
+                       "norm.weight", "final_layernorm.weight")
+    head_key = find_top("embed_out.weight", "lm_head.weight")
+    pos_key = find_top("wpe.weight", "embed_positions.weight")
+
+    ids = sorted({int(m.group(1)) for k in sd
+                  if (m := re.search(r"\.(?:h|layers)\.(\d+)\.", k))})
+    if not ids or lnf_key is None:
+        raise NotImplementedError(
+            f"generic HF import: could not locate decoder layers / final "
+            f"norm for model_type '{hf_config.model_type}'")
+    sample = next(k for k in sd if re.search(r"\.(?:h|layers)\.0\.", k))
+    layer_prefix = sample[:re.search(r"\.(?:h|layers)\.0\.", sample).end()]
+    layer_tmpl = layer_prefix.replace(".0.", ".{i}.")
+
+    V, E = sd[embed_key].shape
+    L = len(ids)
+    H = attr("num_attention_heads", "n_head")
+    KV = attr("num_key_value_heads", default=H)
+    D = E // H
+
+    def layer_keys(i):
+        p = layer_tmpl.format(i=i)
+        return {k[len(p):]: k for k in sd if k.startswith(p)}
+
+    lk0 = layer_keys(0)
+
+    def match(suffixes, kind="weight"):
+        for s in suffixes:
+            if f"{s}.{kind}" in lk0:
+                return s
+        return None
+
+    q_name = match(_G_ATTN_Q)
+    fused_hw = match(_G_ATTN_FUSED_HEADWISE)
+    fused_seq = match(_G_ATTN_FUSED_SEQ)
+    o_name = match(_G_ATTN_O)
+    gate_name = match(_G_MLP_GATE)
+    up_name = match(_G_MLP_UP)
+    down_name = match(_G_MLP_DOWN)
+    ln_attn_name = match(_G_LN_ATTN)
+    ln_ffn_name = match(_G_LN_FFN)
+    if o_name is None or up_name is None or down_name is None \
+            or ln_attn_name is None \
+            or (q_name is None and fused_hw is None and fused_seq is None):
+        raise NotImplementedError(
+            f"generic HF import: could not identify the attention/FFN "
+            f"projections for model_type '{hf_config.model_type}'; "
+            f"layer-0 keys: {sorted(lk0)}")
+
+    # ---- config, from HF attrs + what the tensors prove ---------------
+    _reject_rope_scaling(hf_config)
+    act = str(attr("hidden_act", "activation_function", "hidden_activation",
+                   default="gelu")).lower()
+    if "silu" in act or "swish" in act:
+        activation = "silu_glu"
+    elif "relu" in act:
+        activation = "relu"
+    elif act in ("gelu_new", "gelu_fast", "gelu_pytorch_tanh"):
+        activation = "gelu"              # tanh approximation family
+    else:
+        activation = "gelu_exact"        # torch nn.GELU default = erf
+    if activation == "silu_glu" and gate_name is None:
+        raise NotImplementedError(
+            "generic HF import: silu activation without a gate_proj "
+            "(non-GLU silu MLPs are not modeled)")
+    norm = "layernorm" if f"{ln_attn_name}.bias" in lk0 else "rmsnorm"
+    parallel = bool(attr("use_parallel_residual", "parallel_attn",
+                         default=False))
+    rot_pct = float(attr("rotary_pct", "partial_rotary_factor", default=1.0))
+    qkv_bias = (f"{q_name}.bias" in lk0 if q_name
+                else f"{fused_hw or fused_seq}.bias" in lk0)
+    cfg = ModelConfig(
+        vocab_size=V, hidden_size=E, num_layers=L, num_heads=H,
+        num_kv_heads=KV,
+        intermediate_size=sd[lk0[f"{down_name}.weight"]].shape[1],
+        max_seq_len=int(attr("max_position_embeddings", "n_positions",
+                             "seq_length", default=2048)),
+        position_embedding="learned" if pos_key else "rope",
+        rotary_pct=rot_pct,
+        rope_theta=float(attr("rope_theta", "rotary_emb_base",
+                              default=10000.0)),
+        norm=norm,
+        norm_eps=float(attr("rms_norm_eps", "layer_norm_eps",
+                            "layer_norm_epsilon", default=1e-5)),
+        activation=activation,
+        qkv_bias=qkv_bias,
+        attn_out_bias=f"{o_name}.bias" in lk0,
+        parallel_block=parallel,
+        parallel_block_norms=2 if parallel and ln_ffn_name else 1,
+        unembed_bias=bool(head_key
+                          and head_key.replace(".weight", ".bias") in sd),
+        tie_embeddings=head_key is None,
+    )
+    F = cfg.ffn_size
+    d_rot = (int(D * rot_pct) // 2) * 2
+    perm = np.concatenate([_interleave_perm(d_rot), np.arange(d_rot, D)]) \
+        if cfg.position_embedding == "rope" else np.arange(D)
+
+    # ---- tree ----------------------------------------------------------
+    def norm_tree(base_key):
+        out = {"scale": take(base_key)}
+        b = base_key.replace(".weight", ".bias")
+        if norm == "layernorm":
+            out["bias"] = take(b) if b in sd else np.zeros(
+                sd[base_key].shape, np.float32)
+        elif b in sd:
+            raise NotImplementedError(
+                f"generic HF import: rmsnorm with a bias at {b}")
+        return out
+
+    t = {"embed": take(embed_key), "ln_final": norm_tree(lnf_key)}
+    if pos_key:
+        t["pos_embed"] = take(pos_key)
+    if head_key:
+        t["unembed"] = take(head_key).T
+        hb = head_key.replace(".weight", ".bias")
+        if hb in sd:
+            t["unembed_b"] = take(hb)
+
+    for i in range(L):
+        lk = layer_keys(i)
+
+        def w(name):  # torch Linear [out, in] → [in, out]
+            return take(lk[f"{name}.weight"]).T
+
+        def b(name):
+            return take(lk[f"{name}.bias"])
+
+        attn = {}
+        if q_name:
+            attn["wq"] = w(q_name).reshape(E, H, D)[:, :, perm]
+            attn["wk"] = w(match(_G_ATTN_K)).reshape(E, KV, D)[:, :, perm]
+            attn["wv"] = w(match(_G_ATTN_V)).reshape(E, KV, D)
+            if qkv_bias:
+                attn["bq"] = b(q_name).reshape(H, D)[:, perm]
+                attn["bk"] = b(match(_G_ATTN_K)).reshape(KV, D)[:, perm]
+                attn["bv"] = b(match(_G_ATTN_V)).reshape(KV, D)
+        elif fused_hw:
+            # neox/bloom convention: rows are [H, 3, D]
+            wf = take(lk[f"{fused_hw}.weight"]).reshape(H, 3, D, E)
+            attn["wq"] = wf[:, 0].transpose(2, 0, 1)[:, :, perm]
+            attn["wk"] = wf[:, 1].transpose(2, 0, 1)[:, :, perm]
+            attn["wv"] = wf[:, 2].transpose(2, 0, 1)
+            if qkv_bias:
+                bf = take(lk[f"{fused_hw}.bias"]).reshape(H, 3, D)
+                attn["bq"] = bf[:, 0][:, perm]
+                attn["bk"] = bf[:, 1][:, perm]
+                attn["bv"] = bf[:, 2]
+        else:
+            wf = take(lk[f"{fused_seq}.weight"]).T      # [E, (H+2KV)D]
+            attn["wq"] = wf[:, :H * D].reshape(E, H, D)[:, :, perm]
+            attn["wk"] = wf[:, H * D:(H + KV) * D] \
+                .reshape(E, KV, D)[:, :, perm]
+            attn["wv"] = wf[:, (H + KV) * D:].reshape(E, KV, D)
+            if qkv_bias:
+                bf = take(lk[f"{fused_seq}.bias"])
+                attn["bq"] = bf[:H * D].reshape(H, D)[:, perm]
+                attn["bk"] = bf[H * D:(H + KV) * D].reshape(KV, D)[:, perm]
+                attn["bv"] = bf[(H + KV) * D:].reshape(KV, D)
+        attn["wo"] = w(o_name).reshape(H, D, E)
+        if cfg.attn_out_bias:
+            attn["bo"] = b(o_name)
+
+        ffn = {"w_up": w(up_name), "w_down": w(down_name)}
+        if gate_name and activation == "silu_glu":
+            ffn["w_gate"] = w(gate_name)
+        if activation != "silu_glu":        # two-matrix FFN carries biases
+            ffn["b_up"] = b(up_name) if f"{up_name}.bias" in lk \
+                else np.zeros(F, np.float32)
+            ffn["b_down"] = b(down_name) if f"{down_name}.bias" in lk \
+                else np.zeros(E, np.float32)
+
+        layer = {"ln_attn": norm_tree(lk[f"{ln_attn_name}.weight"]),
+                 "attn": attn, "ffn": ffn}
+        if ln_ffn_name and (not parallel or cfg.parallel_block_norms == 2):
+            layer["ln_ffn"] = norm_tree(lk[f"{ln_ffn_name}.weight"])
+        t[f"layer_{i}"] = layer
+
+    leftover = [k for k in sd if k not in used
+                and not any(s in k for s in _G_IGNORE)]
+    if leftover:
+        raise NotImplementedError(
+            f"generic HF import: {len(leftover)} tensors could not be "
+            f"placed for model_type '{hf_config.model_type}': "
+            f"{sorted(leftover)[:12]}{'...' if len(leftover) > 12 else ''}")
+    return cfg, t
+
+
 def from_hf_model(hf_model, dtype=None) -> tuple[TransformerLM, dict]:
     """(TransformerLM, params) from a loaded transformers model (e.g.
-    ``GPT2LMHeadModel.from_pretrained(...)``)."""
+    ``GPT2LMHeadModel.from_pretrained(...)``). Unknown ``model_type``s go
+    through the generic name/shape converter (the AutoTP role) and raise
+    listing unmatched tensors when the layout is genuinely alien."""
     import dataclasses
 
     import jax.numpy as jnp
 
-    cfg = config_from_hf(hf_model.config)
-    if dtype is not None:
-        cfg = dataclasses.replace(cfg, dtype=dtype)
     sd = {k: v.detach().cpu().numpy() for k, v in
           hf_model.state_dict().items()}
-    tree = _CONVERTERS[hf_model.config.model_type](sd, cfg)
+    mt = hf_model.config.model_type
+    if mt in _CONVERTERS:
+        cfg = config_from_hf(hf_model.config)
+        if dtype is not None:
+            cfg = dataclasses.replace(cfg, dtype=dtype)
+        tree = _CONVERTERS[mt](sd, cfg)
+    else:
+        cfg, tree = generic_config_and_tree(hf_model.config, sd)
+        if dtype is not None:
+            cfg = dataclasses.replace(cfg, dtype=dtype)
 
     def to_jnp(x):
         return {k: to_jnp(v) for k, v in x.items()} \

@@ -82,6 +82,10 @@ class MoEConfig:
     # intermediate size added to the routed output through a sigmoid gate
     # (reference inference/v2 qwen_v2_moe shared expert). None = no shared.
     shared_expert_intermediate: int | None = None
+    # renormalize the top-k gate values to sum to 1 (mixtral semantics);
+    # False = use the raw softmax probabilities (qwen2-moe's
+    # norm_topk_prob=False default)
+    normalize_gates: bool = True
 
 
 @dataclass(frozen=True)
@@ -98,7 +102,9 @@ class ModelConfig:
     rotary_pct: float = 1.0                  # partial rotary (gpt-neox/phi)
     norm: str = "layernorm"                  # layernorm | rmsnorm
     norm_eps: float = 1e-5
-    activation: str = "gelu"                 # gelu | relu | silu_glu (SwiGLU)
+    activation: str = "gelu"                 # gelu (tanh approx) |
+                                             # gelu_exact (erf) | relu |
+                                             # silu_glu (SwiGLU)
     qkv_bias: bool = False                   # qwen-style projection biases
     attn_out_bias: bool = False              # gpt2/bert-style out-proj bias
     parallel_block: bool = False             # falcon/gpt-j/phi: attn ∥ ffn
@@ -348,6 +354,16 @@ class Attention(nn.Module):
         return out
 
 
+#: two-matrix FFN activations; torch's nn.GELU() is the erf form while
+#: jax.nn.gelu defaults to the tanh approximation — archs that use exact
+#: gelu (gpt-neox, falcon) map to "gelu_exact" at import
+_ACTS = {
+    "gelu": jax.nn.gelu,
+    "gelu_exact": lambda x: jax.nn.gelu(x, approximate=False),
+    "relu": jax.nn.relu,
+}
+
+
 class DenseFFN(nn.Module):
     config: ModelConfig
 
@@ -372,7 +388,7 @@ class DenseFFN(nn.Module):
                             (F,), jnp.float32)
             bd = self.param("b_down", nn.with_partitioning(nn.initializers.zeros, ("embed",)),
                             (cfg.hidden_size,), jnp.float32)
-            act = jax.nn.relu if cfg.activation == "relu" else jax.nn.gelu
+            act = _ACTS[cfg.activation]
             h = act(x @ wu.astype(cfg.dtype) + bu.astype(cfg.dtype))
         h = constrain(h, BATCH, SEQ, MLP)
         out = h @ wd.astype(cfg.dtype)
@@ -400,6 +416,7 @@ def moe_layer_kwargs(cfg: ModelConfig, **overrides) -> dict:
         z_loss_weight=moe.router_z_loss_weight,
         dropless=moe.dropless,
         dropless_block_m=moe.dropless_block_m,
+        normalize_gates=moe.normalize_gates,
     )
     kw.update(overrides)
     return kw

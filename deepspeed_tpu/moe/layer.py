@@ -36,6 +36,9 @@ class TopKGate(nn.Module):
     noisy_gate_policy: str | None = None     # None | 'RSample'
     drop_tokens: bool = True
     dropless: bool = False
+    #: renormalize top-k gates to sum to 1 (False = raw softmax probs,
+    #: qwen2-moe norm_topk_prob=False semantics)
+    normalize_gates: bool = True
 
     @nn.compact
     def __call__(self, x: jax.Array, deterministic: bool = True):
@@ -49,11 +52,13 @@ class TopKGate(nn.Module):
         if self.noisy_gate_policy == "RSample" and not deterministic:
             rng = self.make_rng("gating")
         if self.dropless:
-            return topk_dropless_gating(logits, self.k, noise_rng=rng)
+            return topk_dropless_gating(logits, self.k, noise_rng=rng,
+                                        normalize_gates=self.normalize_gates)
         return topkgating(
             logits, self.k,
             self.eval_capacity_factor if deterministic else self.capacity_factor,
-            self.min_capacity, noise_rng=rng, drop_tokens=self.drop_tokens)
+            self.min_capacity, noise_rng=rng, drop_tokens=self.drop_tokens,
+            normalize_gates=self.normalize_gates)
 
 
 def dropless_dispatch_combine(x2d: jax.Array, gates: jax.Array,
@@ -155,6 +160,7 @@ class MoE(nn.Module):
     #: partitioning rule) — the capacity path is the multi-device default.
     dropless: bool = False
     dropless_block_m: int = 128
+    normalize_gates: bool = True
 
     @nn.compact
     def __call__(self, x: jax.Array, deterministic: bool = True) -> jax.Array:
@@ -167,6 +173,7 @@ class MoE(nn.Module):
             min_capacity=self.min_capacity,
             noisy_gate_policy=self.noisy_gate_policy,
             drop_tokens=self.drop_tokens, dropless=self.dropless,
+            normalize_gates=self.normalize_gates,
             name="gate")(x, deterministic)
 
         self.sow("losses", "moe_aux_loss",

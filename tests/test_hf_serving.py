@@ -61,3 +61,32 @@ def test_bloom_alibi_serves_matching_hf_generate():
         vocab_size=128, hidden_size=64, n_layer=2, n_head=4,
         layer_norm_epsilon=1e-5)).eval()
     _serve_and_compare(hf)
+
+
+def test_qwen2_moe_serves_matching_hf_generate():
+    """Shared-expert serving against real (imported) weights: router with
+    raw-softmax gates (norm_topk_prob=False), 4 experts top-2, and the
+    sigmoid-gated shared expert — the reference qwen_v2_moe path."""
+    torch.manual_seed(0)
+    hf = transformers.Qwen2MoeForCausalLM(transformers.Qwen2MoeConfig(
+        vocab_size=128, hidden_size=64, intermediate_size=128,
+        moe_intermediate_size=96, shared_expert_intermediate_size=112,
+        num_hidden_layers=2, num_attention_heads=4, num_key_value_heads=2,
+        max_position_embeddings=64, rms_norm_eps=1e-5,
+        tie_word_embeddings=False, num_experts=4, num_experts_per_tok=2,
+        decoder_sparse_step=1, mlp_only_layers=[], norm_topk_prob=False,
+        use_sliding_window=False)).eval()
+    _serve_and_compare(hf)
+
+
+def test_generic_neox_serves_matching_hf_generate():
+    """A generically-imported arch (no hand-written tree) must also SERVE
+    through v2, not just forward: parallel residual + partial rotary
+    through the paged path."""
+    torch.manual_seed(0)
+    hf = transformers.GPTNeoXForCausalLM(transformers.GPTNeoXConfig(
+        vocab_size=128, hidden_size=64, intermediate_size=128,
+        num_hidden_layers=2, num_attention_heads=4,
+        max_position_embeddings=64, rotary_pct=0.25,
+        use_parallel_residual=True, tie_word_embeddings=False)).eval()
+    _serve_and_compare(hf)
