@@ -228,7 +228,11 @@ def fastgen_main(emit: bool = True, *, n_req=None, prompt_mu=None,
            "p50_ttft_s": round(res["p50_ttft"], 3),        # incl. queue wait
            "p50_ttft_admitted_s": round(res["p50_ttft_adm"], 3),
            "requests": n_req, "prompt_mu": prompt_mu, "gen_mu": gen_mu,
-           "slots": max_seqs, "max_seq_len": MAX_LEN, "chunk": chunk}
+           "slots": max_seqs, "max_seq_len": MAX_LEN, "chunk": chunk,
+           # decode windows batch W tokens per dispatch: throughput up,
+           # admission/streaming latency granularity = W tokens (see
+           # RaggedInferenceConfig.decode_window; 1 disables)
+           "decode_window": 8}
     if seq_tok_s:
         out["sequential_tokens_per_s"] = round(seq_tok_s, 1)
         out["vs_sequential"] = round(tok_s / seq_tok_s, 2)
