@@ -193,6 +193,7 @@ def fastgen_main(emit: bool = True, *, n_req=None, prompt_mu=None,
         sla_tokens = sum(done_info[uid][0] for uid in met)
         return {
             "tok_s": done_tokens / wall,
+            "decode_window": eng.config.decode_window,
             "prompt_tok_s": sum(len(p) for p in prompts) / wall,
             "p50_ttft": float(np.percentile(list(ttft.values()), 50)),
             "p50_ttft_adm": float(np.percentile(list(ttft_adm.values()), 50)),
@@ -232,7 +233,7 @@ def fastgen_main(emit: bool = True, *, n_req=None, prompt_mu=None,
            # decode windows batch W tokens per dispatch: throughput up,
            # admission/streaming latency granularity = W tokens (see
            # RaggedInferenceConfig.decode_window; 1 disables)
-           "decode_window": 8}
+           "decode_window": res["decode_window"]}
     if seq_tok_s:
         out["sequential_tokens_per_s"] = round(seq_tok_s, 1)
         out["vs_sequential"] = round(tok_s / seq_tok_s, 2)
@@ -402,15 +403,27 @@ def main():
     n_dev = len(jax.devices())
     peak = _peak_tflops()
 
-    # ---- primary: the BASELINE config-1 family (easy regime, peak MFU)
-    try:
-        primary = measure_training(
-            model_name=model_name, seq_len=seq_len, micro_bs=micro_bs,
-            steps=steps, warmup=warmup, attn=attn, remat=remat,
-            offload=offload)
-    except BenchInvalid as e:
-        print(f"BENCH INVALID: {e}", file=sys.stderr, flush=True)
-        sys.exit(2)
+    # ---- primary: the BASELINE config-1 family (easy regime, peak MFU).
+    # One retry on transient runtime errors — the tunneled PJRT drops an
+    # occasional remote_compile mid-flight, and losing the whole artifact
+    # to that is worse than a second compile.
+    primary = None
+    for attempt in (0, 1):
+        try:
+            primary = measure_training(
+                model_name=model_name, seq_len=seq_len, micro_bs=micro_bs,
+                steps=steps, warmup=warmup, attn=attn, remat=remat,
+                offload=offload)
+            break
+        except BenchInvalid as e:
+            print(f"BENCH INVALID: {e}", file=sys.stderr, flush=True)
+            sys.exit(2)
+        except Exception as e:  # noqa: BLE001
+            if attempt == 1:
+                raise
+            print(f"# primary entry failed ({type(e).__name__}: {e}); "
+                  f"retrying once", file=sys.stderr, flush=True)
+            time.sleep(30)      # let a dropped tunnel session recycle
 
     # Offload entries move GBs of state host<->device per step; gate their
     # size on measured link bandwidth so a tunneled-PJRT host produces an
@@ -439,6 +452,7 @@ def main():
                     return {"error": f"{type(e).__name__}: {e}"[:200]}
                 print(f"# secondary entry failed ({type(e).__name__}: "
                       f"{e}); retrying once", file=sys.stderr, flush=True)
+                time.sleep(30)  # let a dropped tunnel session recycle
 
     def large_entry():
         if fast_link:

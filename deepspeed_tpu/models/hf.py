@@ -659,9 +659,12 @@ _G_ATTN_K = ("self_attn.k_proj", "attention.k_proj", "attn.k_proj")
 _G_ATTN_V = ("self_attn.v_proj", "attention.v_proj", "attn.v_proj")
 _G_ATTN_FUSED_HEADWISE = ("attention.query_key_value",
                           "self_attention.query_key_value")
-_G_ATTN_FUSED_SEQ = ("self_attn.qkv_proj", "attn.qkv_proj")
+#: NB deliberately NOT "attn.qkv_proj": codegen fuses qkv in mp_num-blocked
+#: order, which the sequential q|k|v split would silently mis-read — that
+#: layout must fail loudly until it has a dedicated converter
+_G_ATTN_FUSED_SEQ = ("self_attn.qkv_proj",)
 _G_ATTN_O = ("self_attn.o_proj", "attention.dense", "self_attn.dense",
-             "self_attn.out_proj", "attention.o_proj")
+             "self_attn.out_proj", "attn.out_proj", "attention.o_proj")
 _G_MLP_GATE = ("mlp.gate_proj",)
 _G_MLP_UP = ("mlp.up_proj", "mlp.dense_h_to_4h", "mlp.fc1", "mlp.fc_in")
 _G_MLP_DOWN = ("mlp.down_proj", "mlp.dense_4h_to_h", "mlp.fc2",
@@ -779,9 +782,33 @@ def generic_config_and_tree(hf_config, sd: dict):
             "generic HF import: silu activation without a gate_proj "
             "(non-GLU silu MLPs are not modeled)")
     norm = "layernorm" if f"{ln_attn_name}.bias" in lk0 else "rmsnorm"
+    # parallel residual: advertised by config (neox/falcon), or structural
+    # — a pre-norm decoder with ONE per-layer norm must feed attn and ffn
+    # from it in parallel (gpt-j/codegen carry no flag)
     parallel = bool(attr("use_parallel_residual", "parallel_attn",
-                         default=False))
-    rot_pct = float(attr("rotary_pct", "partial_rotary_factor", default=1.0))
+                         default=False)) or ln_ffn_name is None
+    # rotary convention: archs with a ``rotary_dim`` attr (gpt-j, codegen)
+    # rotate INTERLEAVED pairs — this model's native layout, no
+    # permutation; rotate_half archs (neox rotary_pct, stablelm
+    # partial_rotary_factor, plain rope_theta) need the half→interleaved
+    # head-dim permutation
+    rotary_dim = attr("rotary_dim")
+    if rotary_dim:
+        rot_pct = float(rotary_dim) / D
+        # ModelConfig stores the ratio; apply_rope reconstructs the dim as
+        # (int(D * pct) // 2) * 2 — refuse the rare (D, rotary_dim) pairs
+        # where that round-trip is lossy rather than rotate the wrong dims
+        if (int(D * rot_pct) // 2) * 2 != (int(rotary_dim) // 2) * 2:
+            raise NotImplementedError(
+                f"generic HF import: rotary_dim={rotary_dim} with "
+                f"head_dim={D} does not round-trip through rotary_pct "
+                f"exactly — silently rotating fewer dims than the "
+                f"checkpoint is not acceptable")
+        interleaved_native = True
+    else:
+        rot_pct = float(attr("rotary_pct", "partial_rotary_factor",
+                             default=1.0))
+        interleaved_native = False
     qkv_bias = (f"{q_name}.bias" in lk0 if q_name
                 else f"{fused_hw or fused_seq}.bias" in lk0)
     cfg = ModelConfig(
@@ -809,7 +836,8 @@ def generic_config_and_tree(hf_config, sd: dict):
     F = cfg.ffn_size
     d_rot = (int(D * rot_pct) // 2) * 2
     perm = np.concatenate([_interleave_perm(d_rot), np.arange(d_rot, D)]) \
-        if cfg.position_embedding == "rope" else np.arange(D)
+        if cfg.position_embedding == "rope" and not interleaved_native \
+        else np.arange(D)
 
     # ---- tree ----------------------------------------------------------
     def norm_tree(base_key):

@@ -411,3 +411,25 @@ def test_rope_scaling_rejected_loudly():
         rope_scaling={"rope_type": "linear", "factor": 2.0})
     with pytest.raises(NotImplementedError, match="rope_scaling"):
         config_from_hf(cfg)
+
+
+def test_generic_import_gptj_matches_torch_forward():
+    """Third generic-fallback family: gpt-j — structurally-parallel block
+    with ONE norm and NO config flag (detected from the absence of a
+    second per-layer norm), INTERLEAVED rotary via ``rotary_dim`` (no
+    head-dim permutation), biased lm_head."""
+    from deepspeed_tpu.models.hf import from_hf_model
+
+    hf_cfg = transformers.GPTJConfig(
+        vocab_size=128, n_embd=64, n_layer=2, n_head=4, n_positions=64,
+        rotary_dim=8, tie_word_embeddings=False)
+    hf = transformers.GPTJForCausalLM(hf_cfg).eval()
+    model, params = from_hf_model(hf, dtype=jnp.float32)
+    assert model.config.parallel_block and model.config.parallel_block_norms == 1
+    assert model.config.rotary_pct == 0.5 and model.config.unembed_bias
+
+    ids = np.random.default_rng(13).integers(0, 128, (2, 16)).astype(np.int32)
+    with torch.no_grad():
+        ref = hf(torch.from_numpy(ids).long()).logits.numpy()
+    got = _logits_ours(model, params, ids)
+    np.testing.assert_allclose(got, ref, atol=2e-4)
