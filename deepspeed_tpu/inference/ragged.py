@@ -49,7 +49,20 @@ class BlockedAllocator:
 
 @dataclass
 class SequenceDescriptor:
-    """Per-uid state (reference sequence_descriptor.py DSSequenceDescriptor)."""
+    """Per-uid state (reference sequence_descriptor.py DSSequenceDescriptor).
+
+    Two views coexist so the engine can run ahead of host readbacks
+    (the async serving pipeline, round-4):
+
+    - committed: ``tokens`` / ``n_computed`` / ``n_generated`` advance when
+      sampled tokens actually reach the host (``commit_generated``).
+    - scheduled: ``n_sched`` (KV scheduled into the pool) and
+      ``n_inflight`` (sampled tokens that exist only on device) advance at
+      DISPATCH time. The scheduler plans exclusively from this view, so
+      step N+1 can be built and dispatched while step N still runs on
+      device. Synchronous drivers that never touch the dispatch-time
+      accessors see identical numbers (``max`` below).
+    """
     uid: int
     tokens: list[int]                 # full token history (prompt + generated)
     slot: int = -1                    # batch slot while scheduled
@@ -59,6 +72,8 @@ class SequenceDescriptor:
     n_generated: int = 0
     done: bool = False
     eos_id: int | None = None         # stop criterion besides max_new_tokens
+    n_sched: int = 0                  # KV tokens scheduled (dispatch-time)
+    n_inflight: int = 0               # sampled tokens not yet read back
 
     @property
     def pending_tokens(self) -> int:
@@ -67,6 +82,34 @@ class SequenceDescriptor:
         (sampled or final-prompt) token."""
         return len(self.tokens) - self.n_computed
 
+    # --- scheduled (speculative) view -------------------------------------
+    @property
+    def kv_next(self) -> int:
+        """First token index whose KV is not yet scheduled."""
+        return max(self.n_computed, self.n_sched)
+
+    @property
+    def len_sched(self) -> int:
+        """Sequence length including in-flight (device-only) tokens."""
+        return len(self.tokens) + self.n_inflight
+
+    @property
+    def pending_sched(self) -> int:
+        """Tokens not yet scheduled through the model (speculative analogue
+        of ``pending_tokens``). > 1 → prefilling; == 1 → decode-ready."""
+        return self.len_sched - self.kv_next
+
+    @property
+    def gen_remaining_sched(self) -> int:
+        """Generation budget not yet scheduled."""
+        return self.max_new_tokens - self.n_generated - self.n_inflight
+
+    @property
+    def sched_done(self) -> bool:
+        """Nothing left to dispatch (committed-done OR budget fully
+        in flight)."""
+        return self.done or self.gen_remaining_sched <= 0
+
     def commit_generated(self, new_tokens: list[int],
                          n_computed: int) -> list[int]:
         """THE generation-accounting step, shared by the per-step scheduler
@@ -74,6 +117,11 @@ class SequenceDescriptor:
         advance the computed-KV counter, apply the stop criteria
         (max_new_tokens, and eos when configured — a window may sample past
         the eos; the surplus is truncated here, never surfaced)."""
+        if self.done:
+            # a lagged async commit can land after eos already finished the
+            # sequence — its tokens were computed past the stop and are
+            # discarded, never surfaced
+            return []
         if self.eos_id is not None and new_tokens:
             for i, t in enumerate(new_tokens):
                 if t == self.eos_id:
@@ -168,4 +216,8 @@ class StepPlan:
     seq_lens: np.ndarray              # [S] int32, length incl. this step's tokens
     sample_idx: np.ndarray            # [S] int32 index into T of last real token
     do_sample: np.ndarray             # [S] uint8 — emit a token for this slot
+    use_last: np.ndarray = None       # [S] uint8 — col-0 token comes from the
+    #                                   device-resident last-sampled array
+    #                                   (its host value is still in flight)
     uids: list[int] = field(default_factory=list)   # uid per slot (-1 = empty)
+    dispatched: bool = False          # mark_dispatched ran (async pipeline)

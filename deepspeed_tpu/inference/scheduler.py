@@ -26,7 +26,8 @@ class SplitFuseScheduler:
         self.state = state
         self.chunk = chunk
 
-    def _desc(self, kind: str, T: int, entries) -> StepPlan:
+    def _desc(self, kind: str, T: int, entries,
+              use_last_slots=()) -> StepPlan:
         S = self.state.max_seqs
         bs = self.state.block_size
         max_blocks = self.state.max_blocks_per_seq
@@ -40,8 +41,11 @@ class SplitFuseScheduler:
             seq_lens=np.zeros(S, np.int32),
             sample_idx=np.zeros(S, np.int32),
             do_sample=np.zeros(S, np.uint8),
+            use_last=np.zeros(S, np.uint8),
             uids=[-1] * S,
         )
+        for s in use_last_slots:
+            plan.use_last[s] = 1
         if not (entries and self._native_build(plan, T, entries)):
             for seq, toks, start_pos, sample in entries:
                 s = seq.slot
@@ -96,32 +100,74 @@ class SplitFuseScheduler:
         return True
 
     def next_step(self) -> StepPlan | None:
-        """Build the next step plan, or None if nothing to run."""
+        """Build the next step plan, or None if nothing to run.
+
+        Plans from the SCHEDULED (speculative) view so the engine can
+        dispatch ahead of readbacks. A decode row whose last token is
+        still in flight carries a placeholder with ``use_last`` set — the
+        program substitutes the device-resident last sampled token.
+
+        SplitFuse fusion: a prefill step also carries every decode-ready
+        sequence as a 1-token row, so running decoders are never starved
+        while a long prompt prefills (the reference packs prompt chunks
+        and decode tokens into one ragged batch; here they share one
+        fixed-shape [S, chunk] program)."""
         st = self.state
         prefill: list[SequenceDescriptor] = []
         decode: list[SequenceDescriptor] = []
         for seq in st.seqs.values():
-            if seq.done:
+            if seq.sched_done:
                 continue
-            (prefill if seq.pending_tokens > 1 else decode).append(seq)
+            (prefill if seq.pending_sched > 1 else decode).append(seq)
+
+        def decode_entry(seq):
+            if seq.n_inflight:
+                # value lives only on device → placeholder + use_last
+                return (seq, [0], seq.kv_next, True)
+            return (seq, seq.tokens[-1:], seq.kv_next, True)
 
         # blocks were reserved for prompt + max_new_tokens at admit time,
         # so neither branch can exhaust the pool here
         if prefill:
             entries = []
             for seq in prefill[:st.max_seqs]:
-                n = min(self.chunk, seq.pending_tokens)
-                toks = seq.tokens[seq.n_computed:seq.n_computed + n]
+                n = min(self.chunk, seq.pending_sched)
+                toks = seq.tokens[seq.kv_next:seq.kv_next + n]
                 # sample only when this chunk consumes the last pending token
-                finishes = n == seq.pending_tokens
-                entries.append((seq, toks, seq.n_computed, finishes))
-            return self._desc("prefill", self.chunk, entries)
+                finishes = n == seq.pending_sched
+                entries.append((seq, toks, seq.kv_next, finishes))
+            taken = {seq.slot for seq, *_ in entries}
+            use_last = []
+            for seq in decode:           # fuse running decoders in
+                if len(entries) >= st.max_seqs:
+                    break
+                if seq.slot in taken:
+                    continue
+                entries.append(decode_entry(seq))
+                if seq.n_inflight:
+                    use_last.append(seq.slot)
+            return self._desc("prefill", self.chunk, entries, use_last)
 
         if decode:
-            entries = [(seq, seq.tokens[-1:], seq.n_computed, True)
-                       for seq in decode[:st.max_seqs]]
-            return self._desc("decode", 1, entries)
+            entries = [decode_entry(seq) for seq in decode[:st.max_seqs]]
+            use_last = [seq.slot for seq in decode[:st.max_seqs]
+                        if seq.n_inflight]
+            return self._desc("decode", 1, entries, use_last)
         return None
+
+    def mark_dispatched(self, plan: StepPlan) -> None:
+        """Advance the SCHEDULED view for every row of a dispatched plan
+        (the async pipeline's dispatch-time half; ``commit`` remains the
+        readback-time half)."""
+        for s, uid in enumerate(plan.uids):
+            if uid < 0:
+                continue
+            seq = self.state.seqs[uid]
+            n = int(plan.active[s].sum())
+            seq.n_sched = seq.kv_next + n
+            if plan.do_sample[s]:
+                seq.n_inflight += 1
+        plan.dispatched = True
 
     def commit(self, plan: StepPlan,
                sampled: dict[int, int]) -> dict[int, list[int]]:
@@ -134,8 +180,14 @@ class SplitFuseScheduler:
         for s, uid in enumerate(plan.uids):
             if uid < 0:
                 continue
-            seq = st.seqs[uid]
+            seq = st.seqs.get(uid)
+            if seq is None:         # flushed while the commit was in flight
+                continue
             n = int(plan.active[s].sum())
+            if plan.dispatched:     # reconcile the speculative view
+                if plan.do_sample[s]:
+                    seq.n_inflight -= 1
             accepted[uid] = seq.commit_generated(
-                [sampled[uid]] if plan.do_sample[s] else [], n)
+                [sampled[uid]] if plan.do_sample[s] and uid in sampled
+                else [], n)
         return accepted

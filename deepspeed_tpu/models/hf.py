@@ -357,6 +357,8 @@ def _qwen2_moe_tree(sd: dict, cfg: ModelConfig) -> dict:
     attention (qkv bias) + per-layer MoE with HF-named experts
     (gate_proj/up_proj/down_proj), a router ``mlp.gate``, and the
     sigmoid-gated shared expert (``mlp.shared_expert[_gate]``)."""
+    from .transformer import is_moe_layer
+
     t = _llama_tree_attn_only(sd, cfg)
     H, KV, D = cfg.num_heads, cfg.kv_heads, cfg.head_dim
     perm = _interleave_perm(D)
@@ -368,6 +370,14 @@ def _qwen2_moe_tree(sd: dict, cfg: ModelConfig) -> dict:
         a["bk"] = sd[p + "self_attn.k_proj.bias"].reshape(KV, D)[:, perm]
         a["bv"] = sd[p + "self_attn.v_proj.bias"].reshape(KV, D)
         mp = p + "mlp."
+        if not is_moe_layer(cfg, i):
+            # mixed stack (mlp_only_layers / decoder_sparse_step): this
+            # layer carries a plain qwen2 dense FFN
+            t[f"layer_{i}"]["ffn"] = {
+                "w_gate": sd[mp + "gate_proj.weight"].T,
+                "w_up": sd[mp + "up_proj.weight"].T,
+                "w_down": sd[mp + "down_proj.weight"].T}
+            continue
         t[f"layer_{i}"]["moe"] = {
             "moe_layer": {
                 "gate": {"wg": sd[mp + "gate.weight"].T},   # [E, n_exp]
@@ -599,13 +609,20 @@ def config_from_hf(hf_config) -> ModelConfig:
     if mt == "qwen2_moe":
         from .transformer import MoEConfig
 
-        if getattr(hf_config, "mlp_only_layers", None):
+        # mixed dense/MoE stacks convert via an explicit per-layer pattern
+        # (HF semantics: MoE at layer i iff i not in mlp_only_layers and
+        # (i+1) % decoder_sparse_step == 0 — transformers
+        # models/qwen2_moe/modeling_qwen2_moe.py decoder layer)
+        step = int(getattr(hf_config, "decoder_sparse_step", 1) or 1)
+        only = set(getattr(hf_config, "mlp_only_layers", None) or ())
+        nl = hf_config.num_hidden_layers
+        pattern = tuple(i not in only and (i + 1) % step == 0
+                        for i in range(nl))
+        if not any(pattern):
             raise NotImplementedError(
-                "qwen2-moe mlp_only_layers (mixed dense/MoE stacks) is not "
-                "converted — homogeneous-MoE checkpoints are")
-        if getattr(hf_config, "decoder_sparse_step", 1) != 1:
-            raise NotImplementedError(
-                "qwen2-moe decoder_sparse_step > 1 is not converted")
+                "qwen2-moe checkpoint with NO MoE layers "
+                f"(decoder_sparse_step={step}, mlp_only_layers={only})")
+        moe_pattern = None if all(pattern) else pattern
         sw = hf_config.sliding_window if getattr(
             hf_config, "use_sliding_window", False) else None
         if sw is not None and sw >= hf_config.max_position_embeddings:
@@ -637,7 +654,14 @@ def config_from_hf(hf_config) -> ModelConfig:
                 normalize_gates=bool(getattr(hf_config, "norm_topk_prob",
                                              False)),
                 aux_loss_weight=float(getattr(
-                    hf_config, "router_aux_loss_coef", 0.001))))
+                    hf_config, "router_aux_loss_coef", 0.001)),
+                moe_layer_pattern=moe_pattern,
+                # mixed stacks: the mlp-only layers keep the checkpoint's
+                # DENSE width (e.g. Qwen1.5-MoE-A2.7B: 5632 dense vs 1408
+                # per expert)
+                dense_ffn_intermediate=(hf_config.intermediate_size
+                                        if moe_pattern is not None
+                                        else None)))
     raise NotImplementedError(
         f"no converter for HF model_type '{mt}' (have: "
         f"{sorted(_CONVERTERS)})")
@@ -929,6 +953,24 @@ def generic_config_and_tree(hf_config, sd: dict):
     return cfg, t
 
 
+class _TrackedSD(dict):
+    """State dict that records which tensors a converter consumed, so
+    ``from_hf_model`` can verify coverage (nothing silently dropped)."""
+
+    def __init__(self, sd: dict):
+        super().__init__(sd)
+        self.used: set[str] = set()
+
+    def __getitem__(self, k):
+        self.used.add(k)
+        return super().__getitem__(k)
+
+    def get(self, k, default=None):
+        if k in self:
+            return self[k]          # records the access
+        return default
+
+
 def from_hf_model(hf_model, dtype=None) -> tuple[TransformerLM, dict]:
     """(TransformerLM, params) from a loaded transformers model (e.g.
     ``GPT2LMHeadModel.from_pretrained(...)``). Unknown ``model_type``s go
@@ -945,7 +987,24 @@ def from_hf_model(hf_model, dtype=None) -> tuple[TransformerLM, dict]:
         cfg = config_from_hf(hf_model.config)
         if dtype is not None:
             cfg = dataclasses.replace(cfg, dtype=dtype)
-        tree = _CONVERTERS[mt](sd, cfg)
+        tsd = _TrackedSD(sd)
+        tree = _CONVERTERS[mt](tsd, cfg)
+        # the generic path's coverage check, applied to the hand-written
+        # converters too (advisor r03: a checkpoint variant carrying
+        # tensors a converter does not expect — e.g. qwen-v1 exported
+        # with biases — must fail loudly, not drop them into wrong
+        # logits). Tied heads duplicate the embedding; ignore them.
+        ignore = _G_IGNORE + (("lm_head.weight",)
+                              if cfg.tie_embeddings else ())
+        leftover = [k for k in sd if k not in tsd.used
+                    and not any(s in k for s in ignore)]
+        if leftover:
+            raise NotImplementedError(
+                f"HF import ({mt}): {len(leftover)} checkpoint tensors "
+                f"were not consumed by the converter — the layout has "
+                f"tensors this converter would silently drop: "
+                f"{sorted(leftover)[:12]}"
+                f"{'...' if len(leftover) > 12 else ''}")
     else:
         cfg, tree = generic_config_and_tree(hf_model.config, sd)
         if dtype is not None:

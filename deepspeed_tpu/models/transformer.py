@@ -74,6 +74,16 @@ class MoEConfig:
     router_z_loss_weight: float = 0.001
     # layers where MoE replaces dense FFN; every Nth layer (1 = all)
     moe_layer_freq: int = 1
+    # explicit per-layer MoE pattern (True = MoE FFN at that layer),
+    # overriding moe_layer_freq when set — expresses qwen2-moe's
+    # decoder_sparse_step phase ((i+1) % step == 0) and mlp_only_layers
+    # dense overrides (arbitrary mixed stacks). Length must equal
+    # num_layers.
+    moe_layer_pattern: tuple[bool, ...] | None = None
+    # FFN width of the DENSE layers in a mixed stack (qwen2-moe's
+    # ``intermediate_size`` vs ``moe_intermediate_size`` for experts);
+    # None = the model's intermediate_size
+    dense_ffn_intermediate: int | None = None
     # dropless (megablocks-style) routing through the Pallas grouped GEMM
     # instead of capacity-dispatch einsums (ops/pallas/grouped_matmul.py)
     dropless: bool = False
@@ -397,6 +407,32 @@ class DenseFFN(nn.Module):
         return constrain(out, BATCH, SEQ, EMBED)
 
 
+def dense_ffn_config(cfg: ModelConfig) -> ModelConfig:
+    """Config for the DENSE FFN of a mixed MoE stack: qwen2-moe's
+    mlp-only layers keep their own intermediate size."""
+    import dataclasses
+
+    if cfg.moe is not None and cfg.moe.dense_ffn_intermediate:
+        return dataclasses.replace(
+            cfg, intermediate_size=cfg.moe.dense_ffn_intermediate)
+    return cfg
+
+
+def is_moe_layer(cfg: ModelConfig, i: int) -> bool:
+    """Whether layer ``i`` carries the MoE FFN: the explicit per-layer
+    pattern when set (qwen2-moe sparse-step phase / mlp_only_layers),
+    else the every-Nth ``moe_layer_freq`` rule."""
+    if cfg.moe is None:
+        return False
+    pat = cfg.moe.moe_layer_pattern
+    if pat is not None:
+        if len(pat) != cfg.num_layers:
+            raise ValueError(f"moe_layer_pattern has {len(pat)} entries for "
+                             f"{cfg.num_layers} layers")
+        return bool(pat[i])
+    return i % (cfg.moe.moe_layer_freq or 1) == 0
+
+
 def moe_layer_kwargs(cfg: ModelConfig, **overrides) -> dict:
     """The single ModelConfig.moe → MoE-layer kwargs mapping, shared by the
     training adapter below and the ragged inference forward
@@ -411,7 +447,7 @@ def moe_layer_kwargs(cfg: ModelConfig, **overrides) -> dict:
         capacity_factor=moe.capacity_factor,
         eval_capacity_factor=moe.eval_capacity_factor,
         min_capacity=moe.min_capacity,
-        activation="silu_glu" if cfg.activation == "silu_glu" else "gelu",
+        activation=cfg.activation,   # Experts routes non-GLU through _ACTS
         aux_loss_weight=moe.aux_loss_weight,
         z_loss_weight=moe.router_z_loss_weight,
         dropless=moe.dropless,
@@ -471,7 +507,7 @@ class Block(nn.Module):
             if self.use_moe:
                 ffn_out = MoEFFN(cfg, name="moe")(h_ffn, deterministic=deterministic)
             else:
-                ffn_out = DenseFFN(cfg, name="ffn")(h_ffn)
+                ffn_out = DenseFFN(dense_ffn_config(cfg), name="ffn")(h_ffn)
             x = x + attn_out + ffn_out
             if kv_cache is not None:
                 return x, new_cache
@@ -493,7 +529,7 @@ class Block(nn.Module):
             if self.use_moe:
                 ffn_out = MoEFFN(cfg, name="moe")(x, deterministic=deterministic)
             else:
-                ffn_out = DenseFFN(cfg, name="ffn")(x)
+                ffn_out = DenseFFN(dense_ffn_config(cfg), name="ffn")(x)
             x = Norm(cfg, name="ln_ffn")(x + drop(ffn_out))
             if kv_cache is not None:
                 return x, new_cache
@@ -510,7 +546,7 @@ class Block(nn.Module):
         if self.use_moe:
             ffn_out = MoEFFN(cfg, name="moe")(h, deterministic=deterministic)
         else:
-            ffn_out = DenseFFN(cfg, name="ffn")(h)
+            ffn_out = DenseFFN(dense_ffn_config(cfg), name="ffn")(h)
         x = x + drop(ffn_out)
         if kv_cache is not None:
             return x, new_cache
@@ -566,7 +602,7 @@ class TransformerLM(nn.Module):
 
         new_caches = [] if kv_caches is not None else None
         for i in range(cfg.num_layers):
-            use_moe = bool(cfg.moe) and (i % (cfg.moe.moe_layer_freq or 1) == 0)
+            use_moe = is_moe_layer(cfg, i)
             cache = kv_caches[i] if kv_caches is not None else None
             out = block_cls(cfg, use_moe=use_moe, name=f"layer_{i}")(
                 x, positions, cache, attn_mask, deterministic)

@@ -115,6 +115,9 @@ class Experts(nn.Module):
         wd = self.param("w_down", nn.with_partitioning(
             init, ("expert", "expert_mlp", "embed")), (n, F, E), jnp.float32)
 
+        from ..models.transformer import _ACTS
+
+        act = _ACTS[self.activation] if not glu else None
         if sort is not None:
             from ..ops.pallas.grouped_matmul import grouped_matmul
 
@@ -124,15 +127,14 @@ class Experts(nn.Module):
                                                block_m)) * \
                     grouped_matmul(x, wu.astype(dtype), te, block_m)
             else:
-                h = jax.nn.gelu(grouped_matmul(x, wu.astype(dtype), te,
-                                               block_m))
+                h = act(grouped_matmul(x, wu.astype(dtype), te, block_m))
             return grouped_matmul(h, wd.astype(dtype), te, block_m)
 
         if glu:
             h = jax.nn.silu(jnp.einsum("ngce,nef->ngcf", x, wg.astype(dtype))) * \
                 jnp.einsum("ngce,nef->ngcf", x, wu.astype(dtype))
         else:
-            h = jax.nn.gelu(jnp.einsum("ngce,nef->ngcf", x, wu.astype(dtype)))
+            h = act(jnp.einsum("ngce,nef->ngcf", x, wu.astype(dtype)))
         return jnp.einsum("ngcf,nfe->ngce", h, wd.astype(dtype))
 
 

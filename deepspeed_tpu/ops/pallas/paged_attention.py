@@ -134,6 +134,230 @@ def _paged_attn_kernel(tables_ref, lens_ref, starts_ref, q_ref, k_ref, v_ref,
         o_ref[0, 0] = (acc_scr[:] / l_safe).astype(o_ref.dtype)
 
 
+def _ragged_attn_kernel(tables_ref, lens_ref, qst_ref, sst_ref, layer_ref,
+                        q_ref, kp_ref, vp_ref, ks_ref, vs_ref, o_ref,
+                        m_scr, l_scr, acc_scr, *, block_size: int,
+                        scale: float, G: int, window: int,
+                        ring_tokens: int, n_stage_pages: int):
+    """Read-only-pool ragged attention, ALL kv heads per grid step.
+
+    Round-4 redesign of :func:`_paged_attn_kernel` driven by two measured
+    costs on real hardware:
+
+    1. Interleaving pool scatters with pallas reads inside the layer scan
+       forced XLA to materialize pool-sized buffers (~280ms per decode
+       step on a 1.6GB pool). The pool here is READ-ONLY — it holds only
+       positions < stage_starts[s]; the current step's (and, in a decode
+       window, the window's earlier) tokens arrive in a small staged
+       buffer and are merged into the pool ONCE per program by the
+       caller.
+    2. A (seqs, kv_heads, pages) grid ran ~200k grid steps per decode
+       iteration (~40ms of pure grid overhead). The grid is now
+       (seqs, pages+1) with all KV heads batched into one block-DMA and
+       one batched MXU dot per step; the final grid step attends over the
+       staged tokens instead of a pool page.
+
+    Grid (S, mb+1). Per step j<mb: one pool page, all heads. j==mb: the
+    stage. Block tables are padded with the trash block (0), so invalid
+    pages re-DMA the same block and the pipeline skips the fetch.
+    """
+    del layer_ref
+    s = pl.program_id(0)
+    tq = pl.program_id(1)          # query-row tile (VMEM-bounds long chunks)
+    j = pl.program_id(2)
+    nj = pl.num_programs(2)
+    n_pool = nj - n_stage_pages    # pool pages come first, then the stage
+
+    @pl.when(j == 0)
+    def _init():
+        m_scr[:] = jnp.full_like(m_scr, NEG_INF)
+        l_scr[:] = jnp.zeros_like(l_scr)
+        acc_scr[:] = jnp.zeros_like(acc_scr)
+
+    seq_len = lens_ref[s]
+    qstart = qst_ref[s]
+    sstart = sst_ref[s]            # pool holds positions < sstart
+    is_stage = j >= n_pool
+    tqb = m_scr.shape[1]           # query rows per tile
+
+    def online_update(scores, ctx, valid, v):
+        """Shared online-softmax step. scores [KV, TQB, W]; ctx [KV,TQB,W]
+        absolute key positions; valid bool; v [KV, W, D]."""
+        qpos = qstart + (tq * tqb + jax.lax.broadcasted_iota(
+            jnp.int32, scores.shape, 1)) // G
+        mask = valid & (ctx <= qpos)
+        if window:
+            mask &= ctx > qpos - window
+        scores = jnp.where(mask, scores, NEG_INF)
+        m_prev = m_scr[:]                                  # [KV, TQB, 1]
+        m_new = jnp.maximum(m_prev,
+                            jnp.max(scores, axis=-1, keepdims=True))
+        alpha = jnp.exp(m_prev - m_new)
+        p = jnp.exp(scores - m_new)
+        l_scr[:] = alpha * l_scr[:] + jnp.sum(p, axis=-1, keepdims=True)
+        acc_scr[:] = acc_scr[:] * alpha + jax.lax.dot_general(
+            p.astype(v.dtype), v, (((2,), (1,)), ((0,), (0,))),
+            preferred_element_type=jnp.float32)            # [KV, TQB, D]
+        m_scr[:] = m_new
+
+    # ---- pool page step --------------------------------------------------
+    if ring_tokens:
+        nwin = ring_tokens // block_size
+        b_latest = jnp.maximum(sstart - 1, 0) // block_size
+        b_j = b_latest - (b_latest - j) % nwin
+        page_start = b_j * block_size
+        run_pool = (sstart > 0) & (b_j >= 0) & (~is_stage)
+    else:
+        page_start = j * block_size
+        run_pool = (page_start < sstart) & (~is_stage)
+        if window:
+            run_pool &= page_start + block_size > qstart - window + 1
+
+    @pl.when(run_pool)
+    def _pool_step():
+        q = q_ref[0]                                       # [KV, TQB, D]
+        k = kp_ref[0, 0, :, 0]                             # [KV, bs, D]
+        v = vp_ref[0, 0, :, 0]
+        scores = jax.lax.dot_general(
+            q, k, (((2,), (2,)), ((0,), (0,))),
+            preferred_element_type=jnp.float32) * scale    # [KV, TQB, bs]
+        raw = page_start + jax.lax.broadcasted_iota(
+            jnp.int32, scores.shape, 2)
+        if ring_tokens:
+            ctx = jnp.where(raw < sstart, raw, raw - ring_tokens)
+            valid = ctx >= 0
+        else:
+            ctx = raw
+            valid = ctx < sstart
+        online_update(scores, ctx, valid, v)
+
+    # ---- stage steps (this program's fresh tokens, page-sized tiles) -----
+    sp = jnp.maximum(j - n_pool, 0)          # stage page index
+    srows = ks_ref.shape[2]                  # rows per stage page
+
+    @pl.when(is_stage & (sstart + sp * srows < seq_len))
+    def _stage_step():
+        q = q_ref[0]                                       # [KV, TQB, D]
+        k = ks_ref[0]                                      # [KV, srows, D]
+        v = vs_ref[0]
+        scores = jax.lax.dot_general(
+            q, k, (((2,), (2,)), ((0,), (0,))),
+            preferred_element_type=jnp.float32) * scale
+        ctx = sstart + sp * srows + jax.lax.broadcasted_iota(
+            jnp.int32, scores.shape, 2)
+        online_update(scores, ctx, ctx < seq_len, v)
+
+    @pl.when(j == nj - 1)
+    def _finalize():
+        l = l_scr[:]
+        l_safe = jnp.where(l == 0.0, 1.0, l)               # empty slot → 0s
+        o_ref[0] = (acc_scr[:] / l_safe).astype(o_ref.dtype)
+
+
+def paged_ragged_attention(q, pool, k_stage, v_stage, block_tables,
+                           seq_lens, q_starts, stage_starts, *,
+                           block_size: int, layer_index,
+                           scale: float | None = None,
+                           window: int | None = None,
+                           ring_tokens: int | None = None,
+                           interpret: bool | None = None):
+    """Ragged attention over a READ-ONLY paged pool plus a staged tail.
+
+    q:            [S, T, H, D] — query rows at positions
+                  q_starts[s]..q_starts[s]+T-1 (contiguous per slot)
+    pool:         [L, 2, KV, nb, bs, D] — past KV, positions
+                  < stage_starts[s] per slot; NEVER written by this
+                  kernel (the caller merges the stage in once per
+                  program)
+    k_stage/v_stage: [S, KV, Ts, D] — fresh tokens at positions
+                  stage_starts[s] + r, valid while < seq_lens[s]
+    block_tables: [S, max_pages] int32 (pad with the trash block 0)
+    seq_lens:     [S] — total valid context incl. staged tokens
+    layer_index:  scalar — which pool layer this call reads
+    Returns [S, T, H, D].
+    """
+    S, T, H, D = q.shape
+    L, _, KV, nb, bs, _ = pool.shape
+    if bs != block_size:
+        raise ValueError(f"pool block dim {bs} != block_size {block_size}")
+    if H % KV:
+        raise ValueError(f"GQA needs H ({H}) divisible by KV ({KV})")
+    G = H // KV
+    Ts = k_stage.shape[2]
+    max_pages = block_tables.shape[1]
+    if ring_tokens and not window:
+        raise ValueError("ring buffer requires a sliding window")
+    if scale is None:
+        scale = 1.0 / (D ** 0.5)
+    if interpret is None:
+        interpret = jax.default_backend() != "tpu"
+
+    # [S, T, KV, G, D] -> [S, KV, T*G, D], rows t*G + g
+    qg = (q.reshape(S, T, KV, G, D).transpose(0, 2, 1, 3, 4)
+          .reshape(S, KV, T * G, D))
+    TG = T * G
+    # query-row tiles bound VMEM for long prefill chunks; stage pages
+    # bound it on the key side (uniform page-sized score tiles)
+    TQB = TG if TG <= 128 else 128
+    while TG % TQB:
+        TQB //= 2
+    if Ts <= bs:
+        srows, nsp = Ts, 1
+    else:
+        if Ts % bs:
+            raise ValueError(f"stage rows {Ts} must be a multiple of "
+                             f"block_size {bs} (or <= it)")
+        srows, nsp = bs, Ts // bs
+    n_pool = max_pages
+
+    def tbj(t, s, j):
+        # stage steps (j >= max_pages) still need a legal page index
+        return jnp.where(j < n_pool, t[s, jnp.minimum(j, n_pool - 1)], 0)
+
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=5,
+        grid=(S, TG // TQB, n_pool + nsp),
+        in_specs=[
+            pl.BlockSpec((1, KV, TQB, D),
+                         lambda s, tq, j, t, ln, qs, ss, lr: (s, 0, tq, 0)),
+            pl.BlockSpec((1, 1, KV, 1, bs, D),
+                         lambda s, tq, j, t, ln, qs, ss, lr:
+                             (lr[0], 0, 0, tbj(t, s, j), 0, 0)),
+            pl.BlockSpec((1, 1, KV, 1, bs, D),
+                         lambda s, tq, j, t, ln, qs, ss, lr:
+                             (lr[0], 1, 0, tbj(t, s, j), 0, 0)),
+            pl.BlockSpec((1, KV, srows, D),
+                         lambda s, tq, j, t, ln, qs, ss, lr:
+                             (s, 0, jnp.maximum(j - n_pool, 0), 0)),
+            pl.BlockSpec((1, KV, srows, D),
+                         lambda s, tq, j, t, ln, qs, ss, lr:
+                             (s, 0, jnp.maximum(j - n_pool, 0), 0)),
+        ],
+        out_specs=pl.BlockSpec((1, KV, TQB, D),
+                               lambda s, tq, j, t, ln, qs, ss, lr:
+                                   (s, 0, tq, 0)),
+        scratch_shapes=[
+            pltpu.VMEM((KV, TQB, 1), jnp.float32),
+            pltpu.VMEM((KV, TQB, 1), jnp.float32),
+            pltpu.VMEM((KV, TQB, D), jnp.float32),
+        ],
+    )
+    out = pl.pallas_call(
+        functools.partial(_ragged_attn_kernel, block_size=block_size,
+                          scale=float(scale), G=G, window=int(window or 0),
+                          ring_tokens=int(ring_tokens or 0),
+                          n_stage_pages=nsp),
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((S, KV, TG, D), q.dtype),
+        interpret=interpret,
+    )(block_tables.astype(jnp.int32), seq_lens.astype(jnp.int32),
+      q_starts.astype(jnp.int32), stage_starts.astype(jnp.int32),
+      jnp.asarray(layer_index, jnp.int32).reshape(1),
+      qg, pool, pool, k_stage, v_stage)
+    return (out.reshape(S, KV, T, G, D).transpose(0, 2, 1, 3, 4)
+            .reshape(S, T, H, D))
+
+
 def paged_prefill_attention(q, k_pool, v_pool, block_tables, seq_lens,
                             chunk_starts, *, block_size: int,
                             scale: float | None = None,
@@ -153,7 +377,9 @@ def paged_prefill_attention(q, k_pool, v_pool, block_tables, seq_lens,
     chunk_starts: [S] int32 — absolute position of each slot's first token
     Returns [S, T, H, D]. Peak memory per grid step is one [T*G, bs]
     score tile + one page — never the [S, ctx, KV, D] gather of the XLA
-    formulation.
+    formulation. (The serving engine itself uses
+    :func:`paged_ragged_attention` — read-only pool + staged fresh
+    tokens; this per-layer-slice form remains for direct kernel use.)
     """
     S, T, H, D = q.shape
     KV, P, _ = k_pool.shape
@@ -179,6 +405,14 @@ def paged_prefill_attention(q, k_pool, v_pool, block_tables, seq_lens,
     # [S, T, H, D] -> [S, KV, T*G, D], rows t*G + g
     qg = (q.reshape(S, T, KV, G, D).transpose(0, 2, 1, 3, 4)
           .reshape(S, KV, T * G, D))
+    scratch = [
+        pltpu.VMEM((T * G, 1), jnp.float32),
+        pltpu.VMEM((T * G, 1), jnp.float32),
+        pltpu.VMEM((T * G, D), jnp.float32),
+    ]
+    kw = dict(block_size=block_size, scale=float(scale),
+              G=G, window=int(window or 0),
+              ring_tokens=int(ring_tokens or 0))
     kp = k_pool.reshape(KV, P // block_size, block_size, D)
     vp = v_pool.reshape(KV, P // block_size, block_size, D)
 
@@ -195,16 +429,10 @@ def paged_prefill_attention(q, k_pool, v_pool, block_tables, seq_lens,
         ],
         out_specs=pl.BlockSpec((1, 1, T * G, D),
                                lambda s, h, j, tb, ln, st: (s, h, 0, 0)),
-        scratch_shapes=[
-            pltpu.VMEM((T * G, 1), jnp.float32),
-            pltpu.VMEM((T * G, 1), jnp.float32),
-            pltpu.VMEM((T * G, D), jnp.float32),
-        ],
+        scratch_shapes=scratch,
     )
     out = pl.pallas_call(
-        functools.partial(_paged_attn_kernel, block_size=block_size,
-                          scale=float(scale), G=G, window=int(window or 0),
-                          ring_tokens=int(ring_tokens or 0)),
+        functools.partial(_paged_attn_kernel, **kw),
         grid_spec=grid_spec,
         out_shape=jax.ShapeDtypeStruct((S, KV, T * G, D), q.dtype),
         interpret=interpret,

@@ -162,6 +162,11 @@ class LayerStreamTrainer:
         # peak_staged_bytes counts staged PARAMS; peak_hbm_bytes adds the
         # grad queue (≤ lookahead+1 layer-grad trees) — the honest total
         self.peak_hbm_bytes = 0
+        # read-ahead effectiveness (surfaced by the bench artifact): a hit
+        # = the group's NVMe reads were already in flight when the walk
+        # needed it; a miss = the fetch had to be issued synchronously
+        self.nvme_prefetch_hits = 0
+        self.nvme_prefetch_misses = 0
 
     # ------------------------------------------------------------------
     # host state bring-up
@@ -271,7 +276,12 @@ class LayerStreamTrainer:
 
     def _fetch_group(self, g: str) -> dict:
         """Complete (or issue-and-complete) the NVMe read of a group."""
-        bufs, treedef = self._inflight.pop(g, None) or self._issue_fetch(g)
+        inflight = self._inflight.pop(g, None)
+        if inflight is not None:
+            self.nvme_prefetch_hits += 1
+        else:
+            self.nvme_prefetch_misses += 1
+        bufs, treedef = inflight or self._issue_fetch(g)
         leaves = []
         for buf, req, shape in bufs:
             self.aio.wait(req)

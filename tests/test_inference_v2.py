@@ -530,3 +530,37 @@ def test_native_atom_builder_matches_python(monkeypatch):
         for f in ("token_ids", "positions", "slot_map", "active",
                   "block_tables", "seq_lens", "sample_idx", "do_sample"):
             np.testing.assert_array_equal(getattr(a, f), getattr(b, f), f)
+
+
+def test_v2_mixed_moe_dense_stack_serves():
+    """A mixed dense/MoE stack (explicit moe_layer_pattern, the qwen2-moe
+    mlp_only_layers shape) generates through the ragged engine and matches
+    the v1 whole-batch engine (unrolled layer path, round-4)."""
+    import dataclasses
+
+    from deepspeed_tpu.parallel.topology import MeshTopology
+
+    base = build_model("tiny-mixtral").config
+    cfg = dataclasses.replace(
+        base, moe=dataclasses.replace(
+            base.moe, moe_layer_pattern=tuple(
+                i % 2 == 1 for i in range(base.num_layers))))
+    from deepspeed_tpu.models.transformer import TransformerLM
+    model = TransformerLM(cfg)
+    topo = MeshTopology({"tensor": 1, "data": 1})
+    rng = jax.random.PRNGKey(9)
+    v1 = InferenceEngine(model, config={"max_seq_len": 128}, rng=rng,
+                         topology=topo)
+    v2 = InferenceEngineV2(model, config={"block_size": 4, "num_blocks": 64,
+                                          "max_seqs": 2, "chunk": 8,
+                                          "max_seq_len": 128},
+                           rng=rng, topology=topo)
+    assert not v2._scan_layers          # mixed stack → unrolled path
+    v2.params = v1.params
+    rngnp = np.random.default_rng(3)
+    prompts = [list(map(int, rngnp.integers(0, 256, (L,)))) for L in [5, 13]]
+    got = v2.generate(prompts, max_new_tokens=4)
+    for p, g in zip(prompts, got):
+        ref = np.asarray(v1.generate(np.asarray([p], np.int32),
+                                     max_new_tokens=4, greedy=True))[0]
+        np.testing.assert_array_equal(np.asarray(g), ref)
