@@ -128,40 +128,65 @@ def fastgen_main(emit: bool = True, *, n_req=None, prompt_mu=None,
     pool_frac = float(os.environ.get("BENCH_POOL_FRAC", "0.6"))
 
     decode_window = int(os.environ.get("BENCH_DECODE_WINDOW", "0")) or None
+    max_inflight = int(os.environ.get("BENCH_MAX_INFLIGHT", "0")) or None
 
     def probe_steps(eng, max_live):
-        """Warm every program size AND measure per-kind synchronous device
-        step time (dispatch + compute, no data readback): max_live slots
-        prefill one full chunk each, then the generation walks the window
-        sizes W, W/2, ..., 1. Pass 1 pays the compiles; pass 2's timings
-        are the recorded device-time probe (the bench's honest split of
-        host scheduling vs device compute vs blocked readback)."""
-        timings = {}
+        """Warm every program size AND measure per-kind device step time.
+
+        Per-step sync on a tunneled PJRT is noise (block_until_ready
+        latency swings 90ms-4s), so each phase is timed as N back-to-back
+        dispatches with ONE final sync. Prompts of 4*chunk give 4 timed
+        prefill steps; a 5W generation budget gives 4 timed full-W
+        windows, and the tail walks W/2, ..., 1 plus the T=1 decode plan
+        so every program the measured run needs is compiled. Pass 1 pays
+        the compiles; pass 2's timings are recorded."""
+        timings: dict = {}
+        # the engine pow2-floors the dispatched window, so gate and label
+        # with the size that actually runs
+        W = 1 << (eng.config.decode_window.bit_length() - 1)
         for pass_n in range(2):
-            uids = [10**9 + i for i in range(max_live)]
-            for uid in uids:
-                # budget 2W: the final-chunk sample takes 1, then the
-                # remaining 2W-1 walks W, W/2, ..., 2 and ends at 1 —
-                # compiling prefill, every pow2 window AND the T=1 plan
-                eng.put(uid, list(range(chunk)),
-                        2 * eng.config.decode_window)
+            rec: dict = {}
+            uids = []
+            for i in range(max_live):
+                plen = 4 * chunk       # halve if the pool can't hold it
+                while plen > chunk and not eng.can_schedule(plen, 5 * W):
+                    plen //= 2
+                if not eng.can_schedule(plen, 5 * W):
+                    break
+                eng.put(10**9 + i, list(range(plen)), 5 * W)
+                uids.append(10**9 + i)
+            # -- prefill: all chunk steps back-to-back, one sync
+            t0, n = time.perf_counter(), 0
+            while any(s.pending_sched > 1 for s in eng.state.seqs.values()):
+                eng._dispatch_next()
+                n += 1
+            jax.block_until_ready(eng.kv_pool)
+            if n:
+                rec.setdefault("prefill", []).append(
+                    (time.perf_counter() - t0) / n)
+            # -- full-size decode windows back-to-back, one sync
+            t0, n = time.perf_counter(), 0
             while True:
-                t0 = time.perf_counter()
+                live = [s for s in eng.state.seqs.values()
+                        if not s.sched_done]
+                if not (live and all(s.pending_sched == 1 for s in live)
+                        and min(s.gen_remaining_sched for s in live) >= W):
+                    break
+                eng._dispatch_next()
+                n += 1
+            jax.block_until_ready(eng.kv_pool)
+            if n:
+                rec.setdefault(f"window{W}", []).append(
+                    (time.perf_counter() - t0) / n)
+            # -- tail: walks W/2, ..., 1 and the T=1 plan (warm only)
+            while any(not s.sched_done for s in eng.state.seqs.values()):
                 if not eng._dispatch_next():
                     break
-                entry = eng._inflight[-1]
-                kind = entry["kind"] if entry["kind"] == "window" \
-                    else entry["plan"].kind
-                if kind == "window":
-                    kind = f"window{entry['toks'].shape[0]}"
-                jax.block_until_ready(eng.kv_pool)
-                timings.setdefault(kind, []).append(
-                    time.perf_counter() - t0)
-                eng._drain(drain_all=True)
-            if pass_n == 0:
-                timings = {}
+            eng._drain(drain_all=True)
             for uid in uids:
                 eng.flush(uid)
+            if pass_n == 1:
+                timings = rec
         return {k: round(float(np.mean(v)), 4) for k, v in timings.items()}
 
     def serve(max_live):
@@ -176,7 +201,9 @@ def fastgen_main(emit: bool = True, *, n_req=None, prompt_mu=None,
                     "max_seqs": max_live, "chunk": chunk,
                     "max_seq_len": MAX_LEN,
                     **({"decode_window": decode_window}
-                       if decode_window else {})},
+                       if decode_window else {}),
+                    **({"max_inflight": max_inflight}
+                       if max_inflight else {})},
             topology=MeshTopology({"tensor": 1, "data": 1}))
         device_probe = probe_steps(eng, max_live)
         for k in eng.stats:

@@ -652,18 +652,18 @@ class InferenceEngineV2:
                                   P()),
                         out_specs=P(None, None, "tensor", None),
                         check_vma=False,
-                    )(q, self._ro_pool, k_st, v_st, block_tables, seq_lens,
+                    )(q, ro_pool, k_st, v_st, block_tables, seq_lens,
                       q_starts, stage_starts, li_dev)
                 else:
                     o = paged_ragged_attention(
-                        q, self._ro_pool, k_st, v_st, block_tables,
+                        q, ro_pool, k_st, v_st, block_tables,
                         seq_lens, q_starts, stage_starts,
                         block_size=bs, layer_index=li_dev, window=win,
                         ring_tokens=ring)
             else:
                 # fallback (alibi / odd geometries): gather each slot's
                 # pool pages (valid < stage_starts) and append the stage.
-                pool = self._ro_pool
+                pool = ro_pool
                 blocks = jnp.repeat(block_tables, bs, axis=1)    # [S,ctx]
                 offs = jnp.tile(jnp.arange(bs), block_tables.shape[1])
                 K = pool[li_dev, 0, :, blocks, offs[None, :]]   # [S,ctx,KV,D]
@@ -730,9 +730,9 @@ class InferenceEngineV2:
             h_ffn = Norm(m).apply({"params": p["ln_ffn"]}, x)
             return x + ffn(p, h_ffn, use_moe), stage_l
 
-        # the pool is read-only for the whole program (see docstring); a
-        # closure attribute keeps the traced value visible to `attention`
-        self._ro_pool = kv_pool
+        # the pool stays read-only for the whole program: `attention`
+        # closes over this alias, never the (later re-bound) kv_pool
+        ro_pool = kv_pool
         empty_stage = (jnp.zeros((S, KV, Ts, D), cfg.dtype),) * 2
         if "layers_stacked" in params:
             # scan over depth: ONE traced layer body regardless of L; the
@@ -768,8 +768,6 @@ class InferenceEngineV2:
                 k_list.append(stage_l[0])
                 v_list.append(stage_l[1])
             k_ys, v_ys = jnp.stack(k_list), jnp.stack(v_list)
-        del self._ro_pool
-
         x = Norm(m).apply({"params": params["ln_final"]}, x)
         last = jnp.take_along_axis(
             x, sample_idx[:, None, None].astype(jnp.int32), axis=1)[:, 0]  # [S,E]
@@ -1131,13 +1129,14 @@ class InferenceEngineV2:
 
     def flush(self, uid: int) -> list[int]:
         """Release a request's KV + slot, returning generated tokens
-        (reference ``flush`` :242). Drains the async pipeline first iff
-        any in-flight step still references this uid — a lingering device
-        step could otherwise write into blocks about to be reused. The
-        common case (sequence committed done, nothing in flight for it)
-        releases without stalling the pipeline."""
-        if self._inflight and self._uid_inflight(uid):
-            self._drain(drain_all=True)
+        (reference ``flush`` :242). Drains the async pipeline ONLY up to
+        the last in-flight step referencing this uid (FIFO) — a lingering
+        device step could otherwise write into blocks about to be reused,
+        but steps that only reference other uids keep riding. The common
+        case (sequence committed done, nothing in flight for it) releases
+        without stalling the pipeline at all."""
+        while self._inflight and self._uid_inflight(uid):
+            self._drain(force=True)         # pops (at least) the oldest
         if uid in self.state.seqs:
             self.state.release(uid)
         return self._results.pop(uid, [])

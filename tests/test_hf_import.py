@@ -433,3 +433,36 @@ def test_generic_import_gptj_matches_torch_forward():
         ref = hf(torch.from_numpy(ids).long()).logits.numpy()
     got = _logits_ours(model, params, ids)
     np.testing.assert_allclose(got, ref, atol=2e-4)
+
+
+def test_qwen2_moe_mixed_stack_import_matches_torch_forward():
+    """Mixed dense/MoE stacks (the layout qwen2-moe checkpoints actually
+    ship): decoder_sparse_step=2 puts MoE at odd layers, mlp_only_layers
+    forces one of those dense anyway, and the dense layers use the
+    checkpoint's DENSE intermediate_size (168), which differs from the
+    expert width (96) — the import must produce torch-equal logits
+    through both FFN kinds (round-4: moe_layer_pattern +
+    dense_ffn_intermediate)."""
+    from deepspeed_tpu.models.hf import from_hf_model
+    from deepspeed_tpu.models.transformer import is_moe_layer
+
+    hf_cfg = transformers.Qwen2MoeConfig(
+        vocab_size=128, hidden_size=64, intermediate_size=168,
+        moe_intermediate_size=96, shared_expert_intermediate_size=112,
+        num_hidden_layers=4, num_attention_heads=4, num_key_value_heads=2,
+        max_position_embeddings=64, rms_norm_eps=1e-5,
+        tie_word_embeddings=False, num_experts=4, num_experts_per_tok=2,
+        decoder_sparse_step=2, mlp_only_layers=[3], norm_topk_prob=False,
+        use_sliding_window=False)
+    hf = transformers.Qwen2MoeForCausalLM(hf_cfg).eval()
+    model, params = from_hf_model(hf, dtype=jnp.float32)
+    # HF: MoE at i where (i+1) % 2 == 0 and i not in mlp_only_layers
+    flags = [is_moe_layer(model.config, i) for i in range(4)]
+    assert flags == [False, True, False, False], flags
+    assert model.config.moe.dense_ffn_intermediate == 168
+
+    ids = np.random.default_rng(11).integers(0, 128, (1, 16)).astype(np.int32)
+    with torch.no_grad():
+        ref = hf(torch.from_numpy(ids).long()).logits.numpy()
+    got = _logits_ours(model, params, ids)
+    np.testing.assert_allclose(got, ref, atol=3e-4)
