@@ -128,7 +128,15 @@ def fastgen_main(emit: bool = True, *, n_req=None, prompt_mu=None,
     pool_frac = float(os.environ.get("BENCH_POOL_FRAC", "0.6"))
 
     decode_window = int(os.environ.get("BENCH_DECODE_WINDOW", "0")) or None
-    max_inflight = int(os.environ.get("BENCH_MAX_INFLIGHT", "0")) or None
+    # NB 0 is meaningful here (synchronous stepping) — unset-sentinel, not
+    # `or None`
+    _mi = os.environ.get("BENCH_MAX_INFLIGHT")
+    max_inflight = int(_mi) if _mi is not None else None
+    # 128-token pages measured best (long mix prompt tok/s: 6032 @ 32,
+    # 7459 @ 64, 9800 @ 128 — wider pages feed the MXU full lanes and
+    # cut the page-grid 4x); 256 exceeds the v5e scoped-VMEM budget in
+    # the ragged kernel, so 128 is the practical max here
+    block_size = int(os.environ.get("BENCH_BLOCK_SIZE", "128"))
 
     def probe_steps(eng, max_live):
         """Warm every program size AND measure per-kind device step time.
@@ -148,10 +156,13 @@ def fastgen_main(emit: bool = True, *, n_req=None, prompt_mu=None,
             rec: dict = {}
             uids = []
             for i in range(max_live):
-                plen = 4 * chunk       # halve if the pool can't hold it
-                while plen > chunk and not eng.can_schedule(plen, 5 * W):
+                plen = 4 * chunk   # halve until context + pool both fit
+                while plen > chunk and (
+                        plen + 5 * W > eng.config.max_seq_len
+                        or not eng.can_schedule(plen, 5 * W)):
                     plen //= 2
-                if not eng.can_schedule(plen, 5 * W):
+                if plen + 5 * W > eng.config.max_seq_len \
+                        or not eng.can_schedule(plen, 5 * W):
                     break
                 eng.put(10**9 + i, list(range(plen)), 5 * W)
                 uids.append(10**9 + i)
@@ -190,20 +201,20 @@ def fastgen_main(emit: bool = True, *, n_req=None, prompt_mu=None,
         return {k: round(float(np.mean(v)), 4) for k, v in timings.items()}
 
     def serve(max_live):
-        worst = max_live * (MAX_LEN // 32)
+        worst = max_live * (MAX_LEN // block_size)
         need = max(int(np.ceil((max(len(p) for p in prompts)
-                                + max(gens)) / 32)),
+                                + max(gens)) / block_size)),
                    int(worst * pool_frac))
         n_blocks = min(worst, need) + 1
         eng = InferenceEngineV2(
             model, rng=jax.random.PRNGKey(0),
-            config={"block_size": 32, "num_blocks": n_blocks,
+            config={"block_size": block_size, "num_blocks": n_blocks,
                     "max_seqs": max_live, "chunk": chunk,
                     "max_seq_len": MAX_LEN,
                     **({"decode_window": decode_window}
                        if decode_window else {}),
                     **({"max_inflight": max_inflight}
-                       if max_inflight else {})},
+                       if max_inflight is not None else {})},
             topology=MeshTopology({"tensor": 1, "data": 1}))
         device_probe = probe_steps(eng, max_live)
         for k in eng.stats:

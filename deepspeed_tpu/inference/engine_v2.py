@@ -6,17 +6,25 @@ engine_v2.py:30 with ``put`` :107 / ``query`` :158 / ``can_schedule`` :184 /
 ``BlockedKVCache`` ragged/kv_cache.py, blocked-flash ragged attention
 kernels kernels/ragged_ops/).
 
-Architecture (TPU-first):
-- KV lives in ONE pool per model: [L, 2, num_blocks * block_size, KV, D],
-  sharded over ``tensor`` on the KV-head dim. Sequences own block lists
-  (host-side allocator, inference/ragged.py).
-- Each step is one of two cached jitted programs — prefill ([S, chunk]
-  prompt chunks) or decode ([S, 1]) — built by the SplitFuse scheduler
-  (inference/scheduler.py). New KV is scattered into the pool by flat token
-  slot; decode steps ([S, 1]) run the Pallas paged-attention kernel
-  (ops/pallas/paged_attention.py) which DMAs pages straight out of the
-  pool via scalar-prefetched block tables; prefill chunks use the XLA
-  gather formulation of the same math.
+Architecture (TPU-first, round-4 async design):
+- KV lives in ONE block-granular pool per model:
+  [L, 2, KV, num_blocks, block_size, D], sharded over ``tensor`` on the
+  KV-head dim. Sequences own block lists (host-side allocator,
+  inference/ragged.py). The pool is READ-ONLY inside every compiled
+  step: fresh K/V rides a small staged buffer through
+  ``paged_ragged_attention`` (ops/pallas/paged_attention.py — pool pages
+  + stage in one online softmax, all KV heads per grid step) and ONE
+  scatter per program merges it. Interleaving pool writes with the
+  attention custom call makes XLA materialize pool-sized copies — the
+  measured difference is ~280ms vs ~8.5ms per decode token-step.
+- Steps are cached jitted programs — a SplitFuse plan ([S, chunk] prompt
+  chunks with decode rows fused in) or a multi-iteration decode window
+  (early-exiting ``lax.while_loop``) — built by inference/scheduler.py
+  from a SPECULATIVE view of each sequence (dispatched-but-uncommitted).
+- Dispatch never waits: decode chains through a device-resident
+  last-sampled-token array, sampled-token readbacks ride d2h in the
+  background, and host commits lag up to ``max_inflight`` dispatches
+  (the tunnel's ~100ms readback latency never gates throughput).
 - The model is the SAME TransformerLM parameter tree the trainer produces —
   no weight surgery; the ragged forward reads the tree directly.
 """
@@ -800,7 +808,14 @@ class InferenceEngineV2:
         row n ↔ flat pool slot ``flat_slots[n]``) into the block-granular
         pool. Shared by the per-step program (stage = this step's tokens)
         and the window program (stage = the whole window) — the
-        [L, 2, KV, nb, bs, D] indexing convention lives HERE only."""
+        [L, 2, KV, nb, bs, D] indexing convention lives HERE only.
+
+        NB on layout: XLA layout-assigns the pool to a scatter-friendly
+        permutation around this op while the pallas reads need row-major,
+        costing two full-pool layout-permute copies per compiled step. A
+        flat [rows, D] scatter formulation was tried and is WORSE (the
+        2-D scatter wants a column-major operand — bigger permutes);
+        the 6-D advanced-index form below is the measured best."""
         bs = self.config.block_size
         blk, off = flat_slots // bs, flat_slots % bs
         liL = jnp.arange(kv_pool.shape[0])

@@ -297,8 +297,13 @@ def paged_ragged_attention(q, pool, k_stage, v_stage, block_tables,
           .reshape(S, KV, T * G, D))
     TG = T * G
     # query-row tiles bound VMEM for long prefill chunks; stage pages
-    # bound it on the key side (uniform page-sized score tiles)
+    # bound it on the key side (uniform page-sized score tiles). Large
+    # pages widen the f32 score tile [KV, TQB, bs], so shrink TQB to
+    # keep it ~2MB (a 256-token page at TQB=128 overflows the 16MB
+    # scoped-vmem budget)
     TQB = TG if TG <= 128 else 128
+    while TQB > 8 and KV * TQB * bs * 4 > 2 ** 21:
+        TQB //= 2
     while TG % TQB:
         TQB //= 2
     if Ts <= bs:
