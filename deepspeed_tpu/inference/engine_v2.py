@@ -794,13 +794,23 @@ class InferenceEngineV2:
 
         # ---- the ONE pool write of this program -------------------------
         # every layer's fresh K/V lands at its (block, offset) slot;
-        # padded tokens carry trash-block slots (block 0) by construction
+        # padded tokens carry trash-block slots (block 0) by construction.
+        # DUS merges avoid the scatter layout war (see _merge_stage);
+        # ring mode and page-misaligned chunks keep the scatter.
         L = m.num_layers
-        ks = (k_ys[:, :, :, :T, :].transpose(0, 1, 3, 2, 4)
-              .reshape(L, S * T, KV, D))
-        vs = (v_ys[:, :, :, :T, :].transpose(0, 1, 3, 2, 4)
-              .reshape(L, S * T, KV, D))
-        kv_pool = self._merge_stage(kv_pool, slot_map.reshape(-1), ks, vs)
+        if T == 1:
+            kv_pool = self._merge_rows(
+                kv_pool, slot_map[:, 0],
+                k_ys[:, :, :, 0, :], v_ys[:, :, :, 0, :])
+        elif not self._ring_tokens and T % bs == 0:
+            kv_pool = self._merge_pages(kv_pool, slot_map, k_ys, v_ys, T)
+        else:
+            ks = (k_ys[:, :, :, :T, :].transpose(0, 1, 3, 2, 4)
+                  .reshape(L, S * T, KV, D))
+            vs = (v_ys[:, :, :, :T, :].transpose(0, 1, 3, 2, 4)
+                  .reshape(L, S * T, KV, D))
+            kv_pool = self._merge_stage(kv_pool, slot_map.reshape(-1),
+                                        ks, vs)
         return kv_pool, logits
 
     def _merge_stage(self, kv_pool, flat_slots, ks, vs):
@@ -810,12 +820,15 @@ class InferenceEngineV2:
         and the window program (stage = the whole window) — the
         [L, 2, KV, nb, bs, D] indexing convention lives HERE only.
 
-        NB on layout: XLA layout-assigns the pool to a scatter-friendly
-        permutation around this op while the pallas reads need row-major,
-        costing two full-pool layout-permute copies per compiled step. A
-        flat [rows, D] scatter formulation was tried and is WORSE (the
-        2-D scatter wants a column-major operand — bigger permutes);
-        the 6-D advanced-index form below is the measured best."""
+        NB on layout: an XLA scatter layout-assigns the pool to a
+        scatter-friendly permutation while the pallas reads need
+        row-major, costing full-pool layout-permute copies per compiled
+        step (~23ms/window on a 1.6GB pool; a flat [rows, D] scatter is
+        WORSE — column-major preference; layout_constraint pins don't
+        override scatter's mandatory layout). Callers therefore prefer
+        the layout-NEUTRAL dynamic-update-slice merges (``_merge_rows``,
+        ``_merge_pages``) and fall back here only for configurations
+        those can't express."""
         bs = self.config.block_size
         blk, off = flat_slots // bs, flat_slots % bs
         liL = jnp.arange(kv_pool.shape[0])
@@ -824,6 +837,69 @@ class InferenceEngineV2:
         kv_pool = kv_pool.at[liL[:, None], 1, :, blk[None, :],
                              off[None, :]].set(vs.astype(kv_pool.dtype))
         return kv_pool
+
+    def _merge_rows(self, kv_pool, flat_slots, k_rows, v_rows):
+        """Token-granular pool merge: one dynamic-update-slice per row
+        (``k_rows/v_rows`` [L, N, KV, D], row n ↔ flat slot n). DUS is
+        layout-neutral and in-place — no scatter layout war — and row
+        granularity never clobbers neighbouring rows, so it is safe in
+        ring (rolling-buffer) mode too. N is small by construction
+        (decode plans: S; windows: W*S)."""
+        bs = self.config.block_size
+        kv_rows = jnp.stack([k_rows, v_rows], axis=1).astype(kv_pool.dtype)
+        z = jnp.int32(0)
+        for n in range(flat_slots.shape[0]):
+            upd = kv_rows[:, :, n][:, :, :, None, None, :]  # [L,2,KV,1,1,D]
+            kv_pool = jax.lax.dynamic_update_slice(
+                kv_pool, upd,
+                (z, z, z, flat_slots[n] // bs, flat_slots[n] % bs, z))
+        return kv_pool
+
+    def _merge_pages(self, kv_pool, slot_map, k_ys, v_ys, T):
+        """Page-granular pool merge for SplitFuse chunk steps
+        (``k_ys/v_ys`` [L, S, KV, Ts, D], token t of row s ↔
+        ``slot_map[s, t]``). Chunk starts are page-aligned whenever
+        chunk %% block_size == 0, so each page of a prefill row is one
+        whole-page DUS (rows past the chunk's real tokens land in the
+        not-yet-valid region — harmless). Rows carrying a single token
+        (fused decode rows, 1-token final chunks, inactive padding) must
+        NOT page-write (their page holds live earlier rows): for those
+        the page update degrades to a read-back of the current page, and
+        a per-row token DUS writes the one real token."""
+        L, _, KV, nb, bs, D = kv_pool.shape
+        S = slot_map.shape[0]
+        z = jnp.int32(0)
+        n_real = (slot_map >= bs).sum(axis=1)          # trash slots < bs
+        for s in range(S):
+            # page-write only rows that really carry a chunk AND start on
+            # a page boundary (the scheduler advances kv_next in whole
+            # chunks so this holds today; the traced check pins the
+            # invariant rather than assuming it)
+            no_page = (n_real[s] <= 1) | (slot_map[s, 0] % bs != 0)
+            for pg in range(T // bs):
+                sl = pg * bs
+                page = jnp.stack(
+                    [k_ys[:, s, :, sl:sl + bs, :],
+                     v_ys[:, s, :, sl:sl + bs, :]],
+                    axis=1)[:, :, :, None].astype(kv_pool.dtype)
+                blk = slot_map[s, sl] // bs
+                if pg == 0:
+                    # read-modify-write: a single-token/misaligned row's
+                    # first page holds live earlier KV
+                    cur = jax.lax.dynamic_slice(
+                        kv_pool, (z, z, z, blk, z, z), (L, 2, KV, 1, bs, D))
+                    page = jnp.where(no_page, cur, page)
+                else:
+                    # later pages of degraded rows carry trash slots
+                    # (block 0) — writing garbage there is the existing
+                    # trash-block convention, no read-back needed
+                    blk = jnp.where(no_page, 0, blk)
+                kv_pool = jax.lax.dynamic_update_slice(
+                    kv_pool, page, (z, z, z, blk, z, z))
+        # every row's first token (covers degraded rows; for full chunks
+        # this rewrites the value the page already wrote)
+        return self._merge_rows(kv_pool, slot_map[:, 0],
+                                k_ys[:, :, :, 0, :], v_ys[:, :, :, 0, :])
 
     def _program(self, T: int):
         if T not in self._programs:
@@ -935,8 +1011,8 @@ class InferenceEngineV2:
                       .reshape(L, W * S, KV, D))
                 vs = (vbuf[:, :, :, :W, :].transpose(0, 3, 1, 2, 4)
                       .reshape(L, W * S, KV, D))
-                kv_pool = self._merge_stage(kv_pool, slots.reshape(-1),
-                                            ks, vs)
+                kv_pool = self._merge_rows(kv_pool, slots.reshape(-1),
+                                           ks, vs)
                 return kv_pool, tok, buf, i        # toks [W, S], iters run
 
             self._programs[key] = jax.jit(
