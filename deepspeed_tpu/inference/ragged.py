@@ -219,5 +219,8 @@ class StepPlan:
     use_last: np.ndarray = None       # [S] uint8 — col-0 token comes from the
     #                                   device-resident last-sampled array
     #                                   (its host value is still in flight)
-    uids: list[int] = field(default_factory=list)   # uid per slot (-1 = empty)
+    row_slots: np.ndarray = None      # [S] int32 — physical slot per plan row
+    #                                   (packed prefill plans carry fewer rows
+    #                                   than max_seqs; row==slot when full)
+    uids: list[int] = field(default_factory=list)   # uid per row (-1 = empty)
     dispatched: bool = False          # mark_dispatched ran (async pipeline)

@@ -51,8 +51,11 @@ from ..parallel.axes import (  # noqa: E402
 
 def default_activation_rules(topology) -> list[tuple[str, Any]]:
     """Logical→mesh rules installed by the engine around apply()."""
+    from ..parallel.axes import BATCH_NOEXP
+
     return [
         (BATCH, ("data", "expert", "fsdp")),
+        (BATCH_NOEXP, ("data", "fsdp")),
         (SEQ, "seq"),
         (EMBED, None),
         # inside attention: heads sharded over tensor AND seq (Ulysses)

@@ -20,7 +20,8 @@ import flax.linen as nn
 import jax
 import jax.numpy as jnp
 
-from ..parallel.axes import BATCH, EMBED, EXPERT, SEQ, constrain as _constrain
+from ..parallel.axes import (BATCH, BATCH_NOEXP, EMBED, EXPERT, SEQ,
+                             constrain as _constrain)
 from .sharded_moe import GateOutput, topk_dropless_gating, topkgating
 
 
@@ -197,16 +198,21 @@ class MoE(nn.Module):
 
         # dispatch: [B,S,E] tokens → [n, B, cap, E] expert inputs. Under
         # GSPMD this einsum IS the expert all-to-all (_AllToAll :96).
+        # Pin the token operand first: without it, propagation inside a
+        # pipe-stage shard_map invents shardings over size-1 dims that
+        # the partitioner can only reach via full rematerialization
+        # (measured in the pipe x expert dryrun).
+        x = _constrain(x, BATCH, SEQ, EMBED)
         expert_in = jnp.einsum("gsnc,gse->ngce",
                                gate.dispatch.astype(dtype), x)
-        expert_in = _constrain(expert_in, EXPERT, BATCH, None, EMBED)
+        expert_in = _constrain(expert_in, EXPERT, BATCH_NOEXP, None, EMBED)
 
         expert_out = Experts(
             hidden_size=self.hidden_size,
             ffn_size=self.ffn_size or 4 * self.hidden_size,
             num_experts=self.num_experts,
             activation=self.activation, name="experts")(expert_in)
-        expert_out = _constrain(expert_out, EXPERT, BATCH, None, EMBED)
+        expert_out = _constrain(expert_out, EXPERT, BATCH_NOEXP, None, EMBED)
 
         out = jnp.einsum("gsnc,ngce->gse", gate.combine.astype(dtype), expert_out)
         return _constrain(out, BATCH, SEQ, EMBED)

@@ -135,10 +135,10 @@ def _paged_attn_kernel(tables_ref, lens_ref, starts_ref, q_ref, k_ref, v_ref,
 
 
 def _ragged_attn_kernel(tables_ref, lens_ref, qst_ref, sst_ref, layer_ref,
-                        q_ref, kp_ref, vp_ref, ks_ref, vs_ref, o_ref,
-                        m_scr, l_scr, acc_scr, *, block_size: int,
+                        *refs, block_size: int,
                         scale: float, G: int, window: int,
-                        ring_tokens: int, n_stage_pages: int):
+                        ring_tokens: int, n_stage_pages: int,
+                        page_group: int, n_pool: int):
     """Read-only-pool ragged attention, ALL kv heads per grid step.
 
     Round-4 redesign of :func:`_paged_attn_kernel` driven by two measured
@@ -153,20 +153,32 @@ def _ragged_attn_kernel(tables_ref, lens_ref, qst_ref, sst_ref, layer_ref,
        caller.
     2. A (seqs, kv_heads, pages) grid ran ~200k grid steps per decode
        iteration (~40ms of pure grid overhead). The grid is now
-       (seqs, pages+1) with all KV heads batched into one block-DMA and
-       one batched MXU dot per step; the final grid step attends over the
-       staged tokens instead of a pool page.
+       (seqs, page-groups+stage) with all KV heads batched into one
+       block-DMA and one batched MXU dot per step; the final grid steps
+       attend over the staged tokens instead of a pool page.
+    3. (round 5) Even at one-page-per-step the decode window spent ~60%
+       of device time in this kernel at ~94us/call — 136 grid steps of
+       ~0.5us fixed overhead each with one tiny dot. ``page_group`` pool
+       pages now ride ONE grid step through separate block-spec refs
+       (each with its own scalar-prefetched table index), cutting grid
+       steps ~page_group-fold; tail/invalid sub-pages map to the trash
+       block so the pipeline elides their re-fetch.
 
-    Grid (S, mb+1). Per step j<mb: one pool page, all heads. j==mb: the
-    stage. Block tables are padded with the trash block (0), so invalid
-    pages re-DMA the same block and the pipeline skips the fetch.
+    Grid (S, q-tiles, ceil(n_pool/page_group) + n_stage_pages).
+    ``refs`` = (q, k_0..k_{Gp-1}, v_0..v_{Gp-1}, k_stage, v_stage, o,
+    m_scr, l_scr, acc_scr).
     """
     del layer_ref
+    Gp = page_group
+    q_ref = refs[0]
+    kp_refs = refs[1:1 + Gp]
+    vp_refs = refs[1 + Gp:1 + 2 * Gp]
+    ks_ref, vs_ref, o_ref, m_scr, l_scr, acc_scr = refs[1 + 2 * Gp:]
     s = pl.program_id(0)
     tq = pl.program_id(1)          # query-row tile (VMEM-bounds long chunks)
     j = pl.program_id(2)
     nj = pl.num_programs(2)
-    n_pool = nj - n_stage_pages    # pool pages come first, then the stage
+    n_grp = nj - n_stage_pages     # pool page-groups come first, then stage
 
     @pl.when(j == 0)
     def _init():
@@ -177,7 +189,7 @@ def _ragged_attn_kernel(tables_ref, lens_ref, qst_ref, sst_ref, layer_ref,
     seq_len = lens_ref[s]
     qstart = qst_ref[s]
     sstart = sst_ref[s]            # pool holds positions < sstart
-    is_stage = j >= n_pool
+    is_stage = j >= n_grp
     tqb = m_scr.shape[1]           # query rows per tile
 
     def online_update(scores, ctx, valid, v):
@@ -200,39 +212,62 @@ def _ragged_attn_kernel(tables_ref, lens_ref, qst_ref, sst_ref, layer_ref,
             preferred_element_type=jnp.float32)            # [KV, TQB, D]
         m_scr[:] = m_new
 
-    # ---- pool page step --------------------------------------------------
+    # ---- pool page step: page_group sub-pages, ONE online update --------
+    # The serial cost of a grid step is its softmax/update CHAIN, not its
+    # dot (measured r5: per-sub-page chains made grouping a net loss).
+    # The Gp pages therefore concatenate in VMEM into one [KV, Gp*bs, D]
+    # tile and run a single chain ~Gp x wider — vector ops grow by lane
+    # count, chain length stays flat.
     if ring_tokens:
         nwin = ring_tokens // block_size
         b_latest = jnp.maximum(sstart - 1, 0) // block_size
-        b_j = b_latest - (b_latest - j) % nwin
-        page_start = b_j * block_size
-        run_pool = (sstart > 0) & (b_j >= 0) & (~is_stage)
+        run_pool = (sstart > 0) & (~is_stage)
+        first_jj = j * Gp
+        run_pool &= (b_latest - (b_latest - first_jj) % nwin >= 0) \
+            & (first_jj < n_pool)
     else:
-        page_start = j * block_size
-        run_pool = (page_start < sstart) & (~is_stage)
+        group_start = j * Gp * block_size
+        run_pool = (group_start < sstart) & (~is_stage)
         if window:
-            run_pool &= page_start + block_size > qstart - window + 1
+            run_pool &= (group_start + Gp * block_size
+                         > qstart - window + 1)
 
     @pl.when(run_pool)
     def _pool_step():
         q = q_ref[0]                                       # [KV, TQB, D]
-        k = kp_ref[0, 0, :, 0]                             # [KV, bs, D]
-        v = vp_ref[0, 0, :, 0]
+        if Gp == 1:
+            k = kp_refs[0][0, 0, :, 0]                     # [KV, bs, D]
+            v = vp_refs[0][0, 0, :, 0]
+        else:
+            k = jnp.concatenate([r[0, 0, :, 0] for r in kp_refs], axis=1)
+            v = jnp.concatenate([r[0, 0, :, 0] for r in vp_refs], axis=1)
+        if k.dtype != q.dtype:
+            # fp8 KV pool: converting the PAGE up costs ~10us/page in
+            # Mosaic (element-wise + sublane relayout); converting the
+            # tiny q tile DOWN is ~free and the MXU contracts fp8 x fp8
+            # natively (measured at parity with bf16 dots on v5e).
+            # p.astype(v.dtype) in online_update then runs the PV dot in
+            # fp8 too.
+            q = q.astype(k.dtype)
         scores = jax.lax.dot_general(
             q, k, (((2,), (2,)), ((0,), (0,))),
-            preferred_element_type=jnp.float32) * scale    # [KV, TQB, bs]
-        raw = page_start + jax.lax.broadcasted_iota(
-            jnp.int32, scores.shape, 2)
+            preferred_element_type=jnp.float32) * scale  # [KV,TQB,Gp*bs]
+        off = jax.lax.broadcasted_iota(jnp.int32, scores.shape, 2)
         if ring_tokens:
+            nwin = ring_tokens // block_size
+            b_latest = jnp.maximum(sstart - 1, 0) // block_size
+            jj = j * Gp + off // block_size    # per-element pool page idx
+            b_j = b_latest - (b_latest - jj) % nwin
+            raw = b_j * block_size + off % block_size
             ctx = jnp.where(raw < sstart, raw, raw - ring_tokens)
-            valid = ctx >= 0
+            valid = (ctx >= 0) & (b_j >= 0) & (jj < n_pool)
         else:
-            ctx = raw
-            valid = ctx < sstart
+            ctx = j * Gp * block_size + off
+            valid = ctx < sstart               # jj >= n_pool ⇒ ctx >= sstart
         online_update(scores, ctx, valid, v)
 
     # ---- stage steps (this program's fresh tokens, page-sized tiles) -----
-    sp = jnp.maximum(j - n_pool, 0)          # stage page index
+    sp = jnp.maximum(j - n_grp, 0)           # stage page index
     srows = ks_ref.shape[2]                  # rows per stage page
 
     @pl.when(is_stage & (sstart + sp * srows < seq_len))
@@ -260,6 +295,7 @@ def paged_ragged_attention(q, pool, k_stage, v_stage, block_tables,
                            scale: float | None = None,
                            window: int | None = None,
                            ring_tokens: int | None = None,
+                           page_group: int | None = None,
                            interpret: bool | None = None):
     """Ragged attention over a READ-ONLY paged pool plus a staged tail.
 
@@ -314,29 +350,50 @@ def paged_ragged_attention(q, pool, k_stage, v_stage, block_tables,
                              f"block_size {bs} (or <= it)")
         srows, nsp = bs, Ts // bs
     n_pool = max_pages
+    # sub-pages per grid step. Measured on v5e (520-token decode contexts,
+    # 136-step baseline 84us/call): page_group 2 -> 95us, 4 -> 106-117us —
+    # the call is DMA-bound on its valid pages, per-grid-step overhead is
+    # already pipelined away, and the VMEM concat + wider chain only adds
+    # work. Default therefore 1; the grouped path stays for experiments
+    # on geometries where step count dominates (tiny pages, huge tables).
+    page_b = KV * bs * D * 2            # one pool page in VMEM (bf16)
+    score_b = KV * TQB * bs * 4         # f32 score tile per sub-page
+    Gp = page_group if page_group else 1
+    Gp = max(1, min(Gp, n_pool))
+    # budget: 2*Gp pool refs double-buffered + the k/v concat tiles +
+    # the [KV, TQB, Gp*bs] f32 score tile, inside ~16MB scoped VMEM
+    while Gp > 1 and 6 * Gp * page_b + Gp * score_b > 8 * 2 ** 20:
+        Gp //= 2
+    n_grp = -(-n_pool // Gp)
 
-    def tbj(t, s, j):
-        # stage steps (j >= max_pages) still need a legal page index
-        return jnp.where(j < n_pool, t[s, jnp.minimum(j, n_pool - 1)], 0)
+    def tbj(t, s, jj):
+        # tail sub-pages of the last group and stage steps still need a
+        # legal page index — map them to the trash block (0); their
+        # re-fetch is elided when the previous index was already 0
+        return jnp.where(jj < n_pool, t[s, jnp.minimum(jj, n_pool - 1)], 0)
+
+    def pool_spec(half, i):
+        return pl.BlockSpec(
+            (1, 1, KV, 1, bs, D),
+            lambda s, tq, j, t, ln, qs, ss, lr:
+                (lr[0], half, 0, tbj(t, s, j * Gp + i), 0, 0))
+
+    def stage_spec():
+        return pl.BlockSpec(
+            (1, KV, srows, D),
+            lambda s, tq, j, t, ln, qs, ss, lr:
+                (s, 0, jnp.maximum(j - n_grp, 0), 0))
 
     grid_spec = pltpu.PrefetchScalarGridSpec(
         num_scalar_prefetch=5,
-        grid=(S, TG // TQB, n_pool + nsp),
+        grid=(S, TG // TQB, n_grp + nsp),
         in_specs=[
             pl.BlockSpec((1, KV, TQB, D),
                          lambda s, tq, j, t, ln, qs, ss, lr: (s, 0, tq, 0)),
-            pl.BlockSpec((1, 1, KV, 1, bs, D),
-                         lambda s, tq, j, t, ln, qs, ss, lr:
-                             (lr[0], 0, 0, tbj(t, s, j), 0, 0)),
-            pl.BlockSpec((1, 1, KV, 1, bs, D),
-                         lambda s, tq, j, t, ln, qs, ss, lr:
-                             (lr[0], 1, 0, tbj(t, s, j), 0, 0)),
-            pl.BlockSpec((1, KV, srows, D),
-                         lambda s, tq, j, t, ln, qs, ss, lr:
-                             (s, 0, jnp.maximum(j - n_pool, 0), 0)),
-            pl.BlockSpec((1, KV, srows, D),
-                         lambda s, tq, j, t, ln, qs, ss, lr:
-                             (s, 0, jnp.maximum(j - n_pool, 0), 0)),
+            *[pool_spec(0, i) for i in range(Gp)],
+            *[pool_spec(1, i) for i in range(Gp)],
+            stage_spec(),
+            stage_spec(),
         ],
         out_specs=pl.BlockSpec((1, KV, TQB, D),
                                lambda s, tq, j, t, ln, qs, ss, lr:
@@ -351,14 +408,14 @@ def paged_ragged_attention(q, pool, k_stage, v_stage, block_tables,
         functools.partial(_ragged_attn_kernel, block_size=block_size,
                           scale=float(scale), G=G, window=int(window or 0),
                           ring_tokens=int(ring_tokens or 0),
-                          n_stage_pages=nsp),
+                          n_stage_pages=nsp, page_group=Gp, n_pool=n_pool),
         grid_spec=grid_spec,
         out_shape=jax.ShapeDtypeStruct((S, KV, TG, D), q.dtype),
         interpret=interpret,
     )(block_tables.astype(jnp.int32), seq_lens.astype(jnp.int32),
       q_starts.astype(jnp.int32), stage_starts.astype(jnp.int32),
       jnp.asarray(layer_index, jnp.int32).reshape(1),
-      qg, pool, pool, k_stage, v_stage)
+      qg, *([pool] * Gp), *([pool] * Gp), k_stage, v_stage)
     return (out.reshape(S, KV, T, G, D).transpose(0, 2, 1, 3, 4)
             .reshape(S, T, H, D))
 

@@ -154,6 +154,33 @@ def _qmm8_kernel(x_ref, d_ref, s_ref, o_ref, acc, *, G: int, dtype):
         o_ref[:] = acc[:].astype(o_ref.dtype)
 
 
+def _qmm8_kernel_l(li_ref, x_ref, d_ref, s_ref, o_ref, acc, *, G, dtype):
+    """Stacked-layer variant: ``d_ref``/``s_ref`` carry a leading size-1
+    layer block selected by the scalar-prefetched layer index — the weight
+    tile DMAs straight from the [L, ...] stack, so a layer-scanned caller
+    never materializes per-layer weight copies (measured r5: the scan's
+    dynamic-slice of int8 codes cost ~0.57ms per decode iteration)."""
+    del li_ref
+    k, nk = pl.program_id(2), pl.num_programs(2)
+
+    @pl.when(k == 0)
+    def _init():
+        acc[:] = jnp.zeros_like(acc)
+
+    bk = x_ref.shape[1]
+    for g in range(bk // G):
+        w = (d_ref[0, g * G:(g + 1) * G, :].astype(jnp.float32)
+             * s_ref[0, 0, g:g + 1, :]).astype(dtype)      # [G, bn]
+        acc[:] += jax.lax.dot_general(
+            x_ref[:, g * G:(g + 1) * G].astype(dtype), w,
+            (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+
+    @pl.when(k == nk - 1)
+    def _fin():
+        o_ref[:] = acc[:].astype(o_ref.dtype)
+
+
 def _qmm4_kernel(xe_ref, xo_ref, d_ref, s_ref, o_ref, acc, *, G: int, dtype):
     k, nk = pl.program_id(2), pl.num_programs(2)
 
@@ -181,6 +208,36 @@ def _qmm4_kernel(xe_ref, xo_ref, d_ref, s_ref, o_ref, acc, *, G: int, dtype):
         o_ref[:] = acc[:].astype(o_ref.dtype)
 
 
+def _qmm4_kernel_l(li_ref, xe_ref, xo_ref, d_ref, s_ref, o_ref, acc, *,
+                   G: int, dtype):
+    """Stacked-layer int4 variant (see ``_qmm8_kernel_l``)."""
+    del li_ref
+    k, nk = pl.program_id(2), pl.num_programs(2)
+
+    @pl.when(k == 0)
+    def _init():
+        acc[:] = jnp.zeros_like(acc)
+
+    h = G // 2
+    for g in range(xe_ref.shape[1] // h):
+        u = d_ref[0, g * h:(g + 1) * h, :].astype(jnp.int32)
+        s = s_ref[0, 0, g:g + 1, :]
+        lo = (((u & 15) - 8).astype(jnp.float32) * s).astype(dtype)
+        hi = (((u >> 4) - 8).astype(jnp.float32) * s).astype(dtype)
+        acc[:] += jax.lax.dot_general(
+            xe_ref[:, g * h:(g + 1) * h].astype(dtype), lo,
+            (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        acc[:] += jax.lax.dot_general(
+            xo_ref[:, g * h:(g + 1) * h].astype(dtype), hi,
+            (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+
+    @pl.when(k == nk - 1)
+    def _fin():
+        o_ref[:] = acc[:].astype(o_ref.dtype)
+
+
 def _pick(dim: int, want: int) -> int:
     if dim <= want:
         return dim
@@ -191,18 +248,32 @@ def _pick(dim: int, want: int) -> int:
 
 
 def quant_matmul(x: jax.Array, qw: QuantLinear, *,
+                 layer_index: jax.Array | None = None,
                  block_m: int = 256, block_n: int = 512,
                  block_k: int = 512,
                  interpret: bool | None = None) -> jax.Array:
     """x [M, K] @ dequant(qw) [K, N] -> [M, N] in x.dtype, weights
-    dequantized tile-by-tile in VMEM."""
+    dequantized tile-by-tile in VMEM.
+
+    ``layer_index``: when the QuantLinear's arrays carry a leading layer
+    dim ([L, K, N] codes from a ``jnp.stack`` over per-layer weights),
+    selects the layer INSIDE the kernel via scalar prefetch — a
+    layer-scanned caller passes the whole stack plus the loop index and
+    never pays a per-layer dynamic-slice copy of the codes.
+    """
     M, K = x.shape
     Kw, N_logical = qw.shape
-    N = qw.data.shape[1]             # lane-padded columns
+    N = qw.data.shape[-1]            # lane-padded columns
+    stacked = layer_index is not None
     if K != Kw:
         raise ValueError(f"contract mismatch: x {x.shape} w {qw.shape}")
+    if stacked and qw.data.ndim != 3:
+        raise ValueError("layer_index given but codes are not stacked "
+                         f"(data {qw.data.shape})")
     if pltpu is None:
         # no Pallas TPU support in this jax build — XLA dequant fallback
+        if stacked:
+            qw = jax.tree.map(lambda a: a[layer_index], qw)
         return (x @ dequantize_weight(qw).astype(x.dtype))
     if interpret is None:
         interpret = jax.default_backend() != "tpu"
@@ -222,39 +293,55 @@ def quant_matmul(x: jax.Array, qw: QuantLinear, *,
     out_dtype = x.dtype
     # scale rides as [K/bk, bk/G, N] so the block covers the whole middle
     # dim (Mosaic accepts block == array dim; a (1, bn) tile would not be)
-    scale3 = qw.scale.reshape(K // bk, bk // G, N)
-    s_spec = pl.BlockSpec((1, bk // G, bn), lambda m, n, k: (k, 0, n))
+    scale3 = qw.scale.reshape(*qw.scale.shape[:-2], K // bk, bk // G, N)
 
-    if qw.bits in (8, "fp8"):       # the int8 kernel's astype covers fp8
+    int8_like = qw.bits in (8, "fp8")   # the int8 kernel's astype covers fp8
+    if not stacked:
+        s_spec = pl.BlockSpec((1, bk // G, bn), lambda m, n, k: (k, 0, n))
+        x_specs = [pl.BlockSpec((bm, bk), lambda m, n, k: (m, k))] \
+            if int8_like else \
+            [pl.BlockSpec((bm, bk // 2), lambda m, n, k: (m, k))] * 2
+        d_spec = pl.BlockSpec((bk, bn), lambda m, n, k: (k, n)) \
+            if int8_like else \
+            pl.BlockSpec((bk // 2, bn), lambda m, n, k: (k, n))
+        kern = _qmm8_kernel if int8_like else _qmm4_kernel
         out = pl.pallas_call(
-            functools.partial(_qmm8_kernel, G=G, dtype=mm_dtype),
+            functools.partial(kern, G=G, dtype=mm_dtype),
             grid=grid,
-            in_specs=[
-                pl.BlockSpec((bm, bk), lambda m, n, k: (m, k)),
-                pl.BlockSpec((bk, bn), lambda m, n, k: (k, n)),
-                s_spec,
-            ],
+            in_specs=x_specs + [d_spec, s_spec],
             out_specs=pl.BlockSpec((bm, bn), lambda m, n, k: (m, n)),
             scratch_shapes=[pltpu.VMEM((bm, bn), jnp.float32)],
             out_shape=jax.ShapeDtypeStruct((Mp, N), out_dtype),
             interpret=interpret,
-        )(x, qw.data, scale3)
+        )(*((x,) if int8_like else (x[:, 0::2], x[:, 1::2])),
+          qw.data, scale3)
     else:
-        xe, xo = x[:, 0::2], x[:, 1::2]                    # [Mp, K/2]
-        out = pl.pallas_call(
-            functools.partial(_qmm4_kernel, G=G, dtype=mm_dtype),
+        s_spec = pl.BlockSpec((1, 1, bk // G, bn),
+                              lambda m, n, k, li: (li[0], k, 0, n))
+        x_specs = [pl.BlockSpec((bm, bk), lambda m, n, k, li: (m, k))] \
+            if int8_like else \
+            [pl.BlockSpec((bm, bk // 2), lambda m, n, k, li: (m, k))] * 2
+        d_spec = pl.BlockSpec((1, bk, bn),
+                              lambda m, n, k, li: (li[0], k, n)) \
+            if int8_like else \
+            pl.BlockSpec((1, bk // 2, bn),
+                         lambda m, n, k, li: (li[0], k, n))
+        kern = _qmm8_kernel_l if int8_like else _qmm4_kernel_l
+        grid_spec = pltpu.PrefetchScalarGridSpec(
+            num_scalar_prefetch=1,
             grid=grid,
-            in_specs=[
-                pl.BlockSpec((bm, bk // 2), lambda m, n, k: (m, k)),
-                pl.BlockSpec((bm, bk // 2), lambda m, n, k: (m, k)),
-                pl.BlockSpec((bk // 2, bn), lambda m, n, k: (k, n)),
-                s_spec,
-            ],
-            out_specs=pl.BlockSpec((bm, bn), lambda m, n, k: (m, n)),
+            in_specs=x_specs + [d_spec, s_spec],
+            out_specs=pl.BlockSpec((bm, bn), lambda m, n, k, li: (m, n)),
             scratch_shapes=[pltpu.VMEM((bm, bn), jnp.float32)],
+        )
+        out = pl.pallas_call(
+            functools.partial(kern, G=G, dtype=mm_dtype),
+            grid_spec=grid_spec,
             out_shape=jax.ShapeDtypeStruct((Mp, N), out_dtype),
             interpret=interpret,
-        )(xe, xo, qw.data, scale3)
+        )(jnp.asarray(layer_index, jnp.int32).reshape(1),
+          *((x,) if int8_like else (x[:, 0::2], x[:, 1::2])),
+          qw.data, scale3)
     return out[:M, :N_logical]
 
 
@@ -359,23 +446,87 @@ def _qgmm4_kernel(te_ref, xe_ref, xo_ref, d_ref, s_ref, o_ref, acc, *,
         o_ref[:] = acc[:].astype(o_ref.dtype)
 
 
+def _qgmm8_kernel_l(te_ref, li_ref, x_ref, d_ref, s_ref, o_ref, acc, *,
+                    G: int, dtype):
+    """Stacked-layer grouped variant (see ``_qmm8_kernel_l``)."""
+    del li_ref
+    k, nk = pl.program_id(2), pl.num_programs(2)
+
+    @pl.when(k == 0)
+    def _init():
+        acc[:] = jnp.zeros_like(acc)
+
+    bk = x_ref.shape[1]
+    for g in range(bk // G):
+        w = (d_ref[0, 0, g * G:(g + 1) * G, :].astype(jnp.float32)
+             * s_ref[0, 0, 0, g:g + 1, :]).astype(dtype)   # [G, bn]
+        acc[:] += jax.lax.dot_general(
+            x_ref[:, g * G:(g + 1) * G].astype(dtype), w,
+            (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+
+    @pl.when(k == nk - 1)
+    def _fin():
+        o_ref[:] = acc[:].astype(o_ref.dtype)
+
+
+def _qgmm4_kernel_l(te_ref, li_ref, xe_ref, xo_ref, d_ref, s_ref, o_ref,
+                    acc, *, G: int, dtype):
+    """Stacked-layer grouped int4 variant (see ``_qmm4_kernel_l``)."""
+    del li_ref
+    k, nk = pl.program_id(2), pl.num_programs(2)
+
+    @pl.when(k == 0)
+    def _init():
+        acc[:] = jnp.zeros_like(acc)
+
+    h = G // 2
+    for g in range(xe_ref.shape[1] // h):
+        u = d_ref[0, 0, g * h:(g + 1) * h, :].astype(jnp.int32)
+        s = s_ref[0, 0, 0, g:g + 1, :]
+        lo = (((u & 15) - 8).astype(jnp.float32) * s).astype(dtype)
+        hi = (((u >> 4) - 8).astype(jnp.float32) * s).astype(dtype)
+        acc[:] += jax.lax.dot_general(
+            xe_ref[:, g * h:(g + 1) * h].astype(dtype), lo,
+            (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        acc[:] += jax.lax.dot_general(
+            xo_ref[:, g * h:(g + 1) * h].astype(dtype), hi,
+            (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+
+    @pl.when(k == nk - 1)
+    def _fin():
+        o_ref[:] = acc[:].astype(o_ref.dtype)
+
+
 def quant_grouped_matmul(x: jax.Array, qw: QuantGrouped,
-                         tile_expert: jax.Array, *, block_m: int = 128,
+                         tile_expert: jax.Array, *,
+                         layer_index: jax.Array | None = None,
+                         block_m: int = 128,
                          block_n: int = 512, block_k: int = 512,
                          interpret: bool | None = None) -> jax.Array:
     """x [Tp, K] expert-sorted+aligned tokens (Tp % block_m == 0, every
     block_m tile owned by ONE expert, see ``sort_tokens_by_expert``)
     @ dequant(qw[e]) -> [Tp, N]. The tile→expert map rides as a scalar
     prefetch; each weight tile DMAs from its owner's slab and dequantizes
-    in VMEM right before the MXU dot."""
+    in VMEM right before the MXU dot. ``layer_index`` selects a layer of
+    a stacked [L, n, K, N] slab inside the kernel (see
+    :func:`quant_matmul`)."""
     Tp, K = x.shape
     n_exp, Kw, N_logical = qw.shape
-    N = qw.data.shape[2]             # lane-padded
+    N = qw.data.shape[-1]            # lane-padded
+    stacked = layer_index is not None
     if K != Kw:
         raise ValueError(f"contract mismatch: x {x.shape} w {qw.shape}")
     if Tp % block_m:
         raise ValueError(f"tokens {Tp} not a multiple of block_m {block_m}")
+    if stacked and qw.data.ndim != 4:
+        raise ValueError("layer_index given but codes are not stacked "
+                         f"(data {qw.data.shape})")
     if pltpu is None:
+        if stacked:
+            qw = jax.tree.map(lambda a: a[layer_index], qw)
         full = dequantize_grouped(qw).astype(x.dtype)      # [n, K, N]
         te = jnp.repeat(tile_expert, block_m)
         return jnp.einsum("tk,tkn->tn", x, full[te])[:, :N_logical]
@@ -388,47 +539,56 @@ def quant_grouped_matmul(x: jax.Array, qw: QuantGrouped,
     bn = _pick(N, block_n)
     grid = (Tp // block_m, N // bn, K // bk)
     mm_dtype = jnp.float32 if interpret else x.dtype
-    scale4 = qw.scale.reshape(n_exp, K // bk, bk // G, N)
-    s_spec = pl.BlockSpec((1, 1, bk // G, bn),
-                          lambda t, f, k, te: (te[t], k, 0, f))
+    int8_like = qw.bits in (8, "fp8")
+    half = bk if int8_like else bk // 2
+    x_ops = (x,) if int8_like else (x[:, 0::2], x[:, 1::2])
 
-    if qw.bits in (8, "fp8"):
+    if not stacked:
+        scale4 = qw.scale.reshape(n_exp, K // bk, bk // G, N)
+        s_spec = pl.BlockSpec((1, 1, bk // G, bn),
+                              lambda t, f, k, te: (te[t], k, 0, f))
+        x_specs = [pl.BlockSpec((block_m, half),
+                                lambda t, f, k, te: (t, k))] * len(x_ops)
+        d_spec = pl.BlockSpec((1, half, bn),
+                              lambda t, f, k, te: (te[t], k, f))
+        kern = _qgmm8_kernel if int8_like else _qgmm4_kernel
         grid_spec = pltpu.PrefetchScalarGridSpec(
             num_scalar_prefetch=1,
             grid=grid,
-            in_specs=[
-                pl.BlockSpec((block_m, bk), lambda t, f, k, te: (t, k)),
-                pl.BlockSpec((1, bk, bn), lambda t, f, k, te: (te[t], k, f)),
-                s_spec,
-            ],
+            in_specs=x_specs + [d_spec, s_spec],
             out_specs=pl.BlockSpec((block_m, bn), lambda t, f, k, te: (t, f)),
             scratch_shapes=[pltpu.VMEM((block_m, bn), jnp.float32)],
         )
         out = pl.pallas_call(
-            functools.partial(_qgmm8_kernel, G=G, dtype=mm_dtype),
+            functools.partial(kern, G=G, dtype=mm_dtype),
             grid_spec=grid_spec,
             out_shape=jax.ShapeDtypeStruct((Tp, N), x.dtype),
             interpret=interpret,
-        )(tile_expert.astype(jnp.int32), x, qw.data, scale4)
+        )(tile_expert.astype(jnp.int32), *x_ops, qw.data, scale4)
     else:
-        xe, xo = x[:, 0::2], x[:, 1::2]
+        L = qw.data.shape[0]
+        scale5 = qw.scale.reshape(L, n_exp, K // bk, bk // G, N)
+        s_spec = pl.BlockSpec((1, 1, 1, bk // G, bn),
+                              lambda t, f, k, te, li: (li[0], te[t], k, 0, f))
+        x_specs = [pl.BlockSpec((block_m, half),
+                                lambda t, f, k, te, li: (t, k))] * len(x_ops)
+        d_spec = pl.BlockSpec((1, 1, half, bn),
+                              lambda t, f, k, te, li: (li[0], te[t], k, f))
+        kern = _qgmm8_kernel_l if int8_like else _qgmm4_kernel_l
         grid_spec = pltpu.PrefetchScalarGridSpec(
-            num_scalar_prefetch=1,
+            num_scalar_prefetch=2,
             grid=grid,
-            in_specs=[
-                pl.BlockSpec((block_m, bk // 2), lambda t, f, k, te: (t, k)),
-                pl.BlockSpec((block_m, bk // 2), lambda t, f, k, te: (t, k)),
-                pl.BlockSpec((1, bk // 2, bn),
-                             lambda t, f, k, te: (te[t], k, f)),
-                s_spec,
-            ],
-            out_specs=pl.BlockSpec((block_m, bn), lambda t, f, k, te: (t, f)),
+            in_specs=x_specs + [d_spec, s_spec],
+            out_specs=pl.BlockSpec((block_m, bn),
+                                   lambda t, f, k, te, li: (t, f)),
             scratch_shapes=[pltpu.VMEM((block_m, bn), jnp.float32)],
         )
         out = pl.pallas_call(
-            functools.partial(_qgmm4_kernel, G=G, dtype=mm_dtype),
+            functools.partial(kern, G=G, dtype=mm_dtype),
             grid_spec=grid_spec,
             out_shape=jax.ShapeDtypeStruct((Tp, N), x.dtype),
             interpret=interpret,
-        )(tile_expert.astype(jnp.int32), xe, xo, qw.data, scale4)
+        )(tile_expert.astype(jnp.int32),
+          jnp.asarray(layer_index, jnp.int32).reshape(1),
+          *x_ops, qw.data, scale5)
     return out[:, :N_logical]

@@ -197,9 +197,11 @@ def _pattern_period(sigs: Sequence, pp: int) -> int:
         raise ValueError(
             f"heterogeneous stack has pattern period {period}, which does "
             f"not divide the {L // pp} layers per stage — SPMD stages "
-            f"must be identical programs (choose pipe so that "
-            f"(num_layers/pipe) % {period} == 0, or make the stack "
-            f"periodic)")
+            f"must be identical programs. Either choose pipe so that "
+            f"(num_layers/pipe) % {period} == 0, or group the aperiodic "
+            f"layers into ONE repeating composite block "
+            f"(nn.Module applying them in sequence) and pipeline the "
+            f"blocks — see MIGRATION.md 'Aperiodic pipeline stacks'")
     return period
 
 
