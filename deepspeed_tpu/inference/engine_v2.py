@@ -300,12 +300,21 @@ class InferenceEngineV2:
         self._results: dict[int, list[int]] = {}
         # device-resident last sampled token per slot: decode steps read it
         # on device (use_last), so the next dispatch never waits for a host
-        # readback of the previous step's samples
-        self._last_tok = jnp.zeros((cfg.max_seqs,), jnp.int32)
+        # readback of the previous step's samples. COMMITTED with the
+        # replicated sharding program outputs carry: an uncommitted array
+        # keys a different jit cache entry, so every program warmed before
+        # the first real step would silently recompile inside the first
+        # SLA-scored serve (measured: 3-4s per shape).
+        self._last_tok = jax.device_put(
+            jnp.zeros((cfg.max_seqs,), jnp.int32),
+            NamedSharding(topology.mesh, P()))
         # async pipeline: dispatched steps whose sampled tokens are still
         # riding d2h; committed lazily (see _drain)
         from collections import deque
         self._inflight: deque = deque()
+        # mixed-load alternation: True → the next dispatch prefers the
+        # decode window/plan over another prefill step
+        self._serve_toggle = False
         #: wall-time split + counters for the serving artifact (VERDICT r03:
         #: "nothing in the artifact says where the time goes")
         self.stats = {"plan_s": 0.0, "dispatch_s": 0.0, "drain_block_s": 0.0,
@@ -1097,6 +1106,11 @@ class InferenceEngineV2:
                     jnp.where(do_sample.astype(bool), toks, row_last))
                 return kv_pool, last_tok, toks
 
+            # distinct module names per kind: device traces attribute
+            # jit_step_prefill vs jit_step_decode busy time separately
+            # (a T=1 decode plan in "prefill" seconds would corrupt the
+            # trace-derived prefill MFU)
+            step.__name__ = "step_prefill" if T > 1 else "step_decode"
             self._programs[key] = jax.jit(
                 step, donate_argnums=(1, 2),
                 in_shardings=(None, self._pool_format) + (None,) * 11,
@@ -1203,16 +1217,19 @@ class InferenceEngineV2:
         return self._programs[key]
 
     def _try_dispatch_window(self) -> bool:
-        """All-decoding fast path: dispatch up to ``decode_window`` decode
-        steps in ONE program (early-exiting, per-slot budgets) without
-        waiting for any readback. Returns False when the window path does
-        not apply (mixed prefill/decode states go through the SplitFuse
-        plan instead)."""
+        """Decode fast path: dispatch up to ``decode_window`` decode steps
+        in ONE program (early-exiting, per-slot budgets) without waiting
+        for any readback. Runs over the decode-READY subset — slots still
+        prefilling (or empty) ride along inactive (rem=0, masked last-
+        token update), so mixed states window too; the caller alternates
+        windows with pure prefill steps (round-5: fused decode rows cost
+        a full prefill-row budget each)."""
         if self.config.decode_window <= 1:
             return False
         live = [s for s in self.state.seqs.values()
-                if not s.sched_done and s.slot >= 0]
-        if not live or any(s.pending_sched != 1 for s in live):
+                if not s.sched_done and s.slot >= 0
+                and s.pending_sched == 1]
+        if not live:
             return False
         W = min(max(s.gen_remaining_sched for s in live),
                 self.config.decode_window)
@@ -1271,14 +1288,24 @@ class InferenceEngineV2:
 
     def _dispatch_next(self) -> bool:
         """Dispatch the next scheduled step without blocking. Returns True
-        if something was dispatched."""
-        if self._try_dispatch_window():
+        if something was dispatched. Mixed prefill/decode load alternates
+        pure prefill steps with decode windows (or [S,1] decode plans when
+        windowing is off) — each kind runs at full useful occupancy."""
+        live = [s for s in self.state.seqs.values()
+                if not s.sched_done and s.slot >= 0]
+        has_prefill = any(s.pending_sched > 1 for s in live)
+        has_decode = any(s.pending_sched == 1 for s in live)
+        want_decode = has_decode and (not has_prefill or self._serve_toggle)
+        if want_decode and self._try_dispatch_window():
+            self._serve_toggle = False
             return True
         t0 = time.perf_counter()
-        plan = self.scheduler.next_step()
+        plan = self.scheduler.next_step(
+            prefer="decode" if want_decode else None)
         self.stats["plan_s"] += time.perf_counter() - t0
         if plan is None:
             return False
+        self._serve_toggle = plan.kind == "prefill"
         T, bs = plan.token_ids.shape[1], self.config.block_size
         if T > 1 and not self._ring_tokens and T % bs == 0:
             # page-merge invariant (advisor r04): the compiled program

@@ -129,7 +129,7 @@ class SplitFuseScheduler:
                 f"invariants (meta {meta[(rc - 1) * 7:rc * 7]})")
         return True
 
-    def next_step(self) -> StepPlan | None:
+    def next_step(self, prefer: str | None = None) -> StepPlan | None:
         """Build the next step plan, or None if nothing to run.
 
         Plans from the SCHEDULED (speculative) view so the engine can
@@ -137,11 +137,15 @@ class SplitFuseScheduler:
         still in flight carries a placeholder with ``use_last`` set — the
         program substitutes the device-resident last sampled token.
 
-        SplitFuse fusion: a prefill step also carries every decode-ready
-        sequence as a 1-token row, so running decoders are never starved
-        while a long prompt prefills (the reference packs prompt chunks
-        and decode tokens into one ragged batch; here they share one
-        fixed-shape [S, chunk] program)."""
+        Mixed prefill/decode load ALTERNATES pure steps instead of fusing
+        decode rows into prefill plans (round-5 redesign: a fused decode
+        row occupied a full T-token row, holding long-mix prefill
+        occupancy to ~55%; the engine interleaves decode windows/steps so
+        decoders still see a token at least every other dispatch —
+        Dynamic SplitFuse's constant-work goal with PURE steps).
+        ``prefer="decode"`` emits the decode plan when both kinds of work
+        exist (the engine's alternation hint when the multi-iteration
+        window path is unavailable)."""
         st = self.state
         prefill: list[SequenceDescriptor] = []
         decode: list[SequenceDescriptor] = []
@@ -158,21 +162,26 @@ class SplitFuseScheduler:
 
         # blocks were reserved for prompt + max_new_tokens at admit time,
         # so neither branch can exhaust the pool here
-        if prefill:
-            # token-budget packing: rows shrink to the pow2 bucket that
-            # fits the work, each row's chunk grows to keep S*T constant
-            k = min(len(prefill) + len(decode), st.max_seqs)
+        if prefill and not (decode and prefer == "decode"):
+            # token-budget packing: the plan carries exactly the rows that
+            # have work (pow2 buckets round 5-7 rows up to 8 and miss the
+            # pool-throttled steady state entirely — measured 54%
+            # occupancy on the long mix), and each row's chunk grows by
+            # the pow2 budget multiplier. One compiled program per
+            # (rows, chunk) pair, ~4s each, warmed by the bench probe.
+            k = min(len(prefill), st.max_seqs)
             n_rows = st.max_seqs
             T = self.chunk
             if self.pack and k < st.max_seqs:
-                n_rows = 1 << max(0, k - 1).bit_length()   # pow2 >= k
-                if n_rows >= st.max_seqs:
-                    n_rows = st.max_seqs   # non-pow2 max_seqs: full width
-                elif self.chunk % st.block_size == 0:
+                n_rows = max(1, k)
+                if self.chunk % st.block_size == 0:
                     T = self.chunk * (st.max_seqs // n_rows)
-                    # don't pad a row wider than the largest pending prompt
+                    # don't pad a row wider than the largest pending
+                    # prompt; never shrink below the configured chunk
+                    # (non-pow2 budgets would otherwise halve past it
+                    # into shapes no warm pass anticipates)
                     maxpend = max(s.pending_sched for s in prefill)
-                    while T > self.chunk and T // 2 >= maxpend:
+                    while T // 2 >= maxpend and T // 2 >= self.chunk:
                         T //= 2
                 # chunk % block_size != 0 packs ROWS only: growing T could
                 # make a later chunk hit the page-merge program with a
@@ -185,18 +194,7 @@ class SplitFuseScheduler:
                 # sample only when this chunk consumes the last pending token
                 finishes = n == seq.pending_sched
                 entries.append((seq, toks, seq.kv_next, finishes))
-            taken = {seq.slot for seq, *_ in entries}
-            use_last = []
-            for seq in decode:           # fuse running decoders in
-                if len(entries) >= n_rows:
-                    break
-                if seq.slot in taken:
-                    continue
-                entries.append(decode_entry(seq))
-                if seq.n_inflight:
-                    use_last.append(seq.slot)
-            return self._desc("prefill", T, entries, use_last,
-                              n_rows=n_rows)
+            return self._desc("prefill", T, entries, (), n_rows=n_rows)
 
         if decode:
             entries = [decode_entry(seq) for seq in decode[:st.max_seqs]]

@@ -655,24 +655,24 @@ def test_scheduler_token_budget_packing():
     sched.commit(p1, {1: 42})
     assert st.seqs[1].tokens[-1] == 42
 
-    # two pending: 2 rows x 16; the chunk shrinks toward the largest
-    # pending prompt so rows aren't padded wider than the work
+    # mixed load: prefill plans stay PURE (no fused decode rows — a fused
+    # row costs a whole T-wide row of padding); decode work comes out as
+    # its own plan when the engine's alternation asks for it
     st.admit(2, list(range(9)), max_new_tokens=2)
     p2 = sched.next_step()
-    assert p2.token_ids.shape == (2, 16)
-    # row 0 = seq 2's 9 prompt tokens; row 1 = seq 1's decode ride-along
-    rows = {p2.uids[r]: int(p2.active[r].sum()) for r in range(2)}
-    assert rows == {2: 9, 1: 1}
-    # distinct physical slots, decode row mapped correctly
-    assert sorted(p2.row_slots.tolist()) == sorted(
-        [st.seqs[1].slot, st.seqs[2].slot])
+    assert p2.kind == "prefill" and p2.token_ids.shape == (1, 16)
+    assert p2.uids[0] == 2 and int(p2.active.sum()) == 9
+    p2d = sched.next_step(prefer="decode")
+    assert p2d.kind == "decode" and p2d.token_ids.shape == (4, 1)
+    assert p2d.uids[st.seqs[1].slot] == 1
 
-    # full house: identical to the unpacked shape
+    # two prompts pending: exact-k rows with the budget split across them
     st.admit(3, list(range(20)), max_new_tokens=1)
     st.admit(4, list(range(20)), max_new_tokens=1)
     sched.commit(p2, {2: 7})
     p3 = sched.next_step()
-    assert p3.token_ids.shape == (4, 8)
+    assert p3.kind == "prefill" and p3.token_ids.shape == (2, 16)
+    assert sorted(u for u in p3.uids if u > 0) == [3, 4]
 
 
 def test_v2_prefill_pack_generates_same_tokens():
