@@ -82,9 +82,11 @@ def probe_link() -> dict:
 
 def _trace_module_split(log_dir: str) -> dict | None:
     """MEASURED device time per program family from an xplane trace:
-    ``jit_step`` = prefill/decode step plans, ``jit_run`` = decode
-    windows. Returns None when the profiler protos are unavailable or no
-    TPU plane was captured (CPU hosts)."""
+    ``jit_step_prefill`` = prefill plans (the prefill-MFU denominator);
+    ``jit_run`` (decode windows) and ``jit_step_decode`` ([S,1] decode
+    plans) both count as decode/window time. Returns None when the
+    profiler protos are unavailable or no TPU plane was captured (CPU
+    hosts)."""
     try:
         import glob
         import re
@@ -208,39 +210,28 @@ def fastgen_main(emit: bool = True, *, n_req=None, prompt_mu=None,
         # direct call with zero plans is harmless: slot_map 0 writes the
         # trash block, do_sample 0 leaves last_tok untouched.
         if eng.scheduler.pack:
-            S_max = eng.config.max_seqs
             mb = eng.state.max_blocks_per_seq
-            # chunks only GROW when page-aligned (scheduler invariant)
-            grow = chunk % eng.config.block_size == 0
-            S_act = S_max - 1
-            while S_act >= 1:
-                # the scheduler emits T = chunk*(S_max//S_act) halved
-                # toward chunk — enumerate THAT set (pow2 doubling from
-                # chunk misses non-pow2 budget multipliers)
-                menu = {chunk}
-                Tp = chunk * (S_max // S_act) if grow else chunk
-                while Tp >= chunk:
-                    menu.add(Tp)
-                    Tp //= 2
-                for Tp in sorted(menu):
-                    if (Tp, S_act) not in eng._programs:
-                        fn = eng._program(Tp, S_act)
-                        # args must be NUMPY like real plans: jit caches
-                        # committed device args as a SEPARATE entry, so a
-                        # device-array warm leaves the real dispatch path
-                        # cold (measured: a 4.5s recompile inside the
-                        # first SLA-scored serve)
-                        z = lambda *s: np.zeros(s, np.int32)
-                        import jax.random as jrnd
-                        eng._rng, sub = jrnd.split(eng._rng)
-                        eng.kv_pool, eng._last_tok, _ = fn(
-                            eng.params, eng.kv_pool, eng._last_tok,
-                            z(S_act, Tp), z(S_act, Tp), z(S_act, Tp),
-                            z(S_act, mb), z(S_act), z(S_act),
-                            np.zeros(S_act, np.uint8),
-                            np.zeros(S_act, np.uint8),
-                            np.arange(S_act, dtype=np.int32), sub)
-                S_act -= 1
+            # THE shape menu comes from the scheduler itself (a hand-kept
+            # copy here drifted once: a 4.5s recompile inside the first
+            # SLA-scored serve)
+            for Tp, S_act in eng.scheduler.program_shape_menu():
+                if (Tp, S_act) not in eng._programs:
+                    fn = eng._program(Tp, S_act)
+                    # args must be NUMPY like real plans: jit caches
+                    # committed device args as a SEPARATE entry, so a
+                    # device-array warm leaves the real dispatch path
+                    # cold (measured: a 4.5s recompile inside the
+                    # first SLA-scored serve)
+                    z = lambda *s: np.zeros(s, np.int32)
+                    import jax.random as jrnd
+                    eng._rng, sub = jrnd.split(eng._rng)
+                    eng.kv_pool, eng._last_tok, _ = fn(
+                        eng.params, eng.kv_pool, eng._last_tok,
+                        z(S_act, Tp), z(S_act, Tp), z(S_act, Tp),
+                        z(S_act, mb), z(S_act), z(S_act),
+                        np.zeros(S_act, np.uint8),
+                        np.zeros(S_act, np.uint8),
+                        np.arange(S_act, dtype=np.int32), sub)
             jax.block_until_ready(eng.kv_pool)
         # the engine pow2-floors the dispatched window, so gate and label
         # with the size that actually runs

@@ -942,7 +942,12 @@ class InferenceEngineV2:
                 # matmul stays on the XLA path. int4 keeps the Pallas
                 # kernel (XLA can't fuse the nibble unpack).
                 qw = params["logits_q"]
-                if qw.bits in (8, "fp8"):
+                if qw.bits in (8, "fp8") and self.topology.mesh.size == 1:
+                    # NB single-device only: a TP-quantized QuantLinear's
+                    # aux .shape is PER-SHARD logical (built inside the
+                    # quantize shard_map), so slicing the GLOBAL matmul
+                    # with it truncates the vocab — multi-device meshes
+                    # go through _qmm's per-shard kernel path instead
                     K = qw.shape[0]
                     G = qw.group_size
                     wd = (qw.data.astype(cfg.dtype)

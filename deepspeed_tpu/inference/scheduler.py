@@ -129,6 +129,36 @@ class SplitFuseScheduler:
                 f"invariants (meta {meta[(rc - 1) * 7:rc * 7]})")
         return True
 
+    def program_shape_menu(self) -> list[tuple[int, int]]:
+        """Every (T, n_rows) prefill-plan shape :meth:`next_step` can emit
+        under the current packing config — THE warm list for anything that
+        must never compile mid-serve (the bench probe pre-compiles these;
+        a hand-kept copy drifted once and cost a 4.5s recompile inside an
+        SLA-scored run). Mirrors the packing math below by construction."""
+        S_max = self.state.max_seqs
+        shapes = {(self.chunk, S_max)}
+        if not self.pack:
+            return sorted(shapes)
+        for n_rows in range(1, S_max):
+            for T in self._chunk_chain(n_rows):
+                shapes.add((T, n_rows))
+        return sorted(shapes)
+
+    def _chunk_chain(self, n_rows: int) -> list[int]:
+        """The T values a packed ``n_rows``-row prefill plan may carry:
+        the budget chunk halved toward the configured chunk, stopping
+        before any value that is not page-aligned (a non-multiple of
+        block_size would advance kv_next off a page boundary and a later
+        page-merge program would fail the alignment invariant)."""
+        bs = self.state.block_size
+        out = [self.chunk]
+        if self.chunk % bs == 0:
+            T = self.chunk * (self.state.max_seqs // n_rows)
+            while T >= self.chunk and T % bs == 0:
+                out.append(T)
+                T //= 2
+        return out
+
     def next_step(self, prefer: str | None = None) -> StepPlan | None:
         """Build the next step plan, or None if nothing to run.
 
@@ -174,15 +204,13 @@ class SplitFuseScheduler:
             T = self.chunk
             if self.pack and k < st.max_seqs:
                 n_rows = max(1, k)
-                if self.chunk % st.block_size == 0:
-                    T = self.chunk * (st.max_seqs // n_rows)
+                chain = self._chunk_chain(n_rows)
+                if len(chain) > 1:
                     # don't pad a row wider than the largest pending
-                    # prompt; never shrink below the configured chunk
-                    # (non-pow2 budgets would otherwise halve past it
-                    # into shapes no warm pass anticipates)
+                    # prompt; stay on the chain (page-aligned, >= chunk)
                     maxpend = max(s.pending_sched for s in prefill)
-                    while T // 2 >= maxpend and T // 2 >= self.chunk:
-                        T //= 2
+                    T = next((t for t in sorted(chain)
+                              if t >= maxpend), max(chain))
                 # chunk % block_size != 0 packs ROWS only: growing T could
                 # make a later chunk hit the page-merge program with a
                 # page-misaligned start (kv_next advanced by non-page

@@ -696,3 +696,43 @@ def test_v2_prefill_pack_generates_same_tokens():
     got_p = ep.generate(prompts, max_new_tokens=5)
     got_u = eu.generate(prompts, max_new_tokens=5)
     assert got_p == got_u
+
+
+def test_program_shape_menu_covers_scheduler_emissions():
+    """The scheduler's program_shape_menu is THE warm list: every prefill
+    plan shape emitted under randomized admission/commit churn must be in
+    it (a hand-kept copy in the bench drifted once and cost a 4.5s
+    recompile inside an SLA-scored serve). Non-pow2 max_seqs + small
+    pages exercise the page-aligned halving-chain edge."""
+    rng = np.random.default_rng(0)
+    st = StateManager(num_blocks=256, block_size=4, max_seqs=5,
+                      max_blocks_per_seq=16)
+    sched = SplitFuseScheduler(st, chunk=8, pack=True)
+    menu = set(sched.program_shape_menu())
+    uid = 0
+    for _ in range(300):
+        while st.can_admit(30, 4) and rng.random() < 0.6:
+            uid += 1
+            st.admit(uid, list(map(int, rng.integers(
+                0, 50, int(rng.integers(1, 30))))), int(rng.integers(1, 4)))
+        plan = sched.next_step(
+            prefer="decode" if rng.random() < 0.5 else None)
+        if plan is None:
+            for u in [u for u, s in st.seqs.items()]:
+                st.release(u)
+            continue
+        if plan.kind == "prefill":
+            T, S = plan.token_ids.shape[1], plan.token_ids.shape[0]
+            assert (T, S) in menu, ((T, S), sorted(menu))
+            # page-merge alignment invariant: multi-token rows start
+            # page-aligned whenever the program would whole-page-write
+            if T % st.block_size == 0:
+                n_real = (plan.slot_map >= st.block_size).sum(axis=1)
+                bad = (n_real > 1) & (plan.slot_map[:, 0]
+                                      % st.block_size != 0)
+                assert not bad.any()
+        sampled = {u: 7 for s_i, u in enumerate(plan.uids)
+                   if u >= 0 and plan.do_sample[s_i]}
+        sched.commit(plan, sampled)
+        for u in [u for u, s in st.seqs.items() if s.done]:
+            st.release(u)
