@@ -736,3 +736,28 @@ def test_program_shape_menu_covers_scheduler_emissions():
         sched.commit(plan, sampled)
         for u in [u for u, s in st.seqs.items() if s.done]:
             st.release(u)
+
+
+def test_v2_fp8_kv_with_rolling_window_ring():
+    """fp8 KV pool composes with the mistral rolling-window ring: packing
+    is auto-disabled in ring mode, the ring reuses pages past the window,
+    and generation completes with fp8 pages round-tripping through the
+    wrap."""
+    from deepspeed_tpu.parallel.topology import MeshTopology
+
+    model = build_model("tiny-gpt2", hidden_size=256, num_heads=4,
+                        sliding_window=24)
+    eng = InferenceEngineV2(
+        model, config={"block_size": 8, "num_blocks": 64, "max_seqs": 2,
+                       "chunk": 8, "max_seq_len": 128,
+                       "kv_cache_dtype": "fp8"},
+        rng=jax.random.PRNGKey(3), topology=MeshTopology({"tensor": 1,
+                                                          "data": 1}))
+    assert eng._ring_tokens > 0          # rolling buffer active
+    assert not eng.scheduler.pack        # packing off in ring mode
+    assert eng.kv_pool.dtype == jnp.float8_e4m3fn
+    prompt = list(range(40))             # > window: the ring must wrap
+    eng.put(1, prompt, max_new_tokens=6)
+    while not eng.query(1).get("done", False):
+        eng.step()
+    assert len(eng.flush(1)) == 6
