@@ -53,6 +53,57 @@ class BenchInvalid(RuntimeError):
     """A measurement failed its physicality/replay gate."""
 
 
+def _bring_up_backend(max_attempts: int | None = None,
+                      timeout_s: float | None = None) -> None:
+    """Initialize the jax backend under a watchdog, retrying a bounded
+    number of times. The first ``jax.devices()`` on a tunneled PJRT can
+    HANG (not error) when the tunnel is down — round 5 lost BOTH driver
+    artifacts to exactly that. Each attempt runs in a daemon thread with
+    a deadline; after the attempts are spent the bench emits ONE
+    structured JSON line on stdout (the artifact contract: always a
+    parseable line, never a bare traceback or a hang) and exits 1.
+
+    NB a hung attempt's thread keeps holding jax's backend-init lock, so
+    later attempts only help for transient ERRORS (Unavailable etc.); a
+    true hang burns all attempts on the same lock and falls through to
+    the JSON error — which is the required behavior either way."""
+    import threading
+
+    max_attempts = max_attempts or int(
+        os.environ.get("BENCH_BACKEND_ATTEMPTS", "3"))
+    timeout_s = timeout_s or float(
+        os.environ.get("BENCH_BACKEND_TIMEOUT_S", "120"))
+    last_err = None
+    for attempt in range(1, max_attempts + 1):
+        box: dict = {}
+
+        def probe():
+            try:
+                box["devices"] = [str(d) for d in jax.devices()]
+            except Exception as e:  # noqa: BLE001
+                box["error"] = f"{type(e).__name__}: {e}"[:300]
+
+        t = threading.Thread(target=probe, daemon=True)
+        t.start()
+        t.join(timeout_s)
+        if "devices" in box:
+            return
+        last_err = box.get(
+            "error", f"backend init still hung after {timeout_s:.0f}s")
+        print(f"# backend bring-up {attempt}/{max_attempts} failed: "
+              f"{last_err}", file=sys.stderr, flush=True)
+        if attempt < max_attempts:
+            time.sleep(10)
+    print(json.dumps({
+        "metric": "bench aborted: jax backend unavailable",
+        "value": 0.0,
+        "unit": "",
+        "vs_baseline": 0.0,
+        "error": f"backend bring-up failed {max_attempts}x: {last_err}",
+    }), flush=True)
+    sys.exit(1)
+
+
 def _peak_tflops() -> float | None:
     kind = str(jax.devices()[0].device_kind)
     return next((v for k, v in PEAK_BF16_TFLOPS.items() if k in kind), None)
@@ -156,6 +207,14 @@ def fastgen_main(emit: bool = True, *, n_req=None, prompt_mu=None,
 
     model_name = os.environ.get("BENCH_MODEL", "gpt2-350m")
     n_req = n_req or int(os.environ.get("BENCH_REQUESTS", "24"))
+    if sweep:
+        # client-sweep runs need enough requests per point that steady-
+        # state pool pressure, fragmentation, and the p95 TBT tail are
+        # actually exercised — the reference FastGen methodology runs 512
+        # requests per client count (blogs/deepspeed-fastgen README);
+        # a dozen requests measures warmup, not the plateau.
+        n_req = max(n_req, int(os.environ.get("BENCH_SWEEP_REQUESTS",
+                                              "128")))
     prompt_mu = prompt_mu or int(os.environ.get("BENCH_PROMPT", "256"))
     gen_mu = gen_mu or int(os.environ.get("BENCH_GEN", "64"))
     max_seqs = max_seqs or int(os.environ.get("BENCH_MAX_SEQS", "8"))
@@ -282,6 +341,11 @@ def fastgen_main(emit: bool = True, *, n_req=None, prompt_mu=None,
                 eng.flush(uid)
             if pass_n == 1:
                 timings = rec
+        # -- warm every remaining pow2 window size the serve can
+        # dispatch: mixed load caps windows at decode_window_mixed_cap,
+        # so capped sizes (2, 4, ...) appear exactly when prefill and
+        # decode overlap — mid-SLA-serve, where a compile costs seconds
+        eng.warm_decode_windows()
         return {k: round(float(np.mean(v)), 4) for k, v in timings.items()}
 
     def build_engine(max_live):
@@ -426,7 +490,8 @@ def fastgen_main(emit: bool = True, *, n_req=None, prompt_mu=None,
                 k: st[k] for k in
                 ("dispatches", "prefill_steps", "decode_steps", "windows",
                  "window_iters", "window_iters_max", "forced_drains",
-                 "opportunistic_drains", "d2h_latency_s", "prefill_slots",
+                 "opportunistic_drains", "d2h_latency_s",
+                 "prefill_budget_tokens",
                  "prefill_tokens", "decode_tokens")},
             "device_probe": device_probe,
         }
@@ -495,9 +560,9 @@ def fastgen_main(emit: bool = True, *, n_req=None, prompt_mu=None,
     # token SLOTS those steps paid for (padding is not useful work —
     # VERDICT r04 weak #2).
     cnt = (trace_res or res)["counters"]
-    if cnt["prefill_slots"]:
+    if cnt["prefill_budget_tokens"]:
         out["prefill_occupancy"] = round(
-            cnt["prefill_tokens"] / cnt["prefill_slots"], 3)
+            cnt["prefill_tokens"] / cnt["prefill_budget_tokens"], 3)
     if peak and device_split and device_split.get("prefill_busy_s"):
         out["device_split"] = device_split
         out["prefill_mfu"] = round(
@@ -700,6 +765,10 @@ def _measure_with_engine(engine, model, seq_len, steps, warmup, model_name,
 
 
 def main():
+    # the FIRST device touch, under a bounded watchdog: a downed PJRT
+    # tunnel must produce a structured JSON error line, never a hang
+    # (round 5 lost both driver artifacts to exactly that)
+    _bring_up_backend()
     if os.environ.get("BENCH_MODE") == "fastgen":
         return fastgen_main(with_sequential=True, sla=True)
     if os.environ.get("BENCH_MODE") == "fastgen_sweep":

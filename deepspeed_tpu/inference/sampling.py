@@ -2,7 +2,13 @@
 
 Role of the reference's sampling glue in inference (the HF-generate
 integration in inference/engine.py:616 and FastGen's logits handling):
-pure functions over logits, traceable inside the decode loop.
+pure functions over logits, traceable inside the decode loop. These run
+INSIDE the jitted decode programs — for a decode window every iteration
+pays this cost on device, so the filters are written for the decode
+roofline: ``top_k`` uses ``lax.top_k`` (O(V·log k) partial selection)
+instead of a full O(V·log V) sort, and when ``top_k`` and ``top_p`` are
+both active they share ONE descending sort instead of sorting the vocab
+twice.
 """
 from __future__ import annotations
 
@@ -16,11 +22,21 @@ def sample_logits(logits: jax.Array, rng: jax.Array, *, temperature: float = 1.0
     if greedy or temperature == 0.0:
         return jnp.argmax(logits, axis=-1)
     logits = logits.astype(jnp.float32) / max(temperature, 1e-6)
-    if top_k and top_k > 0:
-        kth = jnp.sort(logits, axis=-1)[..., -top_k][..., None]
+    use_k = bool(top_k and top_k > 0)
+    use_p = top_p < 1.0
+    if use_k and not use_p:
+        # partial selection only — the k-th value is the keep threshold
+        kth = jax.lax.top_k(logits, top_k)[0][..., -1:]
         logits = jnp.where(logits < kth, -jnp.inf, logits)
-    if top_p < 1.0:
+    elif use_p:
+        # one descending sort serves both filters
         sorted_logits = jnp.sort(logits, axis=-1)[..., ::-1]
+        if use_k:
+            kth = sorted_logits[..., top_k - 1:top_k]
+            logits = jnp.where(logits < kth, -jnp.inf, logits)
+            sorted_logits = jnp.where(
+                jnp.arange(sorted_logits.shape[-1]) < top_k,
+                sorted_logits, -jnp.inf)
         probs = jax.nn.softmax(sorted_logits, axis=-1)
         cum = jnp.cumsum(probs, axis=-1)
         # smallest set whose cumulative prob >= top_p; keep at least 1

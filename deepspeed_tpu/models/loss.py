@@ -317,8 +317,16 @@ def lm_loss_fn(model, params, batch, deterministic: bool = True):
     input_ids = batch["input_ids"]
     labels = batch.get("labels")
     if labels is None:
-        labels = jnp.concatenate(
-            [input_ids[:, 1:], jnp.full_like(input_ids[:, :1], IGNORE_INDEX)], axis=1)
+        # next-token shift as roll+where, NOT slice+concat: with the seq
+        # dim sharded over the 'seq' axis (Ulysses), some XLA versions
+        # miscompile concatenate(x[:, 1:], fill) on the sharded dim (the
+        # halo exchange drops the fill column — observed on jaxlib
+        # 0.4.36 CPU: the ignore mask silently covered zero positions and
+        # the loss went NaN). roll lowers to a collective-permute, which
+        # is correct on every version in range.
+        S = input_ids.shape[1]
+        labels = jnp.where(jnp.arange(S)[None, :] < S - 1,
+                           jnp.roll(input_ids, -1, axis=1), IGNORE_INDEX)
     kwargs = {"deterministic": deterministic} | _train_mode_kwargs(batch)
     env = os.environ.get("DS_TPU_FUSED_HEAD_CHUNK")
     vchunk = int(env) if env else 0

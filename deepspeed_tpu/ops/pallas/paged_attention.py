@@ -138,7 +138,8 @@ def _ragged_attn_kernel(tables_ref, lens_ref, qst_ref, sst_ref, layer_ref,
                         *refs, block_size: int,
                         scale: float, G: int, window: int,
                         ring_tokens: int, n_stage_pages: int,
-                        page_group: int, n_pool: int):
+                        page_group: int, n_pool: int,
+                        p_scale: float = 1.0):
     """Read-only-pool ragged attention, ALL kv heads per grid step.
 
     Round-4 redesign of :func:`_paged_attn_kernel` driven by two measured
@@ -194,7 +195,17 @@ def _ragged_attn_kernel(tables_ref, lens_ref, qst_ref, sst_ref, layer_ref,
 
     def online_update(scores, ctx, valid, v):
         """Shared online-softmax step. scores [KV, TQB, W]; ctx [KV,TQB,W]
-        absolute key positions; valid bool; v [KV, W, D]."""
+        absolute key positions; valid bool; v [KV, W, D].
+
+        ``p_scale`` != 1 when the pool is fp8: attention weights ~1/n fall
+        below e4m3's subnormal granularity (~2^-9) past a few hundred
+        context tokens, so the raw p cast would quantize long-context tails
+        to zero/coarse steps. Scaling p up to e4m3's full normal range
+        (max weight 1.0 → 448) before the cast and accumulating l at the
+        SAME scale keeps the final acc/l division exact while every fp8
+        code stays normal out to ~200k-token contexts. Constant across all
+        grid steps of a program (pool and stage alike) so the online
+        alpha-rescaling algebra is unchanged."""
         qpos = qstart + (tq * tqb + jax.lax.broadcasted_iota(
             jnp.int32, scores.shape, 1)) // G
         mask = valid & (ctx <= qpos)
@@ -206,6 +217,8 @@ def _ragged_attn_kernel(tables_ref, lens_ref, qst_ref, sst_ref, layer_ref,
                             jnp.max(scores, axis=-1, keepdims=True))
         alpha = jnp.exp(m_prev - m_new)
         p = jnp.exp(scores - m_new)
+        if p_scale != 1.0:
+            p = p * p_scale
         l_scr[:] = alpha * l_scr[:] + jnp.sum(p, axis=-1, keepdims=True)
         acc_scr[:] = acc_scr[:] * alpha + jax.lax.dot_general(
             p.astype(v.dtype), v, (((2,), (1,)), ((0,), (0,))),
@@ -247,7 +260,13 @@ def _ragged_attn_kernel(tables_ref, lens_ref, qst_ref, sst_ref, layer_ref,
             # tiny q tile DOWN is ~free and the MXU contracts fp8 x fp8
             # natively (measured at parity with bf16 dots on v5e).
             # p.astype(v.dtype) in online_update then runs the PV dot in
-            # fp8 too.
+            # fp8 too — with p pre-scaled into e4m3's normal range
+            # (p_scale, see online_update) so long-context weights don't
+            # land subnormal. Accuracy is gated by the long-context parity
+            # test (tests/test_inference_v2.py::
+            # test_v2_fp8_kv_long_context_logits_parity) — if that ever
+            # regresses, fall back to v.astype(q.dtype) here (bf16 PV dot,
+            # pays the page upconvert).
             q = q.astype(k.dtype)
         scores = jax.lax.dot_general(
             q, k, (((2,), (2,)), ((0,), (0,))),
@@ -404,11 +423,16 @@ def paged_ragged_attention(q, pool, k_stage, v_stage, block_tables,
             pltpu.VMEM((KV, TQB, D), jnp.float32),
         ],
     )
+    # fp8 pools scale p into e4m3's normal range (the e4m3 max, 448) so
+    # long-context attention weights survive the fp8 PV-dot cast; the
+    # matching l accumulation cancels the scale exactly at finalize
+    p_scale = 448.0 if pool.dtype == jnp.float8_e4m3fn else 1.0
     out = pl.pallas_call(
         functools.partial(_ragged_attn_kernel, block_size=block_size,
                           scale=float(scale), G=G, window=int(window or 0),
                           ring_tokens=int(ring_tokens or 0),
-                          n_stage_pages=nsp, page_group=Gp, n_pool=n_pool),
+                          n_stage_pages=nsp, page_group=Gp, n_pool=n_pool,
+                          p_scale=p_scale),
         grid_spec=grid_spec,
         out_shape=jax.ShapeDtypeStruct((S, KV, TG, D), q.dtype),
         interpret=interpret,

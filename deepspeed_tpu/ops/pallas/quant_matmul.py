@@ -238,6 +238,35 @@ def _qmm4_kernel_l(li_ref, xe_ref, xo_ref, d_ref, s_ref, o_ref, acc, *,
         o_ref[:] = acc[:].astype(o_ref.dtype)
 
 
+#: row threshold below which int8/fp8 matmuls route through XLA's fused
+#: dequant-dot instead of the Pallas tile kernel. At decode-sized M the
+#: tile kernel is VPU-bound: every grid step dequantizes a full
+#: [block_k, block_n] weight tile element-wise before a tiny MXU dot, so
+#: the whole [K, N] weight pays VPU convert+multiply per call. XLA folds
+#: the convert+multiply into the dot's operand READ (runs at HBM speed) —
+#: measured on v5e, gpt2-350m logits [8,1024]@[1024,50257] int8: 122us
+#: XLA fused vs 271us Pallas vs 138us bf16. Large M amortizes the tile
+#: dequant over many rows and the Pallas kernel wins again (prefill).
+#: int4 always keeps the kernel: XLA cannot fuse the nibble unpack.
+SMALL_M_XLA = 16
+
+
+def _xla_dequant_dot(x: jax.Array, qw, layer_index) -> jax.Array:
+    """x @ dequant(codes) with the dequant left for XLA to fold into the
+    dot's operand read — the decode-time (small-M) int8/fp8 path. The
+    dequant algebra matches the kernel exactly: f32 codes x f32 group
+    scales, cast to the compute dtype, then the dot."""
+    data, scale = qw.data, qw.scale
+    if layer_index is not None:
+        data = data[layer_index]
+        scale = scale[layer_index]
+    K, N_logical = qw.shape
+    G = qw.group_size
+    w = (data.astype(jnp.float32).reshape(K // G, G, -1)
+         * scale[:, None, :]).reshape(K, -1).astype(x.dtype)
+    return (x @ w)[:, :N_logical]
+
+
 def _pick(dim: int, want: int) -> int:
     if dim <= want:
         return dim
@@ -251,6 +280,7 @@ def quant_matmul(x: jax.Array, qw: QuantLinear, *,
                  layer_index: jax.Array | None = None,
                  block_m: int = 256, block_n: int = 512,
                  block_k: int = 512,
+                 small_m_xla: bool | None = None,
                  interpret: bool | None = None) -> jax.Array:
     """x [M, K] @ dequant(qw) [K, N] -> [M, N] in x.dtype, weights
     dequantized tile-by-tile in VMEM.
@@ -260,6 +290,11 @@ def quant_matmul(x: jax.Array, qw: QuantLinear, *,
     selects the layer INSIDE the kernel via scalar prefetch — a
     layer-scanned caller passes the whole stack plus the loop index and
     never pays a per-layer dynamic-slice copy of the codes.
+
+    ``small_m_xla``: None (auto) routes int8/fp8 calls with
+    M <= ``SMALL_M_XLA`` rows through the XLA fused dequant-dot — the
+    decode regime where the Pallas tile dequant is VPU-bound (see
+    ``SMALL_M_XLA``). True/False forces the choice (tests; profiling).
     """
     M, K = x.shape
     Kw, N_logical = qw.shape
@@ -270,6 +305,9 @@ def quant_matmul(x: jax.Array, qw: QuantLinear, *,
     if stacked and qw.data.ndim != 3:
         raise ValueError("layer_index given but codes are not stacked "
                          f"(data {qw.data.shape})")
+    if qw.bits in (8, "fp8") and (
+            small_m_xla if small_m_xla is not None else M <= SMALL_M_XLA):
+        return _xla_dequant_dot(x, qw, layer_index)
     if pltpu is None:
         # no Pallas TPU support in this jax build — XLA dequant fallback
         if stacked:

@@ -83,6 +83,20 @@ def spmd_pipeline(stage_fn: Callable[[Pytree, jax.Array, Pytree], jax.Array],
     """
     n = mesh.shape[axis]
     M = xs.shape[0]
+    if n > 1 and any(s > 1 for a, s in mesh.shape.items() if a != axis):
+        from .._jax_compat import partial_manual_collectives_ok
+
+        if not partial_manual_collectives_ok():
+            # old jaxlib: the SPMD partitioner hits a FATAL CHECK
+            # (IsManualSubgroup) on collectives inside a partial-manual
+            # shard_map — a process abort, not an exception. Refuse with
+            # a catchable error instead so callers (dryrun, tests) can
+            # skip pipeline × {data,tensor,expert} cleanly.
+            raise RuntimeError(
+                "this jaxlib cannot partition collectives inside a "
+                "partial-manual shard_map (pipe x non-trivial auto "
+                "axes); upgrade jax/jaxlib to run pipeline parallelism "
+                "combined with data/tensor/expert axes")
     base_fn = stage_fn if shared is not None else \
         (lambda p, x, a, _sh: stage_fn(p, x, a))
     fn = jax.checkpoint(base_fn) if remat else base_fn
@@ -100,12 +114,17 @@ def spmd_pipeline(stage_fn: Callable[[Pytree, jax.Array, Pytree], jax.Array],
             return ys, jnp.sum(aux_losses)
         return ys
 
-    def body(params, xs, aux, sh):
+    def body(params, si, xs, aux, sh):
         # squeeze the broadcast stage dim (see below)
         xs = xs[0]
         aux = jax.tree.map(lambda a: a[0], aux)
         sh = jax.tree.map(lambda a: a[0], sh)
-        idx = jax.lax.axis_index(axis)
+        # the stage index arrives as a pipe-sharded iota operand rather
+        # than lax.axis_index: under a PARTIAL-manual shard_map some XLA
+        # versions cannot partition the PartitionId instruction axis_index
+        # lowers to ("UNIMPLEMENTED ... ambiguous", jaxlib 0.4.36), while
+        # a sharded operand read is just data
+        idx = si[0]
         T = M + n - 1
         state0 = jnp.zeros_like(xs[0])
 
@@ -146,10 +165,10 @@ def spmd_pipeline(stage_fn: Callable[[Pytree, jax.Array, Pytree], jax.Array],
         mesh=mesh,
         axis_names={axis},
         in_specs=(jax.tree.map(lambda _: P(axis), stage_params), P(axis),
-                  P(axis), P(axis)),
+                  P(axis), P(axis), P(axis)),
         out_specs=(P(axis), P(axis)),
         check_vma=False,
-    )(stage_params, xs_b, aux_b, sh_b)
+    )(stage_params, jnp.arange(n, dtype=jnp.int32), xs_b, aux_b, sh_b)
     # final stage's outputs appear at ticks n-1 .. n-1+M
     ys = out[n - 1, n - 1:n - 1 + M]
     if with_aux_loss:

@@ -34,6 +34,28 @@ def _ocp():
     return ocp
 
 
+def _saved_keys(ckptr, path: str) -> set:
+    """Top-level entry names of a saved checkpoint, across orbax versions
+    (new: metadata().item_metadata.tree; old: metadata() IS the tree)."""
+    md = ckptr.metadata(path)
+    tree = getattr(getattr(md, "item_metadata", md), "tree", md)
+    return set(tree.keys())
+
+
+def _restore_partial(ckptr, path: str, item, restore_args):
+    """``ckptr.restore(..., partial_restore=True)`` across orbax versions:
+    older orbax has no ``partial_restore`` kwarg — there ``item`` already
+    defines the restored structure and checkpoint-extra entries are
+    ignored, which is the same contract."""
+    try:
+        return ckptr.restore(path, item=item, restore_args=restore_args,
+                             partial_restore=True)
+    except TypeError as e:
+        if "partial_restore" not in str(e):
+            raise
+        return ckptr.restore(path, item=item, restore_args=restore_args)
+
+
 def _params_treedef_and_keys(params):
     flat, treedef = jax.tree_util.tree_flatten_with_path(params)
     return treedef, [jax.tree_util.keystr(p) for p, _ in flat]
@@ -208,8 +230,7 @@ def load_checkpoint(engine, load_dir: str, tag: str | None = None) -> dict:
     target = {k: v for k, v in target.items() if v is not None}
     ckptr = ocp.PyTreeCheckpointer()
     try:
-        saved = set(ckptr.metadata(os.path.join(path, "state"))
-                    .item_metadata.tree.keys())
+        saved = _saved_keys(ckptr, os.path.join(path, "state"))
     except Exception:
         saved = set(target)
     # Missing-entry policy: opt_error (1-bit feedback) may restore to its
@@ -257,9 +278,8 @@ def load_checkpoint(engine, load_dir: str, tag: str | None = None) -> dict:
     try:
         # partial_restore: the checkpoint may carry entries this engine
         # doesn't use (e.g. a 1-bit error buffer loaded into a dense run)
-        restored = ckptr.restore(os.path.join(path, "state"), item=target,
-                                 restore_args=restore_args,
-                                 partial_restore=True)
+        restored = _restore_partial(ckptr, os.path.join(path, "state"),
+                                    target, restore_args)
     except Exception as e:
         # per-DP-member error buffers change shape with the DP size; ONLY a
         # failure that names opt_error resets them — anything else is a real
@@ -272,21 +292,19 @@ def load_checkpoint(engine, load_dir: str, tag: str | None = None) -> dict:
             lambda t: jax.tree.map(jnp.zeros_like, t),
             out_shardings=shardings.opt_state.error)(target.pop("opt_error"))
         restore_args.pop("opt_error", None)
-        restored = ckptr.restore(os.path.join(path, "state"), item=target,
-                                 restore_args=restore_args,
-                                 partial_restore=True)
+        restored = _restore_partial(ckptr, os.path.join(path, "state"),
+                                    target, restore_args)
     restored.update(missing)  # zeros for the allowed-absent entries
     if derive_master:
         # restore the checkpoint's fp32 params a second time directly into
         # the master layout — exact, unlike upcasting the bf16-rounded params
-        m = ckptr.restore(
-            os.path.join(path, "state"),
-            item={"params": state.master},
-            restore_args={"params": jax.tree.map(
+        m = _restore_partial(
+            ckptr, os.path.join(path, "state"),
+            {"params": state.master},
+            {"params": jax.tree.map(
                 lambda x, s: ocp.ArrayRestoreArgs(
                     sharding=s, global_shape=x.shape, dtype=jnp.float32),
-                state.master, shardings.master)},
-            partial_restore=True)
+                state.master, shardings.master)})
         restored["master"] = m["params"]
 
     from ..ops.optimizers import OptState
@@ -328,8 +346,7 @@ def _load_checkpoint_offload(engine, path: str) -> dict:
 
     # which entries the checkpoint actually has (fp32 non-offload runs save
     # no "master"; non-momentum optimizers save no mu/nu)
-    md = ckptr.metadata(state_path)
-    saved = set(md.item_metadata.tree.keys())
+    saved = _saved_keys(ckptr, state_path)
 
     def np_like(x):
         return np.empty(x.shape, np.float32)

@@ -17,8 +17,9 @@ import jax
 
 if os.environ.get("DS_TPU_TEST_REAL_DEVICES") != "1":
     try:
-        jax.config.update("jax_platforms", "cpu")
-        jax.config.update("jax_num_cpu_devices", 8)
+        from deepspeed_tpu._jax_compat import set_cpu_devices
+
+        set_cpu_devices(8)
     except RuntimeError:
         # backend already initialized (e.g. running a single test from a
         # session that already touched devices) — leave as-is.

@@ -56,10 +56,55 @@ def make_shards(n: int) -> list[list[str]]:
     return shards
 
 
-def run_shard(files: list[str], extra: list[str]) -> subprocess.Popen:
+def run_shard(files: list[str], extra: list[str],
+              junit: str | None = None) -> subprocess.Popen:
     cmd = [sys.executable, "-m", "pytest", "-m", "slow", "-q",
+           *([f"--junitxml={junit}"] if junit else []),
            *[os.path.join(HERE, f) for f in files], *extra]
     return subprocess.Popen(cmd)
+
+
+def _junit_counts(path: str) -> dict:
+    """passed/failed/errors/skipped totals from one shard's junit xml."""
+    import xml.etree.ElementTree as ET
+
+    out = {"tests": 0, "failures": 0, "errors": 0, "skipped": 0}
+    try:
+        root = ET.parse(path).getroot()
+    except Exception as e:  # noqa: BLE001 — a crashed shard leaves no xml
+        return out | {"parse_error": f"{type(e).__name__}: {e}"[:120]}
+    suites = root.iter("testsuite") if root.tag == "testsuites" else [root]
+    for s in suites:
+        for k in out:
+            out[k] += int(s.get(k, 0))
+    out["passed"] = out.pop("tests") - out["failures"] - out["errors"] \
+        - out["skipped"]
+    return out
+
+
+def write_results(out_path: str, shard_results: list[dict]) -> None:
+    """The machine-readable slow-tier artifact: per-shard rc + junit
+    counts and a tier-level verdict, so per-round full-suite greenness is
+    checkable from a file instead of scrollback."""
+    import json
+    import time
+
+    agg = {"passed": 0, "failures": 0, "errors": 0, "skipped": 0}
+    for r in shard_results:
+        for k in agg:
+            agg[k] += r["counts"].get(k, 0)
+    doc = {
+        "tier": "slow",
+        "finished_at": time.strftime("%Y-%m-%dT%H:%M:%S%z"),
+        "green": all(r["rc"] == 0 for r in shard_results),
+        **agg,
+        "shards": shard_results,
+    }
+    with open(out_path, "w") as f:
+        json.dump(doc, f, indent=2)
+    print(f"slow-tier results -> {out_path} "
+          f"(green={doc['green']} passed={agg['passed']} "
+          f"failed={agg['failures']} errors={agg['errors']})")
 
 
 def main() -> int:
@@ -69,7 +114,24 @@ def main() -> int:
                     help="run all N shards concurrently on this machine")
     ap.add_argument("--list", action="store_true",
                     help="print the shard assignment and exit")
+    ap.add_argument("--out", default=os.path.join(
+        os.path.dirname(HERE), "SLOWTIER.json"),
+        help="machine-readable result file (JSON); '' disables")
     args, extra = ap.parse_known_args()
+
+    def junit_path(j: int) -> str | None:
+        if not args.out:
+            return None
+        d = os.path.join(os.path.dirname(os.path.abspath(args.out)),
+                         ".slowtier_junit")
+        os.makedirs(d, exist_ok=True)
+        p = os.path.join(d, f"shard_{j}.xml")
+        # a shard that dies before pytest's session end (e.g. a fatal XLA
+        # abort) writes no xml — a PREVIOUS run's file must not be counted
+        # as this run's results
+        if os.path.exists(p):
+            os.unlink(p)
+        return p
 
     if args.shard:
         i, n = (int(x) for x in args.shard.split("/"))
@@ -77,8 +139,17 @@ def main() -> int:
         if args.list:
             print("\n".join(shards[i - 1]))
             return 0
-        proc = run_shard(shards[i - 1], extra)
-        return proc.wait()
+        jp = junit_path(i)
+        rc = run_shard(shards[i - 1], extra, junit=jp).wait()
+        if args.out:
+            # per-shard file: sequential `--shard i/N` runs must not
+            # overwrite each other at the shared default path — a later
+            # passing shard would masquerade as the whole tier's verdict
+            base, ext = os.path.splitext(args.out)
+            write_results(f"{base}.shard_{i}of{n}{ext}", [{
+                "shard": f"{i}/{n}", "files": shards[i - 1], "rc": rc,
+                "counts": _junit_counts(jp)}])
+        return rc
 
     n = args.jobs or (os.cpu_count() or 1)
     shards = make_shards(n)
@@ -86,10 +157,20 @@ def main() -> int:
         for j, s in enumerate(shards, 1):
             print(f"shard {j}/{n}: {' '.join(s)}")
         return 0
-    procs = [run_shard(s, extra) for s in shards if s]
-    rc = 0
-    for p in procs:
-        rc = rc or p.wait()
+    procs = []
+    for j, s in enumerate(shards, 1):
+        if not s:
+            continue
+        jp = junit_path(j)      # computed ONCE: the call clears stale xml
+        procs.append((j, s, jp, run_shard(s, extra, junit=jp)))
+    results, rc = [], 0
+    for j, s, jp, p in procs:
+        shard_rc = p.wait()
+        rc = rc or shard_rc
+        results.append({"shard": f"{j}/{n}", "files": s, "rc": shard_rc,
+                        "counts": _junit_counts(jp or "")})
+    if args.out:
+        write_results(args.out, results)
     return rc
 
 

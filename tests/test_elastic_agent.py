@@ -20,10 +20,9 @@ ELASTIC = {"enabled": True, "version": 0.1,
 
 TRAIN_SCRIPT = textwrap.dedent("""
     import json, os, sys
-    import jax
+    from deepspeed_tpu._jax_compat import set_cpu_devices
     n = int(os.environ["DS_TPU_ELASTIC_CHIPS"])
-    jax.config.update("jax_platforms", "cpu")
-    jax.config.update("jax_num_cpu_devices", n)
+    set_cpu_devices(n)
     import numpy as np
     import deepspeed_tpu as ds
     from deepspeed_tpu.models import build_model

@@ -73,6 +73,34 @@ def test_sampling_top_p():
         assert int(tok[0]) == 0
 
 
+def test_sampling_topk_partial_selection_matches_sort():
+    """The decode-loop top_k filter runs lax.top_k (partial selection)
+    instead of a full vocab sort; the kept set must match the sort-based
+    reference formulation, and samples must always land inside it."""
+    r = np.random.default_rng(0)
+    logits = jnp.asarray(r.standard_normal((3, 97)), jnp.float32)
+    k = 5
+    kth_ref = jnp.sort(logits, axis=-1)[..., -k][..., None]
+    keep_ref = np.asarray(logits >= kth_ref)
+    for seed in range(6):
+        tok = np.asarray(sample_logits(logits, jax.random.PRNGKey(seed),
+                                       temperature=1.0, top_k=k))
+        for b in range(logits.shape[0]):
+            assert keep_ref[b, tok[b]], (b, tok[b])
+
+
+def test_sampling_topk_and_topp_combined():
+    """top_k and top_p together share ONE sort: the candidate set is the
+    intersection (top-p computed over the top-k-filtered distribution) —
+    a dominant pair with top_k=3, top_p=0.6 must only ever sample the
+    two heavy tokens."""
+    logits = jnp.log(jnp.asarray([[0.45, 0.40, 0.05, 0.05, 0.05]]))
+    for seed in range(8):
+        tok = int(sample_logits(logits, jax.random.PRNGKey(seed),
+                                temperature=1.0, top_k=3, top_p=0.6)[0])
+        assert tok in (0, 1), tok
+
+
 def test_moe_model_inference():
     model = build_model("tiny-mixtral")
     engine = ds.init_inference(model, config={"tensor_parallel": {"tp_size": 1}})

@@ -761,3 +761,147 @@ def test_v2_fp8_kv_with_rolling_window_ring():
     while not eng.query(1).get("done", False):
         eng.step()
     assert len(eng.flush(1)) == 6
+
+
+def test_v2_fp8_kv_long_context_logits_parity():
+    """THE accuracy gate for keeping the fp8 PV dot (advisor r05: e4m3's
+    subnormal granularity ~2^-9 truncates attention weights ~1/n once the
+    pool holds hundreds of tokens — the old 12-token test never saw it).
+    A ~256-token pool context must still produce logits within fp8-
+    quantization distance of the bf16 pool; the kernel's p pre-scaling
+    (ops/pallas/paged_attention.py online_update) is what makes this
+    hold. If this test regresses, switch the fp8 PV dot back to bf16
+    (v.astype(q.dtype) in the kernel's pool step)."""
+    from deepspeed_tpu.parallel.topology import MeshTopology
+
+    model = build_model("tiny-gpt2", hidden_size=256, num_heads=4,
+                        max_seq_len=512)
+    rng = jax.random.PRNGKey(5)
+    topo = MeshTopology({"tensor": 1, "data": 1})
+    cfg = {"block_size": 16, "num_blocks": 48, "max_seqs": 1, "chunk": 64,
+           "max_seq_len": 512, "prefill_pack": False}
+    e16 = InferenceEngineV2(model, config=cfg, rng=rng, topology=topo)
+    ef8 = InferenceEngineV2(model, config={**cfg, "kv_cache_dtype": "fp8"},
+                            rng=rng, topology=topo)
+    assert ef8.kv_pool.dtype == jnp.float8_e4m3fn
+
+    rngnp = np.random.default_rng(9)
+    prompt = list(map(int, rngnp.integers(0, 256, (300,))))
+    for eng in (e16, ef8):
+        eng.put(1, list(prompt), max_new_tokens=4)
+    # run 4 chunks (256 tokens) through the pool; the 5th chunk's logits
+    # then attend ~256 pool tokens — softmax weights ~1/256 sit BELOW
+    # e4m3's subnormal granularity without the p pre-scaling
+    for _ in range(4):
+        for eng in (e16, ef8):
+            eng._dispatch_next()
+            eng._drain(drain_all=True)
+    p16 = e16.scheduler.next_step()
+    pf8 = ef8.scheduler.next_step()
+    assert int(p16.seq_lens[0]) >= 280   # long context actually reached
+    args16 = (jnp.asarray(p16.token_ids), jnp.asarray(p16.positions),
+              jnp.asarray(p16.slot_map), jnp.asarray(p16.block_tables),
+              jnp.asarray(p16.seq_lens), jnp.asarray(p16.sample_idx))
+    argsf8 = (jnp.asarray(pf8.token_ids), jnp.asarray(pf8.positions),
+              jnp.asarray(pf8.slot_map), jnp.asarray(pf8.block_tables),
+              jnp.asarray(pf8.seq_lens), jnp.asarray(pf8.sample_idx))
+    _, l16 = jax.jit(e16._ragged_forward)(e16.params, e16.kv_pool, *args16)
+    _, lf8 = jax.jit(ef8._ragged_forward)(ef8.params, ef8.kv_pool, *argsf8)
+    a = np.asarray(l16, np.float32)[0]
+    b = np.asarray(lf8, np.float32)[0]
+    # same bound shape as the short-context test: quantization noise on
+    # the softmax scale, not long-context collapse
+    assert np.abs(a - b).max() < 0.5
+    assert np.abs(a - b).mean() < 0.05
+    # and the fp8 engine finishes generation through its own path
+    while not ef8.query(1).get("done", False):
+        ef8.step()
+    assert len(ef8.flush(1)) == 4
+
+
+def test_v2_decode_window_scan_matches_early_exit():
+    """The round-6 fused decode window (fixed-trip lax.scan, XLA can
+    software-pipeline across iterations) must generate token-for-token
+    what the early-exiting while_loop form generates, including eos
+    truncation mid-window and the useful-iteration stats accounting."""
+    model = build_model("tiny-gpt2", hidden_size=256, num_heads=4)
+    rng = jax.random.PRNGKey(5)
+    cfg = {"block_size": 8, "num_blocks": 64, "max_seqs": 2, "chunk": 8,
+           "max_seq_len": 128, "decode_window": 4}
+    es = InferenceEngineV2(model, config=cfg, rng=rng)   # scan (default)
+    ew = InferenceEngineV2(model, config={**cfg, "decode_early_exit": True},
+                           rng=rng)
+    assert not es.config.decode_early_exit
+    ew.params = es.params
+
+    rngnp = np.random.default_rng(4)
+    prompts = [list(map(int, rngnp.integers(0, 256, (L,))))
+               for L in (11, 5)]
+    out_s = es.generate(prompts, max_new_tokens=10)
+    out_w = ew.generate(prompts, max_new_tokens=10)
+    assert out_s == out_w
+    assert es.stats["windows"] > 0 and ew.stats["windows"] > 0
+
+    # eos truncation inside a window behaves identically: pick the token
+    # the free-running chain emitted mid-generation as the eos
+    eos = out_s[0][4]
+    for eng in (es, ew):
+        eng.put(7, list(prompts[0]), max_new_tokens=10, eos_token_id=eos)
+        while not eng.query(7).get("done", False):
+            eng.step()
+    assert es.flush(7) == ew.flush(7)
+
+
+def test_v2_weight_prefetch_matches_unprefetched():
+    """Scan-carried weight prefetch (double-buffered layer walk) is a
+    schedule change only: greedy chains must be identical with it off."""
+    model = build_model("tiny-gpt2", hidden_size=256, num_heads=4)
+    rng = jax.random.PRNGKey(6)
+    cfg = {"block_size": 8, "num_blocks": 64, "max_seqs": 2, "chunk": 8,
+           "max_seq_len": 128}
+    ep = InferenceEngineV2(model, config=cfg, rng=rng)   # prefetch (default)
+    en = InferenceEngineV2(model, config={**cfg, "weight_prefetch": False},
+                           rng=rng)
+    assert ep.config.weight_prefetch and not en.config.weight_prefetch
+    en.params = ep.params
+    rngnp = np.random.default_rng(2)
+    prompts = [list(map(int, rngnp.integers(0, 256, (L,))))
+               for L in (9, 14)]
+    assert ep.generate(prompts, max_new_tokens=8) == \
+        en.generate(prompts, max_new_tokens=8)
+
+
+def test_v2_mixed_load_caps_decode_window():
+    """While prefill chunks are pending, the decode window is capped at
+    decode_window_mixed_cap (advisor r05: a waiting first chunk could sit
+    behind a full window, inflating TTFT); once prefill drains, windows
+    go back to full size."""
+    model = build_model("tiny-gpt2", hidden_size=256, num_heads=4)
+    eng = InferenceEngineV2(
+        model, config={"block_size": 8, "num_blocks": 64, "max_seqs": 2,
+                       "chunk": 8, "max_seq_len": 256, "decode_window": 8,
+                       "decode_window_mixed_cap": 2},
+        rng=jax.random.PRNGKey(8))
+    rngnp = np.random.default_rng(5)
+    # seq 1 becomes decode-ready fast; seq 2 carries a long prompt that
+    # keeps prefill pending for several alternations
+    eng.put(1, list(map(int, rngnp.integers(0, 256, (6,)))),
+            max_new_tokens=40)
+    eng.put(2, list(map(int, rngnp.integers(0, 256, (120,)))),
+            max_new_tokens=8)
+    saw_mixed_window = False
+    while not (eng.query(1).get("done", False)
+               and eng.query(2).get("done", False)):
+        pending_prefill, _ = eng.scheduler.pending_kinds()
+        before = {k for k in eng._programs if k[0] == "win"}
+        eng.step()
+        new_wins = {k for k in eng._programs if k[0] == "win"} - before
+        if pending_prefill and new_wins:
+            # a window program first compiled while prefill was pending
+            # must be capped
+            assert max(k[1] for k in new_wins) <= 2, new_wins
+            saw_mixed_window = True
+    assert saw_mixed_window
+    # after the mix drained, full-size windows were dispatched again
+    assert ("win", 8) in eng._programs
+    eng.flush(1), eng.flush(2)

@@ -171,7 +171,11 @@ def test_instrument_w_nvtx_annotation():
 
     out = jax.jit(f)(jnp.ones((4,)))
     assert float(out[0]) == 3.0
-    txt = jax.jit(f).lower(jnp.ones((4,))).as_text(debug_info=True)
+    lowered = jax.jit(f).lower(jnp.ones((4,)))
+    try:
+        txt = lowered.as_text(debug_info=True)
+    except TypeError:   # older jax: no debug_info kwarg; scope names only
+        txt = lowered.compile().as_text()   # survive into the compiled HLO
     assert "my_marked_op" in txt
     with range_push("block"):
         assert float(f(jnp.ones(()))) == 3.0

@@ -96,8 +96,8 @@ ENGINE_WORKER = textwrap.dedent("""
     os.environ["JAX_PLATFORMS"] = "cpu"
     os.environ.pop("PALLAS_AXON_POOL_IPS", None)
     import jax
-    jax.config.update("jax_platforms", "cpu")
-    jax.config.update("jax_num_cpu_devices", 2)   # 2 devs/proc, 4 global
+    from deepspeed_tpu._jax_compat import set_cpu_devices
+    set_cpu_devices(2)                            # 2 devs/proc, 4 global
 
     pid = int(sys.argv[1]); port = sys.argv[2]; ckpt_dir = sys.argv[3]
 
@@ -185,8 +185,8 @@ SERVE_WORKER = textwrap.dedent("""
     os.environ["JAX_PLATFORMS"] = "cpu"
     os.environ.pop("PALLAS_AXON_POOL_IPS", None)
     import jax
-    jax.config.update("jax_platforms", "cpu")
-    jax.config.update("jax_num_cpu_devices", 2)   # 2 devs/proc, 4 global
+    from deepspeed_tpu._jax_compat import set_cpu_devices
+    set_cpu_devices(2)                            # 2 devs/proc, 4 global
 
     pid = int(sys.argv[1]); port = sys.argv[2]
 

@@ -9,6 +9,27 @@ import pytest
 pytestmark = pytest.mark.slow  # multi-minute: engine jit compiles
 from jax.sharding import Mesh
 
+import functools
+
+from deepspeed_tpu._jax_compat import partial_manual_collectives_ok
+
+
+def needs_partial_manual(fn):
+    """Skip (at RUN time, not collection — the capability probe spawns a
+    ~5s subprocess, which must not tax fast-tier runs that deselect this
+    whole file) when the jaxlib cannot partition collectives inside a
+    partial-manual shard_map: pipe combined with non-trivial data/tensor/
+    expert axes fatally ABORTS there (not an exception), so the probe
+    runs out of process and these tests never reach the crash."""
+    @functools.wraps(fn)
+    def wrapper(*args, **kwargs):
+        if not partial_manual_collectives_ok():
+            pytest.skip("jaxlib cannot partition collectives in a "
+                        "partial-manual shard_map (pipe x "
+                        "data/tensor/expert)")
+        return fn(*args, **kwargs)
+    return wrapper
+
 import deepspeed_tpu as ds
 from deepspeed_tpu.models import get_model_config
 from deepspeed_tpu.parallel.pipeline import (
@@ -57,6 +78,7 @@ def test_spmd_pipeline_matches_sequential():
                                rtol=1e-4, atol=1e-4)
 
 
+@needs_partial_manual
 def test_pipelined_lm_matches_unpipelined():
     import dataclasses
 
@@ -90,6 +112,7 @@ def test_pipeline_module_uniformity_enforced():
         PipelineModule([LayerSpec(A), LayerSpec(B)], topo, num_microbatches=2)
 
 
+@needs_partial_manual
 def test_pipeline_engine_end_to_end():
     """pp2 x data2 x tensor2 + ZeRO-2: the full 3D composition trains."""
     cfg = get_model_config("tiny-llama")
@@ -111,6 +134,7 @@ def test_pipeline_engine_end_to_end():
     assert losses[-1] < losses[0]
 
 
+@needs_partial_manual
 def test_pipelined_moe_matches_unpipelined():
     """MoE-in-pipeline (VERDICT r03 missing #1): a tiny full-MoE stack
     pipelined over pipe=4 produces the same logits AND the same total loss
@@ -144,6 +168,7 @@ def test_pipelined_moe_matches_unpipelined():
     assert aux4 is not None and float(aux4) > 0.0
 
 
+@needs_partial_manual
 def test_pipelined_moe_trains_with_expert_axis():
     """pipe=2 x expert=2 x data=2: MoE pipelined over a mesh with a real
     expert axis trains end-to-end (the mesh product the dryrun had never
@@ -167,6 +192,7 @@ def test_pipelined_moe_trains_with_expert_axis():
     assert losses[-1] < losses[0]
 
 
+@needs_partial_manual
 def test_pipeline_activation_liveness_sublinear_in_microbatches():
     """VERDICT r03 weak #3: the GPipe-vs-1F1B activation-liveness question,
     measured instead of asserted. 1F1B exists to bound live activations at
@@ -202,6 +228,7 @@ def test_pipeline_activation_liveness_sublinear_in_microbatches():
         f"interleaved schedule")
 
 
+@needs_partial_manual
 def test_pipelined_mixed_moe_dense_stack_periodic():
     """Heterogeneous (periodic) stages: a qwen2-moe-style mixed stack —
     dense/MoE alternating (decoder_sparse_step=2 phase) — pipelines over
@@ -252,6 +279,7 @@ def test_pipeline_rejects_aperiodic_stage_split():
                                num_microbatches=2)
 
 
+@needs_partial_manual
 def test_pipeline_module_heterogeneous_and_tied():
     """PipelineModule accepts a PERIODIC heterogeneous stack with a
     TiedLayerSpec: pattern [wide-ffn, tied-mixer] x 4 over pipe=2. The
@@ -303,6 +331,7 @@ def test_pipeline_module_heterogeneous_and_tied():
         np.asarray(a), np.asarray(b), rtol=1e-4, atol=1e-4), g2, g1)
 
 
+@needs_partial_manual
 def test_pipeline_aperiodic_boundary_and_composite_recipe():
     """VERDICT r04 missing #2: aperiodic stacks are a DOCUMENTED SPMD
     boundary, not a silent gap. An aperiodic layer list raises at
