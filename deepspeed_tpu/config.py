@@ -226,6 +226,14 @@ class TensorParallelConfig:
     """TPU extension mirroring the mpu/AutoTP role (module_inject/auto_tp.py:189):
     degree comes from mesh.tensor; this section holds behavior knobs."""
     gather_output: bool = False
+    #: ring collective-matmul overlap (parallel/tensor.py): the row-parallel
+    #: out-projections (attention wo, FFN w_down) run as ring-overlapped
+    #: matmul⊗reduce-scatter + all-gather instead of blocking on the
+    #: GSPMD all-reduce — the partial GEMMs hide under the ring transfers
+    #: and only (n-1)/n of the payload stays exposed. Takes effect when
+    #: mesh.tensor > 1 and mesh.pipe == 1; layers whose token/contraction
+    #: dims don't divide the axis fall back to the plain matmul per site.
+    overlap: bool = False
 
 
 @dataclass

@@ -53,6 +53,8 @@ from ..models.transformer import (
     dense_ffn_config,
     is_moe_layer,
 )
+from ..parallel.tensor import (_ring_rs_core, allgather_matmul,
+                               matmul_reduce_scatter, overlap_counters)
 from ..parallel.topology import MeshConfig, MeshTopology
 from ..utils.logging import logger
 from ..ops.pallas.paged_attention import (paged_attention_usable,
@@ -167,6 +169,28 @@ class RaggedInferenceConfig:
     #: dominant cost of a decode iteration (60% of device time on v5e).
     #: Fresh tokens compute/stage in bf16 and quantize at the pool merge.
     kv_cache_dtype: str | None = None
+    #: ring collective-matmul tensor parallelism (latency hiding): the
+    #: residual stream runs token-sharded over the ``tensor`` axis and
+    #: every projection is an overlapped ring primitive — in-projs consume
+    #: arriving activation shards into partial dots while the next shard
+    #: is in flight (all-gather⊗matmul, QKV fused into ONE ring),
+    #: out-projs ring-accumulate partial outputs toward their owner shard
+    #: (matmul⊗reduce-scatter) instead of blocking on the GSPMD
+    #: all-reduce (parallel/tensor.py). None = auto: on whenever tensor>1,
+    #: the model's head/ffn dims divide by the axis, AND the program
+    #: carries at least ``tp_overlap_min_rows`` token rows per ring chunk
+    #: — prefill/training-shaped M; decode windows (M = max_seqs) stay on
+    #: the blocking path by default because each ring step re-reads the
+    #: weight shard, and at HBM-roofline decode sizes n× weight traffic
+    #: outweighs the tiny hidden collective until measured otherwise
+    #: (ROADMAP open item). Programs whose row count doesn't divide fall
+    #: back per-program (counted in stats["tp_fallbacks"]). False = off;
+    #: True = require: ring EVERY divisible program including decode, and
+    #: raise when the geometry can't ring.
+    tp_overlap: bool | None = None
+    #: auto-mode gate: minimum token rows per ring chunk (S*T // tp)
+    #: before a program rings — see ``tp_overlap``
+    tp_overlap_min_rows: int = 64
     #: int8/fp8 weight matmul dispatch for few-row calls: None (auto)
     #: routes M <= quant_matmul.SMALL_M_XLA rows through XLA's fused
     #: dequant-dot — at decode the Pallas tile kernel is VPU-bound on the
@@ -336,6 +360,21 @@ class InferenceEngineV2:
         self._pallas_decode = pallas_ok if cfg.use_pallas_decode is None \
             else cfg.use_pallas_decode
 
+        # ---- ring collective-matmul TP (latency-hiding overlap) ----------
+        # static geometry gate; programs whose row count doesn't divide the
+        # axis additionally fall back per-program inside _ragged_forward
+        ring_geom = (tp > 1 and m.num_heads % tp == 0
+                     and m.kv_heads % tp == 0 and m.ffn_size % tp == 0)
+        if cfg.tp_overlap and not ring_geom:
+            raise ValueError(
+                f"tp_overlap=True but the geometry can't ring: heads "
+                f"{m.num_heads}, kv_heads {m.kv_heads}, ffn {m.ffn_size} "
+                f"must all divide by the tensor axis size {tp}")
+        self._tp_ring_n = tp if (ring_geom and cfg.tp_overlap is not False) \
+            else 0
+        self._tp_ring_force = cfg.tp_overlap is True
+        self._tp_counter_base = overlap_counters.snapshot()
+
         self._programs: dict[int, Any] = {}
         self._rng = jax.random.PRNGKey(17)
         self._results: dict[int, list[int]] = {}
@@ -363,7 +402,11 @@ class InferenceEngineV2:
                       "decode_steps": 0, "windows": 0, "window_iters": 0,
                       "window_iters_max": 0, "forced_drains": 0,
                       "opportunistic_drains": 0, "prefill_budget_tokens": 0,
-                      "prefill_tokens": 0, "decode_tokens": 0}
+                      "prefill_tokens": 0, "decode_tokens": 0,
+                      # ring collective-matmul overlap (trace-time deltas
+                      # from parallel/tensor.py — see _refresh_tp_stats)
+                      "tp_ring_matmuls": 0, "tp_ring_steps": 0,
+                      "tp_bytes_permuted": 0, "tp_fallbacks": 0}
         # measure the host<->device readback latency ONCE instead of
         # guessing it (VERDICT r04 weak #4: a fixed 0.15s age gate meant
         # the opportunistic commit path never fired — every drain
@@ -583,10 +626,42 @@ class InferenceEngineV2:
             ws = P(None, *ws)
         xs = P(None, "tensor") if kind == "row" else P(None, None)
         os_ = P(None, "tensor") if kind == "col" else P(None, None)
+        # grouped ring steps (tp_overlap): a row-kind expert GEMM's psum
+        # becomes a ring accumulation over token-TILE chunks — each step's
+        # partial grouped GEMM (chunk rows + matching tile→expert slice)
+        # overlaps the traveling accumulator's ppermute; chunks stay
+        # tile-aligned so the tile ownership invariant holds
+        ntp = self.topology.size("tensor")
+        bm = self._MOE_GEMM_BLOCK_M
+        ring = (kind == "row" and self._tp_ring_n and ntp > 1
+                and x2d.shape[0] % (ntp * bm) == 0)
+        if kind == "row" and self._tp_ring_n and not ring:
+            overlap_counters.fallback()
 
         def fn(xl, ql, te, lil):
-            y = gmm(xl, ql, te, layer_index=(None if li is None else lil))
-            return jax.lax.psum(y, "tensor") if kind == "row" else y
+            liA = None if li is None else lil
+            if not ring:
+                y = gmm(xl, ql, te, layer_index=liA)
+                return jax.lax.psum(y, "tensor") if kind == "row" else y
+
+            def dot(rows, start):
+                # the chunk's tile→expert slice rides the traced row
+                # offset; chunks are whole tiles by the ring gate above
+                tec = jax.lax.dynamic_slice(te, (start // bm,),
+                                            (rows.shape[0] // bm,))
+                return gmm(rows, ql, tec, layer_index=liA)
+
+            # unidirectional: the bidirectional half-chunk split need not
+            # stay tile-aligned
+            y_c = _ring_rs_core(xl, dot, ntp, "tensor", x2d.dtype,
+                                bidir=False)
+            return jax.lax.all_gather(y_c, "tensor", axis=0, tiled=True)
+
+        if ring:
+            n_out = qw.shape[-1]
+            overlap_counters.ring(
+                steps=ntp - 1,
+                bytes_permuted=(ntp - 1) * x2d.shape[0] * n_out * 4)
 
         lia = jnp.zeros((), jnp.int32) if li is None else li
         return shard_map(fn, mesh=mesh, in_specs=(xs, ws, P(None), P()),
@@ -634,6 +709,22 @@ class InferenceEngineV2:
             Ts = max(8, T)
             if Ts > bs and Ts % bs:
                 Ts = -(-Ts // bs) * bs
+
+        # ring collective-matmul TP: static per program — the token-sharded
+        # residual stream needs the row dim to divide the tensor axis
+        # (exact-k packed prefill plans with odd row counts fall back to
+        # the blocking einsum path, counted per compiled program), and the
+        # auto mode additionally requires ring chunks of at least
+        # tp_overlap_min_rows rows (decode-sized programs would pay n×
+        # weight re-reads for a tiny hidden collective; tp_overlap=True
+        # overrides for measurement)
+        rn = self._tp_ring_n
+        if rn and (S % rn or not (
+                self._tp_ring_force
+                or (S * T) // rn >= self.config.tp_overlap_min_rows)):
+            overlap_counters.fallback()
+            rn = 0
+        mesh_t = self.topology.mesh
 
         from ..ops.pallas.quant_matmul import (QuantGrouped, QuantLinear,
                                                quant_matmul)
@@ -689,6 +780,12 @@ class InferenceEngineV2:
             x = x + params["pos_embed"].astype(cfg.dtype)[positions]
         if "ln_embed" in params:                                   # bloom
             x = Norm(m).apply({"params": params["ln_embed"]}, x)
+        if rn:
+            # token-sharded residual stream (Megatron-SP layout): norms and
+            # residual adds run 1/tp-sized per chip; the projections put
+            # the gather/scatter back via overlapped ring primitives
+            x = jax.lax.with_sharding_constraint(
+                x, NamedSharding(mesh_t, P("tensor", None, None)))
 
         def quant_moe(ml, h, li=None):
             """Routed experts over QuantGrouped slabs: dropless routing +
@@ -734,6 +831,15 @@ class InferenceEngineV2:
             return out.reshape(S, T, E).astype(cfg.dtype)
 
         def ffn(p, h, use_moe: bool, li=None):
+            if use_moe and rn:
+                # routing needs the full token set (gate + expert sort over
+                # all tokens): gather the token-sharded stream once and run
+                # the MoE path replicated; the expert GEMMs themselves ring
+                # via _qgmm's grouped ring steps when the contraction is
+                # tensor-sharded
+                overlap_counters.fallback()
+                h = jax.lax.with_sharding_constraint(
+                    h, NamedSharding(mesh_t, P(None, None, None)))
             if use_moe:
                 from ..models.transformer import moe_layer_kwargs
                 from ..moe.layer import MoE
@@ -766,6 +872,42 @@ class InferenceEngineV2:
                     out = out + g.astype(out.dtype) * shared
                 return out
             f = p["ffn"]
+            if rn:
+                # ring FFN pair: gate/up share ONE all-gather⊗matmul ring,
+                # down is matmul⊗reduce-scatter back into the token-sharded
+                # stream. Mirrors DenseFFN.__call__ / the quant branch below
+                # — keep activations/biases in sync across the three.
+                def fwr(k):
+                    wv = f.get(k)
+                    if wv is None and f"ffn/{k}" in qstack:
+                        return qstack[f"ffn/{k}"]
+                    return wv if isinstance(wv, QuantLinear) \
+                        else wv.astype(cfg.dtype)
+
+                wu = fwr("w_up")
+                # dense layers of a mixed MoE stack may carry their own
+                # intermediate size — ring only when it divides the axis
+                if isinstance(wu, QuantLinear) or wu.shape[1] % rn == 0:
+                    h2 = h.reshape(S * T, -1)
+                    sm = cfg.quant_small_m_xla
+                    if m.activation == "silu_glu":
+                        g2, u2 = allgather_matmul(
+                            h2, (fwr("w_gate"), wu), mesh_t,
+                            layer_index=li, small_m_xla=sm)
+                        z = jax.nn.silu(g2) * u2
+                    else:
+                        u2 = allgather_matmul(h2, wu, mesh_t,
+                                              layer_index=li, small_m_xla=sm)
+                        z = _ACTS[m.activation](
+                            u2 + f["b_up"].astype(u2.dtype))
+                    y2 = matmul_reduce_scatter(
+                        z.astype(cfg.dtype), fwr("w_down"), mesh_t,
+                        layer_index=li, small_m_xla=sm)
+                    out = y2.reshape(S, T, -1).astype(cfg.dtype)
+                    if m.activation != "silu_glu":
+                        out = out + f["b_down"].astype(cfg.dtype)
+                    return out
+                overlap_counters.fallback()
             quant_ffn = isinstance(f.get("w_up"), QuantLinear) or (
                 "w_up" in f and f["w_up"] is None and "ffn/w_up" in qstack)
             if quant_ffn:
@@ -798,9 +940,30 @@ class InferenceEngineV2:
             the read-only pool pages + the stage. Returns (o, stage_l')."""
             a = p["attn"]
             qli = li if qstack else None
-            q = proj_in(h, a["wq"], H, "wq", li=qli)
-            k = proj_in(h, a["wk"], KV, "wk", li=qli)
-            v = proj_in(h, a["wv"], KV, "wv", li=qli)
+            if rn:
+                # ONE bidirectional ring gathers the token-sharded hidden
+                # while all three projections consume each arriving shard
+                # (fused QKV collective-matmul); quantized weights run
+                # quant_matmul per ring step, never a whole-shard dequant
+                def aw(name):
+                    wv = a[name]
+                    if wv is None:
+                        return qstack[f"attn/{name}"]
+                    if isinstance(wv, QuantLinear):
+                        return wv
+                    w2 = wv.astype(cfg.dtype)
+                    return w2.reshape(w2.shape[0], -1)
+                q2, k2, v2 = allgather_matmul(
+                    h.reshape(S * T, -1), (aw("wq"), aw("wk"), aw("wv")),
+                    mesh_t, layer_index=qli,
+                    small_m_xla=cfg.quant_small_m_xla)
+                q = q2.reshape(S, T, H, -1).astype(cfg.dtype)
+                k = k2.reshape(S, T, KV, -1).astype(cfg.dtype)
+                v = v2.reshape(S, T, KV, -1).astype(cfg.dtype)
+            else:
+                q = proj_in(h, a["wq"], H, "wq", li=qli)
+                k = proj_in(h, a["wk"], KV, "wk", li=qli)
+                v = proj_in(h, a["wv"], KV, "wv", li=qli)
             if m.qkv_bias:
                 q = q + a["bq"].astype(cfg.dtype)
                 k = k + a["bk"].astype(cfg.dtype)
@@ -915,7 +1078,20 @@ class InferenceEngineV2:
                 scores = jnp.where(mask, scores, jnp.finfo(jnp.float32).min)
                 w = jax.nn.softmax(scores, axis=-1).astype(V.dtype)
                 o = jnp.einsum("shtc,schd->sthd", w, V)
-            o = proj_out(o, a["wo"], li=qli)
+            if rn:
+                # row-parallel out-proj: partial outputs ring-accumulate
+                # toward their owner's token chunk instead of blocking on
+                # the GSPMD all-reduce; output rejoins the token-sharded
+                # residual stream directly
+                wo = a["wo"] if a["wo"] is not None else qstack["attn/wo"]
+                if not isinstance(wo, QuantLinear):
+                    wo = wo.astype(cfg.dtype).reshape(-1, wo.shape[-1])
+                o2 = matmul_reduce_scatter(
+                    o.reshape(S * T, -1), wo, mesh_t, layer_index=qli,
+                    small_m_xla=cfg.quant_small_m_xla)
+                o = o2.reshape(S, T, -1).astype(cfg.dtype)
+            else:
+                o = proj_out(o, a["wo"], li=qli)
             if m.attn_out_bias:
                 o = o + a["bo"].astype(cfg.dtype)
             return o, stage_l
@@ -1004,6 +1180,11 @@ class InferenceEngineV2:
         x = Norm(m).apply({"params": params["ln_final"]}, x)
         last = jnp.take_along_axis(
             x, sample_idx[:, None, None].astype(jnp.int32), axis=1)[:, 0]  # [S,E]
+        if rn:
+            # leave the token-sharded stream: the logits projection reads
+            # S rows total — replicating them is noise next to the weight
+            last = jax.lax.with_sharding_constraint(
+                last, NamedSharding(mesh_t, P(None, None)))
         if m.tie_embeddings:
             if "logits_q" in params:
                 # tied models keep the embedding gather exact but project
@@ -1177,10 +1358,14 @@ class InferenceEngineV2:
             # (a T=1 decode plan in "prefill" seconds would corrupt the
             # trace-derived prefill MFU)
             step.__name__ = "step_prefill" if T > 1 else "step_decode"
+            # non-pool outputs PINNED replicated: with tp_overlap's sharded
+            # intermediates, letting XLA choose (None) can shard last_tok's
+            # output and break its donation alias (replicated input)
+            repl = NamedSharding(self.topology.mesh, P())
             self._programs[key] = jax.jit(
                 step, donate_argnums=(1, 2),
                 in_shardings=(None, self._pool_format) + (None,) * 11,
-                out_shardings=(self._pool_format, None, None))
+                out_shardings=(self._pool_format, repl, repl))
         return self._programs[key]
 
     def _window_program(self, W: int):
@@ -1320,10 +1505,12 @@ class InferenceEngineV2:
                                            ks, vs)
                 return kv_pool, tok, buf, i        # toks [W, S], iters run
 
+            # non-pool outputs pinned replicated (see _program)
+            repl = NamedSharding(self.topology.mesh, P())
             self._programs[key] = jax.jit(
                 run, donate_argnums=(1, 2),
                 in_shardings=(None, self._pool_format) + (None,) * 9,
-                out_shardings=(self._pool_format, None, None, None))
+                out_shardings=(self._pool_format, repl, repl, repl))
         return self._programs[key]
 
     def warm_decode_windows(self, sizes: list[int] | None = None,
@@ -1610,6 +1797,25 @@ class InferenceEngineV2:
             self.state.release(uid)
         return self._results.pop(uid, [])
 
+    def _refresh_tp_stats(self) -> None:
+        """Accumulate the ring collective-matmul counters (trace-time,
+        process-wide in parallel/tensor.py) into this engine's stats.
+
+        INCREMENTAL (+= new-since-last-refresh, base rebased each call)
+        rather than since-init values: callers like bench's serve() zero
+        the stats dict per measured run, and an absolute-delta overwrite
+        would silently clobber that reset with cumulative numbers. A
+        snapshot BELOW the base means someone reset the process-wide
+        counters — rebase to zero instead of emitting negative deltas.
+        (Attribution caveat: two ring-enabled engines stepping in one
+        process share the global counters; each engine's stats then count
+        the union of both engines' new compiles.)"""
+        snap = overlap_counters.snapshot()
+        for k, v in snap.items():
+            base = self._tp_counter_base.get(k, 0)
+            self.stats[k] += v - (base if v >= base else 0)
+        self._tp_counter_base = snap
+
     def step(self) -> dict[int, list[int]]:
         """Dispatch the next scheduled step WITHOUT waiting for it, and
         commit any earlier steps whose readbacks completed. Returns
@@ -1621,6 +1827,8 @@ class InferenceEngineV2:
         engine is idle only when it also has nothing in flight."""
         emitted = self._drain()
         dispatched = self._dispatch_next()
+        if self._tp_ring_n:
+            self._refresh_tp_stats()
         if dispatched and self.config.max_inflight <= 0:
             # max_inflight=0 restores the synchronous contract: the step
             # dispatched THIS call commits before we return

@@ -47,6 +47,7 @@ from ..parallel.axes import (  # noqa: E402
     SEQ,
     constrain,
 )
+from ..parallel.tensor import current_tp_overlap, ring_row_matmul
 
 
 def default_activation_rules(topology) -> list[tuple[str, Any]]:
@@ -358,7 +359,19 @@ class Attention(nn.Module):
         )
         # back to seq-sharded, heads full
         out = constrain(out, BATCH, SEQ, None, None)
-        out = jnp.einsum("bshd,hde->bse", out, wo.astype(cfg.dtype))
+        # row-parallel out-proj: under an active tp_overlap scope the
+        # contraction (heads) rides a ring matmul⊗reduce-scatter +
+        # all-gather (parallel/tensor.py) — the GEMM hides under the ring
+        # transfers instead of finishing before a blocking all-reduce
+        scope = current_tp_overlap()
+        proj = None
+        if scope is not None and scope.attention:
+            proj = ring_row_matmul(
+                out.reshape(B, S, H * D),
+                wo.astype(cfg.dtype).reshape(H * D, cfg.hidden_size),
+                scope.mesh, axis=scope.axis, lead_specs=scope.token_specs)
+        out = proj if proj is not None else \
+            jnp.einsum("bshd,hde->bse", out, wo.astype(cfg.dtype))
         if bo is not None:
             out = out + bo.astype(cfg.dtype)
         out = constrain(out, BATCH, SEQ, EMBED)
@@ -404,7 +417,17 @@ class DenseFFN(nn.Module):
             act = _ACTS[cfg.activation]
             h = act(x @ wu.astype(cfg.dtype) + bu.astype(cfg.dtype))
         h = constrain(h, BATCH, SEQ, MLP)
-        out = h @ wd.astype(cfg.dtype)
+        # row-parallel down-proj via ring matmul⊗reduce-scatter when a
+        # tp_overlap scope is active (see Attention); falls back to the
+        # plain matmul when the token/contraction dims can't ring
+        scope = current_tp_overlap()
+        out = None
+        if scope is not None and scope.ffn:
+            out = ring_row_matmul(h, wd.astype(cfg.dtype), scope.mesh,
+                                  axis=scope.axis,
+                                  lead_specs=scope.token_specs)
+        if out is None:
+            out = h @ wd.astype(cfg.dtype)
         if cfg.activation != "silu_glu":
             out = out + bd.astype(cfg.dtype)
         return constrain(out, BATCH, SEQ, EMBED)

@@ -267,6 +267,23 @@ def _xla_dequant_dot(x: jax.Array, qw, layer_index) -> jax.Array:
     return (x @ w)[:, :N_logical]
 
 
+def local_matmul(x: jax.Array, w, *, layer_index: jax.Array | None = None,
+                 small_m_xla: bool | None = None) -> jax.Array:
+    """Per-shard 2D matmul dispatch by weight type: ``QuantLinear`` routes
+    through :func:`quant_matmul` (in-tile dequant Pallas kernel or the
+    fused-XLA small-M dispatch — never a whole-shard dequantize), plain
+    arrays run a dot with fp32 accumulation. The single local-GEMM entry
+    the ring collective-matmul bodies (parallel/tensor.py) use, so
+    dtype/quant routing decisions stay next to the kernels."""
+    if isinstance(w, QuantLinear):
+        return quant_matmul(x, w, layer_index=layer_index,
+                            small_m_xla=small_m_xla)
+    wl = w
+    if layer_index is not None and w.ndim == 3:
+        wl = w[layer_index]
+    return jnp.dot(x, wl, preferred_element_type=jnp.float32).astype(x.dtype)
+
+
 def _pick(dim: int, want: int) -> int:
     if dim <= want:
         return dim

@@ -6,6 +6,14 @@ from .pipeline import (  # noqa: F401
     initialize_pipelined,
     spmd_pipeline,
 )
+from .tensor import (  # noqa: F401
+    allgather_matmul,
+    current_tp_overlap,
+    matmul_reduce_scatter,
+    overlap_counters,
+    ring_row_matmul,
+    tp_overlap_scope,
+)
 from .topology import (  # noqa: F401
     AXIS_ORDER,
     BATCH_AXES,

@@ -176,8 +176,9 @@ def ring_attention(q, k, v, mesh, *, axis: str = "seq", causal: bool = True,
 # Vocab-parallel cross entropy (reference sequence/cross_entropy.py)
 # ---------------------------------------------------------------------------
 
-def _vp_ce_body(logits, labels, *, axis_name: str, ignore_index: int):
-    """logits: [B, S, V/n] local shard; labels: [B, S] global ids."""
+def _vp_ce_body(logits, labels, *, axis_name: str, ignore_index: int,
+                seq_axis: str | None = None):
+    """logits: [B, S/sp, V/n] local shard; labels: [B, S/sp] local ids."""
     idx = comm.axis_index(axis_name)
     V_loc = logits.shape[-1]
     lo = idx * V_loc
@@ -196,20 +197,30 @@ def _vp_ce_body(logits, labels, *, axis_name: str, ignore_index: int):
 
     nll = jnp.log(gsum) + gmax - target_logit                    # [B,S]
     mask = (labels != ignore_index).astype(jnp.float32)
-    return jnp.sum(nll * mask) / jnp.maximum(jnp.sum(mask), 1.0)
+    num, den = jnp.sum(nll * mask), jnp.sum(mask)
+    if seq_axis is not None:
+        # sequence-sharded rows: the masked mean spans every seq shard
+        # (ignore_index rows may be unevenly distributed across shards)
+        num = comm.all_reduce(num, seq_axis)
+        den = comm.all_reduce(den, seq_axis)
+    return num / jnp.maximum(den, 1.0)
 
 
 def vocab_parallel_cross_entropy(logits, labels, mesh, *,
                                  axis: str = "tensor",
-                                 ignore_index: int = -100):
+                                 ignore_index: int = -100,
+                                 seq_axis: str | None = None):
     """Cross entropy over vocab-sharded logits without materializing the
-    full softmax on any chip. logits: [B,S,V] sharded over `axis` on dim 2.
+    full softmax on any chip. logits: [B,S,V] sharded over `axis` on dim 2;
+    ``seq_axis`` additionally shards the sequence dim (seq×tensor training
+    layouts) — the per-position algebra is shard-local either way, only the
+    final masked mean gains a seq reduction.
     """
     fn = shard_map(
         functools.partial(_vp_ce_body, axis_name=axis,
-                          ignore_index=ignore_index),
+                          ignore_index=ignore_index, seq_axis=seq_axis),
         mesh=mesh,
-        in_specs=(P(None, None, axis), P(None, None)),
+        in_specs=(P(None, seq_axis, axis), P(None, seq_axis)),
         out_specs=P(),
         check_vma=False)
     return fn(logits, labels)

@@ -105,6 +105,39 @@ def collective_breakdown(log_dir: str | None = None, *,
     return dict(out)
 
 
+def overlap_breakdown(log_dir: str | None = None, *,
+                      totals: dict[str, float] | None = None,
+                      device_substr: str = "TPU") -> dict:
+    """Ring (overlappable) vs blocking collective device time from the
+    newest trace — the measurement side of the ring collective-matmul
+    counters (parallel/tensor.py records trace-time ring structure; this
+    reads what the device actually spent).
+
+    ``collective-permute`` is overlappable transport: its transfers are
+    schedulable under independent compute, so its share of total
+    collective time is the *upper bound* on comm that ring decompositions
+    can hide — NB it counts EVERY permute producer (ring collective-
+    matmuls, ring attention in parallel/sequence.py, pipeline 1F1B), so
+    on runs mixing those features the fraction bounds their combined
+    overlap, not the TP rings alone (cross-check engine
+    stats["tp_ring_steps"] for attribution). all-reduce / all-gather /
+    reduce-scatter / all-to-all sit on the critical path as barriers.
+    ``comm_hidden_fraction`` = ppermute / (ppermute + blocking); None
+    when the trace carries no collectives (single chip, or a CPU trace
+    without device planes). ``totals`` bypasses the trace read (tests /
+    pre-aggregated data)."""
+    coll = collective_breakdown(log_dir, totals=totals,
+                                device_substr=device_substr)
+    ring_ms = coll.get("ppermute", 0.0)
+    blocking_ms = sum(v for k, v in coll.items() if k != "ppermute")
+    total = ring_ms + blocking_ms
+    return {
+        "ring_ms": round(ring_ms, 6),
+        "blocking_ms": round(blocking_ms, 6),
+        "comm_hidden_fraction": (ring_ms / total) if total else None,
+    }
+
+
 def print_breakdown(log_dir: str, top: int = 20, steps: int = 1,
                     device_substr: str = "TPU") -> str:
     """Human-readable top-N op table (ms per step)."""

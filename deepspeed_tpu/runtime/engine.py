@@ -135,6 +135,16 @@ class DeepSpeedEngine:
             loss_fn = partial(lm_loss_fn, model)
         self._raw_loss_fn = loss_fn
         self._rules = activation_rules or default_activation_rules(self.topology)
+        # ring collective-matmul TP (parallel/tensor.py): hide the
+        # row-parallel projections' all-reduce under ring-overlapped
+        # partial GEMMs. GSPMD-path only — the spmd_pipeline / ZeRO++
+        # shard_map paths would nest manual regions (pipe>1 requires
+        # tensor==1 there anyway), and the models consult the scope at
+        # trace time, so installing it around the loss is the whole wiring.
+        self._tp_overlap = bool(
+            config.tensor_parallel.overlap
+            and self.topology.size("tensor") > 1
+            and self.topology.size("pipe") == 1)
 
         # precision regime (reference engine dtype checks :1101)
         self.fp16_enabled = config.fp16.enabled
@@ -569,7 +579,13 @@ class DeepSpeedEngine:
             batch = dict(batch)
             batch["_train_rng"] = jax.random.fold_in(self._train_rng_base,
                                                      step)
-        with nn.logical_axis_rules(self._rules):
+        from contextlib import nullcontext
+
+        from ..parallel.tensor import tp_overlap_scope
+
+        ctx = tp_overlap_scope(self.topology.mesh) if self._tp_overlap \
+            else nullcontext()
+        with nn.logical_axis_rules(self._rules), ctx:
             return self._raw_loss_fn(params, batch)
 
     def _compute_grads(self, state: TrainState, batch: dict) -> tuple[jax.Array, Pytree]:

@@ -34,6 +34,9 @@ HEAVY = [
     "test_pipeline.py", "test_hpz.py", "test_zeropp_engine.py",
     "test_infinity.py", "test_moe.py", "test_offload.py",
     "test_hybrid_engine.py", "test_checkpoint.py", "test_parallelism.py",
+    # TP>=2 ring collective-matmul parity: engine builds on 2- and 4-way
+    # CPU meshes (several full engine compiles) — spread early
+    "test_tensor_parallel.py",
 ]
 
 

@@ -1,0 +1,45 @@
+"""Repo lint: no module-import-time jax device probes outside _jax_compat
+(bin/check_import_time_devices.py — the round-5 postmortem rule: the first
+``jax.devices()`` belongs behind a watchdog at CALL time, and import-time
+probes freeze the platform before set_cpu_devices can run)."""
+import importlib.util
+import os
+
+HERE = os.path.dirname(os.path.abspath(__file__))
+ROOT = os.path.dirname(HERE)
+
+spec = importlib.util.spec_from_file_location(
+    "check_import_time_devices",
+    os.path.join(ROOT, "bin", "check_import_time_devices.py"))
+lint = importlib.util.module_from_spec(spec)
+spec.loader.exec_module(lint)
+
+
+def test_repo_has_no_import_time_device_probes():
+    violations = lint.check_repo(ROOT)
+    assert violations == [], "\n".join(violations)
+
+
+def test_detector_flags_import_time_probe(tmp_path):
+    bad = tmp_path / "bad.py"
+    bad.write_text(
+        "import jax\n"
+        "KIND = jax.devices()[0].device_kind\n"          # module level
+        "def fine():\n"
+        "    return jax.devices()\n"                     # call time: ok
+        "N = len(jax.local_devices())\n")
+    out = lint.check_file(str(bad))
+    assert len(out) == 2
+    assert "jax.devices()" in out[0] and ":2:" in out[0]
+    assert "jax.local_devices()" in out[1] and ":5:" in out[1]
+
+
+def test_detector_flags_import_time_default_args(tmp_path):
+    """Default-arg expressions evaluate at def time — import time for
+    top-level functions."""
+    bad = tmp_path / "bad2.py"
+    bad.write_text(
+        "import jax\n"
+        "def f(n=len(jax.devices())):\n"
+        "    return n\n")
+    assert len(lint.check_file(str(bad))) == 1
