@@ -144,8 +144,8 @@ def get_accelerator() -> Accelerator:
             logger.info(
                 f"accelerator: platform={info.platform} kind={info.kind} "
                 f"devices={info.num_devices} processes={info.num_processes}")
-        except Exception:
-            pass
+        except Exception as e:  # backend not up yet — info is best-effort
+            logger.debug(f"accelerator info probe failed: {e!r}")
     return _accelerator
 
 

@@ -259,9 +259,80 @@ class CheckpointConfig:
     use_node_local_storage: bool = False
     load_universal: bool = True   # kept for config-compat; always true on TPU
     async_save: bool = False
+    #: keep only the newest N tags after each save; the tag the engine
+    #: resumed from and the 'latest' target are never GC'd
     keep_n: int | None = None
+    #: manifest integrity level written at save / checked at load:
+    #: "crc32" (full content checksums) | "size" (existence + byte size,
+    #: no read-back — for multi-GB checkpoints) | "none" (no manifest)
+    integrity: str = "crc32"
+    #: bound on wait_for_checkpoint (an async save thread that wedges must
+    #: surface as a structured CheckpointWaitTimeout, not an infinite
+    #: hang); None/0 → wait forever
+    wait_timeout_s: float | None = None
 
     _IGNORED_KEYS = ("tag_validation", "parallel_write", "writer")
+
+    def __post_init__(self):
+        if self.integrity not in ("crc32", "size", "none"):
+            raise ValueError(f"checkpoint.integrity must be crc32|size|none, "
+                             f"got '{self.integrity}'")
+
+
+@dataclass
+class ResilienceConfig:
+    """Fault tolerance (runtime/resilience.py): divergence sentinel,
+    preemption-aware saves, hang watchdog, fault injection.
+
+    No reference analogue — the reference's fp16 scaler skips overflowed
+    steps but bf16 runs have no non-finite defense, and preemption /
+    integrity handling lives outside the repo (CheckFreq/Bamboo territory).
+    """
+    #: fuse a non-finite(grads|loss) flag into every train step and skip
+    #: the optimizer update on a bad step — bf16/fp32 included, not just
+    #: the fp16 scaler. Numerically inert on healthy steps.
+    sentinel: bool = True
+    #: >0 enables loss-spike detection: a finite loss above
+    #: ``loss_spike_factor * EMA(loss)`` counts as a bad step
+    loss_spike_factor: float = 0.0
+    loss_ema_beta: float = 0.9
+    #: consecutive bad steps tolerated (device-side skips) before the
+    #: sentinel escalates to a rewind
+    max_consecutive_bad: int = 3
+    #: rewind budget: after this many rewinds the sentinel aborts with
+    #: DivergenceError instead of looping forever
+    max_rewinds: int = 2
+    #: host sentinel sync cadence — observing the flag forces a device
+    #: sync, so raise this to amortize on real slices (1 = every step)
+    check_interval: int = 1
+    #: where rewinds load from; default: the directory of the engine's
+    #: most recent save_checkpoint call
+    rewind_dir: str | None = None
+    #: signals that request a preemption-safe save + exit(PREEMPTED_EXIT_CODE)
+    #: at the next step boundary (empty list disables). SIGINT is opt-in —
+    #: hijacking Ctrl-C surprises interactive runs.
+    preemption_signals: list[str] = field(default_factory=lambda: ["SIGTERM"])
+    #: save a priority synchronous checkpoint before the preemption exit
+    #: (requires a prior save_checkpoint call or rewind_dir to know where)
+    preemption_save: bool = True
+    #: hang watchdog: >0 arms a stall timer around blocking device work
+    #: (train step, restore, checkpoint wait); on stall it dumps all-thread
+    #: stacks + device diagnostics
+    watchdog_timeout_s: float = 0.0
+    #: after the stall dump, self-terminate with WATCHDOG_EXIT_CODE so a
+    #: supervisor can relaunch (default: dump and keep waiting)
+    watchdog_exit: bool = False
+    #: deterministic fault-injection points (tests/chaos drills); merged
+    #: with the DS_TPU_FAULT_INJECT env var — see runtime/resilience.py
+    fault_injection: dict[str, Any] = field(default_factory=dict)
+
+    def __post_init__(self):
+        if self.max_consecutive_bad < 1:
+            raise ValueError("resilience.max_consecutive_bad must be >= 1")
+        if self.check_interval < 1:
+            raise ValueError("resilience.check_interval must be >= 1")
+        if self.max_rewinds < 0:
+            raise ValueError("resilience.max_rewinds must be >= 0")
 
 
 # --------------------------------------------------------------------------
@@ -347,6 +418,7 @@ class Config:
     comet: MonitorBackendConfig = field(default_factory=MonitorBackendConfig)
     data_types: DataTypesConfig = field(default_factory=DataTypesConfig)
     checkpoint: CheckpointConfig = field(default_factory=CheckpointConfig)
+    resilience: ResilienceConfig = field(default_factory=ResilienceConfig)
     data_efficiency: DataEfficiencyConfig = field(
         default_factory=DataEfficiencyConfig)
     hybrid_engine: HybridEngineConfig = field(
@@ -385,6 +457,7 @@ class Config:
             "comet": MonitorBackendConfig,
             "data_types": DataTypesConfig,
             "checkpoint": CheckpointConfig,
+            "resilience": ResilienceConfig,
             "data_efficiency": DataEfficiencyConfig,
             "hybrid_engine": HybridEngineConfig,
         }

@@ -19,6 +19,7 @@ and ``load_checkpoint(ckpt_dir)`` if a ``latest`` tag exists.
 from __future__ import annotations
 
 import os
+import random
 import subprocess
 import time
 from typing import Callable
@@ -26,6 +27,11 @@ from typing import Callable
 from ..utils.logging import logger
 from .elasticity import (ElasticityConfig, ElasticityError,
                          compute_elastic_config)
+
+#: default exit code meaning "worker was preempted after a priority save"
+#: (runtime/resilience.py PREEMPTED_EXIT_CODE; duplicated here so the
+#: supervisor never has to import the jax-heavy runtime package)
+_PREEMPTED_EXIT_CODE = 83
 
 
 def _batch_split(ds_config: dict, batch: int, valid: list[int],
@@ -68,18 +74,35 @@ class ElasticAgent:
     def __init__(self, cmd, ds_config: dict, *,
                  available_chips_fn: Callable[[], int],
                  max_restarts: int = 10, backoff_s: float = 1.0,
-                 env: dict | None = None):
+                 max_backoff_s: float = 60.0, backoff_jitter: float = 0.25,
+                 preempted_exit_codes: tuple[int, ...] = (_PREEMPTED_EXIT_CODE,),
+                 env: dict | None = None, seed: int | None = None):
         """``cmd``: the launch argv, or a callable ``solved_dict ->
         argv`` so process topology (e.g. --nproc_per_node) tracks each
-        re-solve."""
+        re-solve.
+
+        Restart policy: failures restart after exponential backoff with
+        jitter (``backoff_s * 2^(n-1)`` capped at ``max_backoff_s``,
+        ±``backoff_jitter`` fractional jitter so a fleet of agents doesn't
+        thundering-herd the scheduler) and consume the ``max_restarts``
+        budget. Exits in ``preempted_exit_codes`` mean the worker was
+        preempted AFTER a priority checkpoint save — those relaunch with
+        the base backoff and do NOT consume the failure budget (a healthy
+        job evicted nightly must not exhaust its crash allowance)."""
         self.cmd = cmd
         self.ds_config = ds_config
         self.available_chips_fn = available_chips_fn
         self.max_restarts = max_restarts
         self.backoff_s = backoff_s
+        self.max_backoff_s = max_backoff_s
+        self.backoff_jitter = backoff_jitter
+        self.preempted_exit_codes = tuple(preempted_exit_codes)
         self.extra_env = dict(env or {})
-        self.restart_count = 0
+        self.restart_count = 0            # failure restarts (budgeted)
+        self.preemption_count = 0         # preemption restarts (unbudgeted)
         self.history: list[dict] = []     # per-incarnation records
+        self._rng = random.Random(seed)
+        self._sleep = time.sleep          # test seam
 
     # ------------------------------------------------------------------
     def _resolve(self) -> dict:
@@ -106,12 +129,29 @@ class ElasticAgent:
             solved["train_micro_batch_size_per_gpu"])
         env["DS_TPU_ELASTIC_GAS"] = str(
             solved["gradient_accumulation_steps"])
-        env["DS_TPU_ELASTIC_RESTART"] = str(self.restart_count)
+        # total relaunch index (failures + preemptions): incarnation 0 is
+        # the first launch, regardless of why the previous one ended
+        env["DS_TPU_ELASTIC_RESTART"] = str(self.restart_count
+                                            + self.preemption_count)
         return env
 
+    def _backoff_delay(self, cause: str) -> float:
+        """Exponential backoff with jitter for failures; a preempted worker
+        already saved and exited cleanly, so it relaunches after just the
+        (jittered) base delay — the capacity usually returns quickly."""
+        if cause == "preemption":
+            base = self.backoff_s
+        else:
+            base = min(self.max_backoff_s,
+                       self.backoff_s * (2.0 ** max(0, self.restart_count - 1)))
+        jitter = 1.0 + self.backoff_jitter * self._rng.uniform(-1.0, 1.0)
+        return max(0.0, base * jitter)
+
     def run(self) -> int:
-        """Launch; on failure re-solve + relaunch until success or the
-        restart budget is spent. Returns the final exit code."""
+        """Launch; on failure re-solve + relaunch (exponential backoff +
+        jitter) until success or the restart budget is spent; on a
+        preempted exit relaunch without spending the budget. Returns the
+        final exit code."""
         while True:
             solved = self._resolve()
             self.history.append({"restart": self.restart_count, **solved})
@@ -123,17 +163,33 @@ class ElasticAgent:
                 f"{solved['dp']} dp), restart {self.restart_count}")
             argv = self.cmd(solved) if callable(self.cmd) else list(self.cmd)
             proc = subprocess.run(argv, env=self._child_env(solved))
-            if proc.returncode == 0:
+            rc = proc.returncode
+            if rc == 0:
                 logger.info("elastic agent: job completed")
                 return 0
-            self.restart_count += 1
-            if self.restart_count > self.max_restarts:
-                logger.error(
-                    f"elastic agent: giving up after {self.max_restarts} "
-                    f"restarts (last exit code {proc.returncode})")
-                return proc.returncode
+            cause = "preemption" if rc in self.preempted_exit_codes \
+                else "failure"
+            if cause == "preemption":
+                # the worker saved a verified checkpoint and exited on
+                # purpose — this is capacity churn, not a crash
+                self.preemption_count += 1
+            else:
+                self.restart_count += 1
+                if self.restart_count > self.max_restarts:
+                    self.history[-1]["exit"] = rc
+                    self.history[-1]["cause"] = cause
+                    logger.error(
+                        f"elastic agent: giving up after {self.max_restarts} "
+                        f"restarts (last exit code {rc})")
+                    return rc
+            delay = self._backoff_delay(cause)
+            self.history[-1]["exit"] = rc
+            self.history[-1]["cause"] = cause
+            self.history[-1]["backoff_s"] = delay
             logger.warning(
-                f"elastic agent: worker exited {proc.returncode}; "
-                f"re-solving and relaunching "
-                f"({self.restart_count}/{self.max_restarts})")
-            time.sleep(self.backoff_s)
+                f"elastic agent: worker exited {rc} (cause: {cause}); "
+                f"relaunching in {delay:.2f}s "
+                + (f"(preemption {self.preemption_count}, budget untouched)"
+                   if cause == "preemption" else
+                   f"(failure {self.restart_count}/{self.max_restarts})"))
+            self._sleep(delay)

@@ -51,6 +51,17 @@ class MonitorMaster(Monitor):
         for b in self.backends:
             b.write_events(event_list)
 
+    def write_counters(self, counters: dict, step: int,
+                       prefix: str = "") -> None:
+        """Convenience for scalar counter dicts — the resilience layer
+        (rewinds / skipped steps / checkpoint save+commit durations) emits
+        through this so dashboards see recovery activity without bespoke
+        plumbing: ``{"rewinds": 2}`` → ``("<prefix>rewinds", 2.0, step)``."""
+        if not self.enabled or not counters:
+            return
+        self.write_events([(f"{prefix}{k}", float(v), int(step))
+                           for k, v in counters.items()])
+
     def flush(self) -> None:
         for b in self.backends:
             b.flush()

@@ -1,1 +1,12 @@
 from .engine import DeepSpeedEngine, TrainState, initialize  # noqa: F401
+from .resilience import (  # noqa: F401
+    PREEMPTED_EXIT_CODE,
+    WATCHDOG_EXIT_CODE,
+    CheckpointWaitTimeout,
+    DivergenceError,
+    FaultInjector,
+    HangWatchdog,
+    InjectedFault,
+    Preempted,
+    PreemptionHandler,
+)

@@ -14,18 +14,139 @@ TPU-native analogue of the reference checkpoint machinery:
 Layout on disk (per the reference's tag scheme, engine.py:2710):
     <save_dir>/<tag>/state/...        orbax pytree (params/master/opt/scaler)
     <save_dir>/<tag>/meta.json        config + client_state + step
+    <save_dir>/<tag>/manifest.json    per-entry size+crc32 (integrity proof)
     <save_dir>/latest                 text file with the newest tag
+
+Integrity contract (runtime/resilience.py is the policy layer):
+- the state commit, then ``manifest.json``, then the atomic ``latest``
+  rename — a crash between any two leaves the previous fully-committed
+  checkpoint as the resume target, never a torn one;
+- ``load_checkpoint`` verifies the resolved tag against its manifest and
+  falls back to the newest *verified* tag when ``latest`` is torn, the tag
+  dir is truncated, or a checksum mismatches;
+- keep-last-N retention (``checkpoint.keep_n``) never GCs the tag training
+  resumed from, the ``latest`` target, or the tag just written.
 """
 from __future__ import annotations
 
 import json
 import os
+import shutil
+import time
+import zlib
 from typing import Any
 
 import jax
 import jax.numpy as jnp
 
 from ..utils.logging import log_dist, logger
+from .resilience import CheckpointWaitTimeout
+
+
+class CheckpointIntegrityError(RuntimeError):
+    """An explicitly requested tag failed manifest verification."""
+
+
+def _injector(engine):
+    res = getattr(engine, "resilience", None)
+    return res.injector if res is not None else None
+
+
+# --------------------------------------------------------------------------
+# Manifest (per-entry checksums) + tag verification
+# --------------------------------------------------------------------------
+
+def _file_crc32(path: str, chunk: int = 1 << 20) -> int:
+    crc = 0
+    with open(path, "rb") as f:
+        while True:
+            buf = f.read(chunk)
+            if not buf:
+                break
+            crc = zlib.crc32(buf, crc)
+    return crc & 0xFFFFFFFF
+
+
+def write_manifest(path: str, tag: str, global_steps: int,
+                   level: str = "crc32") -> None:
+    """Commit proof for ``<path>`` (one tag dir): every file's size (and
+    crc32 under the full integrity level), written atomically AFTER the
+    state commit and BEFORE the 'latest' advance."""
+    if level == "none":
+        return
+    entries: dict[str, dict] = {}
+    for dirpath, _, files in os.walk(path):
+        for fn in sorted(files):
+            if dirpath == path and fn == "manifest.json":
+                continue
+            full = os.path.join(dirpath, fn)
+            rel = os.path.relpath(full, path)
+            ent: dict[str, Any] = {"size": os.path.getsize(full)}
+            if level == "crc32":
+                ent["crc32"] = _file_crc32(full)
+            entries[rel] = ent
+    doc = {"version": 1, "tag": tag, "global_steps": int(global_steps),
+           "integrity": level, "entries": entries}
+    _write_file_atomic(os.path.join(path, "manifest.json"),
+                       json.dumps(doc, indent=2))
+
+
+def tag_status(path: str, level: str = "crc32") -> tuple[str, str]:
+    """Classify one tag dir: ``verified`` (manifest checks out), ``legacy``
+    (complete but pre-manifest), ``bad`` (truncated/corrupt), ``missing``."""
+    if not os.path.isdir(path):
+        return "missing", "no such tag dir"
+    if not os.path.exists(os.path.join(path, "meta.json")):
+        return "bad", "meta.json missing"
+    if not os.path.isdir(os.path.join(path, "state")):
+        return "bad", "state dir missing"
+    man_path = os.path.join(path, "manifest.json")
+    if not os.path.exists(man_path):
+        return "legacy", "no manifest (pre-integrity checkpoint)"
+    try:
+        with open(man_path) as f:
+            man = json.load(f)
+    except (OSError, ValueError) as e:
+        return "bad", f"manifest unreadable: {e}"
+    for rel, ent in man.get("entries", {}).items():
+        full = os.path.join(path, rel)
+        if not os.path.exists(full):
+            return "bad", f"entry missing: {rel}"
+        size = os.path.getsize(full)
+        if size != ent.get("size"):
+            return "bad", f"entry truncated: {rel} ({size} != {ent['size']})"
+        if level == "crc32" and "crc32" in ent \
+                and _file_crc32(full) != ent["crc32"]:
+            return "bad", f"entry checksum mismatch: {rel}"
+    return "verified", ""
+
+
+def _tag_steps(path: str) -> float:
+    """Recency key for fallback ordering: saved step if readable, else
+    dir mtime (orders legacy/damaged tags sanely)."""
+    for fn in ("manifest.json", "meta.json"):
+        try:
+            with open(os.path.join(path, fn)) as f:
+                steps = json.load(f).get("global_steps")
+            if steps is not None:
+                return float(steps)
+        except (OSError, ValueError):
+            continue
+    try:
+        return os.path.getmtime(path) - 1e12  # always below any real step
+    except OSError:
+        return float("-inf")
+
+
+def _write_file_atomic(target: str, content: str) -> None:
+    """tmp + ``os.replace``: readers see the old content or the new,
+    never a torn/empty file — a crash mid-write cannot poison the tag."""
+    tmp = f"{target}.tmp.{os.getpid()}"
+    with open(tmp, "w") as f:
+        f.write(content)
+        f.flush()
+        os.fsync(f.fileno())
+    os.replace(tmp, target)
 
 
 def _ocp():
@@ -91,23 +212,59 @@ def _async_checkpointer(engine):
     return engine._async_ckptr
 
 
-def wait_for_checkpoint(engine) -> None:
+def wait_for_checkpoint(engine, timeout_s: float | None = None) -> None:
     """Block until any in-flight async save commits AND its 'latest' tag is
-    written (reference nebula persisted-latest wait)."""
-    ck = getattr(engine, "_async_ckptr", None)
-    if ck is not None:
-        ck.wait_until_finished()
+    written (reference nebula persisted-latest wait).
+
+    Bounded: ``timeout_s`` (default ``checkpoint.wait_timeout_s``; None/0 →
+    wait forever) raises a structured :class:`CheckpointWaitTimeout` when a
+    wedged save thread would otherwise hang the job — the supervisor can
+    then decide (relaunch beats a silent infinite stall). A commit error
+    captured by the background thread re-raises here."""
+    if timeout_s is None:
+        cfg = getattr(engine, "config", None)
+        timeout_s = getattr(getattr(cfg, "checkpoint", None),
+                            "wait_timeout_s", None)
+    deadline = None if not timeout_s else time.monotonic() + float(timeout_s)
+
     t = getattr(engine, "_latest_thread", None)
     if t is not None:
-        t.join()
+        # the commit thread itself waits on the async checkpointer, so its
+        # join covers both phases of an async save
+        t.join(None if deadline is None else max(0.0, deadline - time.monotonic()))
+        if t.is_alive():
+            raise CheckpointWaitTimeout("commit+latest", float(timeout_s))
+    ck = getattr(engine, "_async_ckptr", None)
+    if ck is not None:
+        if deadline is None:
+            ck.wait_until_finished()
+        else:
+            import threading
+
+            waiter = threading.Thread(target=ck.wait_until_finished,
+                                      daemon=True)
+            waiter.start()
+            waiter.join(max(0.0, deadline - time.monotonic()))
+            if waiter.is_alive():
+                raise CheckpointWaitTimeout("state_commit", float(timeout_s))
+    err = getattr(engine, "_ckpt_commit_error", None)
+    if err is not None:
+        engine._ckpt_commit_error = None
+        raise err
 
 
 def save_checkpoint(engine, save_dir: str, tag: str | None = None,
                     client_state: dict | None = None) -> str:
     ocp = _ocp()
+    t_start = time.perf_counter()
+    inj = _injector(engine)
+    res = getattr(engine, "resilience", None)
     tag = tag or f"global_step{engine.global_steps}"
-    path = os.path.join(os.path.abspath(save_dir), tag)
+    root = os.path.abspath(save_dir)
+    path = os.path.join(root, tag)
     os.makedirs(path, exist_ok=True)
+    if res is not None:
+        res.record_save_dir(root)
 
     state = engine.state
     tree = {
@@ -163,49 +320,182 @@ def save_checkpoint(engine, save_dir: str, tag: str | None = None,
     }
     with open(os.path.join(path, "meta.json"), "w") as f:
         json.dump(meta, f, indent=2, default=str)
-    # 'latest' tag file (reference engine.py _save_checkpoint 'latest'
-    # write). For async saves it must only advance once the state commit
-    # lands — a crash mid-persist must leave 'latest' on the previous
-    # fully-committed checkpoint.
-    latest_path = os.path.join(os.path.abspath(save_dir), "latest")
+    # Commit tail, in order: (state commit) → manifest.json → atomic
+    # 'latest' rename → retention. 'latest' only advances once the state
+    # commit AND its integrity manifest land — a crash at ANY point in the
+    # tail leaves 'latest' on the previous fully-committed checkpoint
+    # (reference engine.py _save_checkpoint 'latest' write, hardened).
+    latest_path = os.path.join(root, "latest")
+    level = getattr(engine.config.checkpoint, "integrity", "crc32")
+    save_host_s = time.perf_counter() - t_start
 
     def _write_latest():
-        with open(latest_path, "w") as f:
-            f.write(tag)
+        _write_file_atomic(latest_path, tag)
+
+    def _commit_tail(commit_s: float):
+        if inj is not None:
+            inj.maybe_crash("crash_after_commit",
+                            f"save {tag}: state committed, no manifest yet")
+        write_manifest(path, tag, engine.global_steps, level)
+        if inj is not None:
+            inj.maybe_crash("crash_before_latest",
+                            f"save {tag}: manifest written, 'latest' not")
+        _write_latest()
+        if inj is not None:
+            inj.maybe_crash("crash_after_latest",
+                            f"save {tag}: 'latest' advanced")
+        _apply_retention(engine, root, tag)
+        if inj is not None and inj.fire("truncate_tag"):
+            _truncate_tag_for_test(path)
+        if res is not None:
+            res.record_committed(root, tag, {"save_s": save_host_s,
+                                             "commit_s": commit_s})
 
     if async_save:
         import threading
 
         def _commit_then_latest():
-            engine._async_ckptr.wait_until_finished()
-            _write_latest()
+            t_commit = time.perf_counter()
+            try:
+                engine._async_ckptr.wait_until_finished()
+                _commit_tail(time.perf_counter() - t_commit)
+            except BaseException as e:  # surfaced by wait_for_checkpoint
+                engine._ckpt_commit_error = e
+                logger.error(f"async checkpoint commit for {path} failed: "
+                             f"{e!r}")
 
         engine._latest_thread = threading.Thread(
             target=_commit_then_latest, daemon=True)
         engine._latest_thread.start()
     else:
-        _write_latest()
+        _commit_tail(save_host_s)
     log_dist(f"saved checkpoint {path}")
     return path
+
+
+def _truncate_tag_for_test(path: str) -> None:
+    """Fault-injection helper: chop the first state file in half — the
+    torn-write shape a node loss mid-flush leaves behind."""
+    for dirpath, _, files in os.walk(os.path.join(path, "state")):
+        for fn in sorted(files):
+            full = os.path.join(dirpath, fn)
+            size = os.path.getsize(full)
+            if size > 1:
+                with open(full, "r+b") as f:
+                    f.truncate(size // 2)
+                logger.error(f"fault injection: truncated {full} "
+                             f"({size} -> {size // 2} bytes)")
+                return
+
+
+def _apply_retention(engine, root: str, current_tag: str) -> None:
+    """keep-last-N GC (``checkpoint.keep_n``). Never deletes: the tag just
+    written, the 'latest' target, the tag training resumed from, or the
+    newest verified rewind target."""
+    keep = getattr(engine.config.checkpoint, "keep_n", None)
+    if not keep or keep < 1:
+        return
+    protected = {current_tag}
+    try:
+        with open(os.path.join(root, "latest")) as f:
+            protected.add(f.read().strip())
+    except OSError:
+        pass
+    resume_tag = getattr(engine, "_resume_tag", None)
+    if resume_tag:
+        protected.add(resume_tag)
+    res = getattr(engine, "resilience", None)
+    if res is not None and res.last_verified is not None:
+        protected.add(res.last_verified[1])
+    tags = []
+    for d in os.listdir(root):
+        p = os.path.join(root, d)
+        if os.path.isdir(p) and os.path.exists(os.path.join(p, "meta.json")):
+            tags.append((_tag_steps(p), d))
+    tags.sort(reverse=True)
+    for _, d in tags[keep:]:
+        if d in protected:
+            continue
+        shutil.rmtree(os.path.join(root, d), ignore_errors=True)
+        logger.info(f"checkpoint retention: removed {os.path.join(root, d)} "
+                    f"(keep_n={keep})")
+
+
+def _resolve_tag(engine, load_dir: str, level: str) -> str:
+    """The 'latest' target when it is intact+verified; otherwise the newest
+    *verified* tag (then newest legacy tag) — a torn 'latest' file, a
+    truncated tag dir, or a checksum mismatch falls back instead of
+    crashing the resume."""
+    latest_file = os.path.join(load_dir, "latest")
+    latest_tag = None
+    if os.path.exists(latest_file):
+        with open(latest_file) as f:
+            latest_tag = f.read().strip() or None
+    if latest_tag is not None:
+        status, reason = tag_status(os.path.join(load_dir, latest_tag), level)
+        if status in ("verified", "legacy"):
+            return latest_tag
+        logger.error(f"'latest' names tag '{latest_tag}' which is not "
+                     f"loadable ({reason}); falling back to the newest "
+                     f"verified checkpoint")
+    elif os.path.isdir(load_dir):
+        logger.error(f"missing/torn 'latest' under {load_dir}; falling back "
+                     f"to the newest verified checkpoint")
+    else:
+        raise FileNotFoundError(f"checkpoint dir {load_dir} does not exist")
+    candidates = []
+    for d in sorted(os.listdir(load_dir)):
+        if d == latest_tag:
+            continue  # already rejected above
+        p = os.path.join(load_dir, d)
+        if not os.path.isdir(p):
+            continue
+        status, reason = tag_status(p, level)
+        if status in ("verified", "legacy"):
+            candidates.append((status == "verified", _tag_steps(p), d))
+        elif status == "bad":
+            logger.warning(f"checkpoint fallback: skipping tag '{d}' "
+                           f"({reason})")
+    if not candidates:
+        raise FileNotFoundError(
+            f"no loadable checkpoint under {load_dir} ('latest' is "
+            f"{'torn' if latest_tag is None else f'unverifiable: {latest_tag}'}"
+            f" and no other tag verifies); pass a tag")
+    verified, steps, tag = max(candidates)
+    logger.warning(f"checkpoint fallback: resuming from "
+                   f"{'verified' if verified else 'legacy'} tag '{tag}' "
+                   f"(step {steps:.0f})")
+    return tag
 
 
 def load_checkpoint(engine, load_dir: str, tag: str | None = None) -> dict:
     ocp = _ocp()
     load_dir = os.path.abspath(load_dir)
-    if tag is None:
-        latest_file = os.path.join(load_dir, "latest")
-        if not os.path.exists(latest_file):
-            raise FileNotFoundError(f"no 'latest' file under {load_dir}; pass a tag")
-        with open(latest_file) as f:
-            tag = f.read().strip()
-    path = os.path.join(load_dir, tag)
+    level = getattr(getattr(engine, "config", None), "checkpoint", None)
+    level = getattr(level, "integrity", "crc32")
     wait_for_checkpoint(engine)  # an in-flight async save may be the target
+    if tag is None:
+        tag = _resolve_tag(engine, load_dir, level)
+    else:
+        status, reason = tag_status(os.path.join(load_dir, tag), level)
+        if status == "missing":
+            raise FileNotFoundError(
+                f"checkpoint tag '{tag}' not found under {load_dir}")
+        if status == "bad":
+            # an explicitly requested tag is a user decision — fail loudly
+            # rather than silently loading something else
+            raise CheckpointIntegrityError(
+                f"checkpoint tag '{tag}' under {load_dir} failed "
+                f"verification: {reason}")
+    path = os.path.join(load_dir, tag)
 
     state = engine.state
     shardings = engine._state_shardings
 
     if getattr(engine, "_offload_opt", None) is not None:
-        return _load_checkpoint_offload(engine, path)
+        out = _load_checkpoint_offload(engine, path)
+        _note_loaded(engine, load_dir, tag)
+        return out
 
     # restore targets carry the *current* shardings → reshard-on-load
     # (the universal-checkpoint property).
@@ -328,8 +618,20 @@ def load_checkpoint(engine, load_dir: str, tag: str | None = None) -> dict:
     with open(os.path.join(path, "meta.json")) as f:
         meta = json.load(f)
     engine.global_steps = meta.get("global_steps", int(engine.state.global_step))
+    _note_loaded(engine, load_dir, tag)
     log_dist(f"loaded checkpoint {path} (step {engine.global_steps})")
     return meta.get("client_state", {})
+
+
+def _note_loaded(engine, load_dir: str, tag: str) -> None:
+    """Record the resume target: retention must never GC it, and it is the
+    default rewind anchor until the next committed save."""
+    engine._resume_tag = tag
+    res = getattr(engine, "resilience", None)
+    if res is not None:
+        res.record_save_dir(load_dir)
+        if res.last_verified is None:
+            res.last_verified = (load_dir, tag)
 
 
 def _load_checkpoint_offload(engine, path: str) -> dict:

@@ -75,3 +75,28 @@ class DataLoader:
         for idx in self.sampler:
             batch = {k: v[idx] for k, v in self.cols.items()}
             yield self.collate_fn(batch) if self.collate_fn else batch
+
+    def batch_for_step(self, step: int) -> dict:
+        """Deterministic random access: the batch this loader yields at
+        global step ``step`` (0-based, counting from the start of training)
+        under per-epoch reshuffling.
+
+        This is the data-order half of the rewind/preemption contract
+        (runtime/resilience.py): after ``load_checkpoint`` restores
+        ``engine.global_steps``, resume with
+        ``loader.batch_for_step(engine.global_steps)`` and the replayed
+        stream is identical to the one the lost incarnation saw.
+
+        Note: mutates the sampler's epoch to ``step // len(self)`` — mixing
+        with a concurrent ``__iter__`` of a different epoch is undefined.
+        """
+        per_epoch = len(self.sampler)
+        if per_epoch == 0:
+            raise ValueError("empty loader (fewer rows than one batch)")
+        epoch, offset = divmod(int(step), per_epoch)
+        self.sampler.set_epoch(epoch)
+        for i, idx in enumerate(self.sampler):
+            if i == offset:
+                batch = {k: v[idx] for k, v in self.cols.items()}
+                return self.collate_fn(batch) if self.collate_fn else batch
+        raise AssertionError("unreachable: offset < len(sampler)")

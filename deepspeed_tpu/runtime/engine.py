@@ -266,6 +266,17 @@ class DeepSpeedEngine:
 
         self._validate_zeropp()
 
+        # fault tolerance (runtime/resilience.py): divergence sentinel,
+        # preemption-safe saves, hang watchdog, fault injection. Built
+        # before the programs — the sentinel decides whether train steps
+        # carry the fused non-finite skip.
+        from .resilience import ResilienceManager
+
+        self.resilience = ResilienceManager(self, config.resilience)
+        self._monitor_master = None   # lazy MonitorMaster (monitor/)
+        self._resume_tag: str | None = None
+        self._ckpt_commit_error = None
+
         # ---- state bring-up (reference _configure_distributed_model :1137)
         self._init_state(params, sample_batch, rng)
         self._build_programs()
@@ -575,6 +586,10 @@ class DeepSpeedEngine:
         RSample noisy gating) can draw masks; loss fns that don't use it
         ignore the key. One key per optimizer step — microbatches within a
         GAS step share masks (they already share the step's params)."""
+        fault_scale = None
+        if isinstance(batch, dict) and "_fault_scale" in batch:
+            batch = dict(batch)
+            fault_scale = batch.pop("_fault_scale")
         if step is not None:
             batch = dict(batch)
             batch["_train_rng"] = jax.random.fold_in(self._train_rng_base,
@@ -586,7 +601,12 @@ class DeepSpeedEngine:
         ctx = tp_overlap_scope(self.topology.mesh) if self._tp_overlap \
             else nullcontext()
         with nn.logical_axis_rules(self._rules), ctx:
-            return self._raw_loss_fn(params, batch)
+            loss = self._raw_loss_fn(params, batch)
+        if fault_scale is not None:
+            # fault-injection rail (resilience.FaultInjector.nan_scale):
+            # 1.0 except at the armed step, where NaN poisons the grads
+            loss = loss * jnp.mean(fault_scale)
+        return loss
 
     def _compute_grads(self, state: TrainState, batch: dict) -> tuple[jax.Array, Pytree]:
         """One microbatch forward+backward; grads constrained per plan
@@ -612,7 +632,14 @@ class DeepSpeedEngine:
         grads = jax.lax.with_sharding_constraint(grads, self.plan.grad_shardings)
         return loss, grads
 
-    def _apply_grads(self, state: TrainState, grads: Pytree) -> TrainState:
+    def _apply_grads(self, state: TrainState, grads: Pytree,
+                     loss_finite: jax.Array | None = None
+                     ) -> tuple[TrainState, jax.Array]:
+        """Optimizer update; returns ``(new_state, finite_flag)``. Under the
+        fp16 scaler OR the resilience sentinel (bf16/fp32 included) a
+        non-finite step skips the update in-program — ``global_step`` still
+        advances, so ``skipped_steps`` counts the skips host-side with no
+        extra sync."""
         cfg = self.config
         lr = self.lr_schedule(state.opt_state.step)
         if cfg.gradient_clipping:
@@ -629,14 +656,18 @@ class DeepSpeedEngine:
                 new_master, self.plan.master_shardings)
             return new_master, new_opt
 
-        if state.scaler is not None:
+        guarded = state.scaler is not None or cfg.resilience.sentinel
+        if guarded:
             finite = fp16_mod.grads_finite(grads)
+            if loss_finite is not None:
+                finite = finite & loss_finite
             new_master, new_opt = jax.lax.cond(
                 finite, do_update, lambda op: op, (master_in, state.opt_state))
-            new_scaler = fp16_mod.update_scaler(state.scaler, finite, cfg.fp16)
         else:
+            finite = jnp.asarray(True)
             new_master, new_opt = do_update((master_in, state.opt_state))
-            new_scaler = None
+        new_scaler = None if state.scaler is None else \
+            fp16_mod.update_scaler(state.scaler, finite, cfg.fp16)
 
         if self.mixed_precision:
             new_params = _cast_tree(new_master, self.compute_dtype)
@@ -646,7 +677,7 @@ class DeepSpeedEngine:
             master_out = None
         new_params = jax.lax.with_sharding_constraint(new_params, self.plan.param_shardings)
         return TrainState(params=new_params, master=master_out, opt_state=new_opt,
-                          scaler=new_scaler, global_step=state.global_step + 1)
+                          scaler=new_scaler, global_step=state.global_step + 1), finite
 
     # ------------------------------------------------------------------
     def _build_programs(self):
@@ -728,6 +759,11 @@ class DeepSpeedEngine:
             self._offload_finalize = jax.jit(
                 finalize, out_shardings=self.plan.grad_shardings,
                 donate_argnums=(0,))
+            # sentinel flag for the host-optimizer path: the skip decision
+            # is host-side (the host walk syncs every step anyway)
+            self._offload_finite = jax.jit(
+                lambda loss, grads: jnp.isfinite(loss)
+                & fp16_mod.grads_finite(grads), out_shardings=repl)
             self._train_step = None
             self._apply_step = None
             return
@@ -736,7 +772,8 @@ class DeepSpeedEngine:
             grads = jax.tree.map(lambda g: g * scale, grads)
             return self._apply_grads(state, grads)
 
-        self._apply_step = jax.jit(apply_step, out_shardings=ss, donate_argnums=(0,))
+        self._apply_step = jax.jit(apply_step, out_shardings=(ss, repl),
+                                   donate_argnums=(0,))
 
         if self._use_zeropp_comm():
             self._build_zeropp_programs(repl, ss)
@@ -749,14 +786,17 @@ class DeepSpeedEngine:
         def train_step(state: TrainState, batch: dict):
             """Full global-batch step: GAS scan then one update — the
             compiled analogue of forward/backward/step (reference
-            engine.py:1838/:1977/:2176)."""
+            engine.py:1838/:1977/:2176). Returns ``(state, (loss, finite))``
+            — the fused non-finite flag rides out with the loss so the
+            divergence sentinel reads it without a second program."""
             loss, grads = gas_grads(state, batch)
-            new_state = self._apply_grads(state, grads)
-            return new_state, loss
+            new_state, finite = self._apply_grads(state, grads,
+                                                  jnp.isfinite(loss))
+            return new_state, (loss, finite)
 
         self._train_step = jax.jit(
             train_step,
-            out_shardings=(ss, repl),
+            out_shardings=(ss, (repl, repl)),
             donate_argnums=(0,),
         )
 
@@ -831,9 +871,13 @@ class DeepSpeedEngine:
 
         def local_loss(p, mb, step):
             mb = dict(mb)
+            fault_scale = mb.pop("_fault_scale", None)
             mb["_train_rng"] = jax.random.fold_in(self._train_rng_base, step)
             with nn.logical_axis_rules(safe_rules):
-                return self._raw_loss_fn(p, mb)
+                loss = self._raw_loss_fn(p, mb)
+            if fault_scale is not None:
+                loss = loss * jnp.mean(fault_scale)
+            return loss
 
         def zpp_grads(params, step, batch):
             def gather(p, d):
@@ -892,10 +936,12 @@ class DeepSpeedEngine:
                 out_specs=(P(), grad_out),
                 axis_names=set(dp_axes), check_vma=False,
             )(state.params, state.opt_state.step, batch)
-            new_state = self._apply_grads(state, grads)
-            return new_state, loss
+            new_state, finite = self._apply_grads(state, grads,
+                                                  jnp.isfinite(loss))
+            return new_state, (loss, finite)
 
-        self._train_step = jax.jit(train_step, out_shardings=(ss, repl),
+        self._train_step = jax.jit(train_step,
+                                   out_shardings=(ss, (repl, repl)),
                                    donate_argnums=(0,))
 
     def _use_onebit_comm(self) -> bool:
@@ -947,9 +993,13 @@ class DeepSpeedEngine:
 
         def local_loss(p, mb, step):
             mb = dict(mb)
+            fault_scale = mb.pop("_fault_scale", None)
             mb["_train_rng"] = jax.random.fold_in(self._train_rng_base, step)
             with nn.logical_axis_rules(safe_rules):
-                return self._raw_loss_fn(p, mb)
+                loss = self._raw_loss_fn(p, mb)
+            if fault_scale is not None:
+                loss = loss * jnp.mean(fault_scale)
+            return loss
 
         def local_compute(state, mb):
             loss, grads = jax.value_and_grad(
@@ -961,6 +1011,14 @@ class DeepSpeedEngine:
         def inner(state: TrainState, batch: dict):
             master = state.master if state.master is not None else state.params
             loss_local, local_grads = gas_local(state, batch)
+            # fused non-finite flag (sentinel contract): reported, NOT
+            # gated — error-feedback state and a skipped update don't
+            # compose (the member's compensation error would double-count),
+            # so recovery on this path is rewind-only
+            finite_local = (jnp.isfinite(loss_local)
+                            & fp16_mod.grads_finite(local_grads))
+            finite = jax.lax.pmin(finite_local.astype(jnp.int32),
+                                  dp_axes).astype(jnp.bool_)
             lr = self.lr_schedule(state.opt_state.step)
             # error arrives [1, ...] (this member's slice of the stacked
             # per-device buffer)
@@ -979,7 +1037,7 @@ class DeepSpeedEngine:
             new_state = TrainState(params=new_params, master=master_out,
                                    opt_state=new_opt, scaler=None,
                                    global_step=state.global_step + 1)
-            return new_state, loss
+            return new_state, (loss, finite)
 
         state_spec = jax.tree.map(lambda _: P(), self.state)
         err_spec = jax.tree.map(lambda _: P(dp_axes), self.state.opt_state.error)
@@ -992,12 +1050,13 @@ class DeepSpeedEngine:
             # internal sharding constraints (seq/tensor rules) remain legal
             return shard_map(inner, mesh=topo.mesh,
                              in_specs=(state_spec, bspec),
-                             out_specs=(state_spec, P()),
+                             out_specs=(state_spec, (P(), P())),
                              axis_names=set(dp_axes),
                              check_vma=False)(state, batch)
 
         self._train_step = jax.jit(train_step,
-                                   out_shardings=(self._state_shardings, repl),
+                                   out_shardings=(self._state_shardings,
+                                                  (repl, repl)),
                                    donate_argnums=(0,))
 
     def _offload_apply(self, grads: Pytree) -> None:
@@ -1105,20 +1164,32 @@ class DeepSpeedEngine:
     # public API
     def train_batch(self, batch: dict) -> jax.Array:
         """Run one full training step over a global batch
-        (shape [train_batch_size, ...] per leaf)."""
+        (shape [train_batch_size, ...] per leaf).
+
+        Resilience hooks (runtime/resilience.py): a pending preemption
+        triggers a priority save + ``Preempted`` exit BEFORE the step; the
+        divergence sentinel observes the fused non-finite flag AFTER it and
+        may rewind (``engine.last_step_rewound`` — re-derive data order
+        from the restored ``engine.global_steps``) or raise
+        ``DivergenceError`` once the rewind budget is spent."""
+        res = self.resilience
+        res.check_preemption()
         self.tput_timer.start()
         self.timers(TRAIN_BATCH_TIMER).start()
         if self._param_stream is not None:
             batch = self._apply_curriculum(batch)
-            loss = self._train_batch_streamed(batch)
+            with res.guard("train_step"):
+                loss = self._train_batch_streamed(batch)
             self.global_steps += 1
             self.timers(TRAIN_BATCH_TIMER).stop(sync_val=loss)
             self.tput_timer.stop(sync_val=loss)
             if self.global_steps % self.config.steps_per_print == 0:
                 log_dist(f"step={self.global_steps} loss={float(loss):.4f}")
             self._last_loss = loss
+            res.observe_step(loss, None)
             return loss
         batch = self._apply_curriculum(batch)
+        batch = res.arm_batch(batch, self.config.train_batch_size)
         batch = self._shard_batch(self._reshape_for_gas(batch), with_gas_dim=True)
         profile_target = self._train_step if self._offload_opt is None \
             else self._offload_gas_grads
@@ -1130,13 +1201,32 @@ class DeepSpeedEngine:
                 params=self.num_parameters(),
                 latency_s=self.tput_timer.last_step_s
                 if self.config.wall_clock_breakdown else None)
+        finite = None
         if self._offload_opt is not None:
-            loss, grads = self._offload_gas_grads(self.state, batch)
-            if self.config.gradient_clipping:  # scale=1: only clip matters
-                grads = self._offload_finalize(grads, jnp.ones((), jnp.float32))
-            self._offload_apply(grads)
+            with res.guard("train_step"):
+                res.injector.maybe_stall("stall_train_step_s")
+                loss, grads = self._offload_gas_grads(self.state, batch)
+                if self.config.resilience.sentinel:
+                    finite = self._offload_finite(loss, grads)
+            if finite is not None and not bool(finite):
+                # skip-step on the host-optimizer path: the update never
+                # runs, global_step still advances (skipped_steps counts it)
+                self.state = self.state._replace(
+                    global_step=self.state.global_step + 1)
+            else:
+                if self.config.gradient_clipping:  # scale=1: only clip matters
+                    grads = self._offload_finalize(grads,
+                                                   jnp.ones((), jnp.float32))
+                self._offload_apply(grads)
         else:
-            self.state, loss = self._train_step(self.state, batch)
+            with res.guard("train_step"):
+                res.injector.maybe_stall("stall_train_step_s")
+                self.state, (loss, finite) = self._train_step(self.state, batch)
+                if res.watchdog.timeout_s > 0:
+                    # surface a device hang INSIDE the guarded region —
+                    # async dispatch would otherwise return instantly and
+                    # stall later, outside any watchdog
+                    jax.block_until_ready(loss)
         self.global_steps += 1
         if self.config.wall_clock_breakdown:
             self.timers(TRAIN_BATCH_TIMER).stop(sync_val=loss)
@@ -1147,6 +1237,7 @@ class DeepSpeedEngine:
             log_dist(f"step={self.global_steps} loss={float(loss):.4f} "
                      f"lr={float(self.lr_schedule(self.state.opt_state.step)):.3e}")
         self._last_loss = loss
+        res.observe_step(loss, finite)
         return loss
 
     def eval_batch(self, batch: dict) -> jax.Array:
@@ -1222,21 +1313,36 @@ class DeepSpeedEngine:
 
     def step(self) -> None:
         """Apply accumulated grads (reference engine.step :2176). No-op—with
-        warning—if backward hasn't run."""
+        warning—if backward hasn't run. The divergence sentinel observes
+        this path too: the fused finite flag comes from the apply program
+        (or a host check on the offload path), so a bf16 NaN streak rewinds
+        or aborts exactly as under ``train_batch``."""
         if self._accum_grads is None:
             logger.warning("step() called with no accumulated gradients")
             return
         self.timers(STEP_GLOBAL_TIMER).start()
         scale = jnp.asarray(1.0 / max(self._accum_count, 1), jnp.float32)
         if self._offload_opt is not None:
+            finite = self._offload_finite(self._last_loss, self._accum_grads) \
+                if self.config.resilience.sentinel \
+                and self._last_loss is not None else None
             grads = self._offload_finalize(self._accum_grads, scale)
-            self._offload_apply(grads)
+            if finite is not None and not bool(finite):
+                # skip-step (host decision, like train_batch's offload path)
+                self.state = self.state._replace(
+                    global_step=self.state.global_step + 1)
+            else:
+                self._offload_apply(grads)
         else:
-            self.state = self._apply_step(self.state, self._accum_grads, scale)
+            self.state, finite = self._apply_step(
+                self.state, self._accum_grads, scale)
+        self._last_step_finite = finite
         self._accum_grads = None
         self._accum_count = 0
         self.global_steps += 1
         self.timers(STEP_GLOBAL_TIMER).stop()
+        if self._last_loss is not None:
+            self.resilience.observe_step(self._last_loss, finite)
 
     def zero_grad(self) -> None:
         self._accum_grads = None
@@ -1284,6 +1390,30 @@ class DeepSpeedEngine:
         self.state = None
         self._param_stream = None
 
+    # --- resilience surface (runtime/resilience.py) ---------------------
+    @property
+    def last_step_rewound(self) -> bool:
+        """True when the immediately preceding ``train_batch`` ended in a
+        sentinel rewind — the driver should re-derive its data position
+        from the restored ``global_steps``."""
+        return self.resilience.last_step_rewound
+
+    @property
+    def resilience_counters(self) -> dict:
+        """Host-side resilience counters (bad/skipped steps, rewinds,
+        preemptions, aborts) — also emitted through monitor/ backends."""
+        return dict(self.resilience.counters)
+
+    def _emit_counters(self, counters: dict, prefix: str) -> None:
+        """Fan resilience/checkpoint counters out to the configured
+        monitor/ backends (lazy MonitorMaster; no-op when none enabled)."""
+        if self._monitor_master is None:
+            from ..monitor import MonitorMaster
+
+            self._monitor_master = MonitorMaster(self.config)
+        self._monitor_master.write_counters(counters, self.global_steps,
+                                            prefix=prefix)
+
     # --- checkpointing (reference engine.py:3109/:2763) -----------------
     def save_checkpoint(self, save_dir: str, tag: str | None = None,
                         client_state: dict | None = None) -> str:
@@ -1308,13 +1438,16 @@ class DeepSpeedEngine:
     def load_checkpoint(self, load_dir: str, tag: str | None = None) -> dict:
         from .checkpointing import load_checkpoint as _load
 
-        return _load(self, load_dir, tag=tag)
+        with self.resilience.guard("checkpoint_restore"):
+            return _load(self, load_dir, tag=tag)
 
-    def wait_for_checkpoint(self) -> None:
-        """Block until an async checkpoint save has committed."""
+    def wait_for_checkpoint(self, timeout_s: float | None = None) -> None:
+        """Block until an async checkpoint save has committed. Bounded by
+        ``timeout_s`` (default ``checkpoint.wait_timeout_s``); a wedged
+        save thread raises ``CheckpointWaitTimeout`` instead of hanging."""
         from .checkpointing import wait_for_checkpoint as _wait
 
-        _wait(self)
+        _wait(self, timeout_s=timeout_s)
 
 
 # --------------------------------------------------------------------------

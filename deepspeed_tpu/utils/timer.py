@@ -29,8 +29,11 @@ def _sync(sync_val: Any | None = None) -> None:
 
             jax.block_until_ready(sync_val)
             return
-        except Exception:
-            pass
+        except Exception as e:  # timing degrades to dispatch time, say so
+            from .logging import logger
+
+            logger.debug(f"timer sync failed ({e!r}); measuring dispatch "
+                         f"time only")
 
 
 class _Timer:

@@ -37,6 +37,8 @@ HEAVY = [
     # TP>=2 ring collective-matmul parity: engine builds on 2- and 4-way
     # CPU meshes (several full engine compiles) — spread early
     "test_tensor_parallel.py",
+    # crash-recovery matrix: tiny-gpt2 engines on two mesh shapes
+    "test_resilience.py",
 ]
 
 

@@ -1,18 +1,26 @@
 """Repo lint: no module-import-time jax device probes outside _jax_compat
 (bin/check_import_time_devices.py — the round-5 postmortem rule: the first
 ``jax.devices()`` belongs behind a watchdog at CALL time, and import-time
-probes freeze the platform before set_cpu_devices can run)."""
+probes freeze the platform before set_cpu_devices can run), and no silent
+``except Exception: pass`` swallows (bin/check_exception_swallows.py —
+recovery paths must not eat the faults the resilience layer surfaces)."""
 import importlib.util
 import os
 
 HERE = os.path.dirname(os.path.abspath(__file__))
 ROOT = os.path.dirname(HERE)
 
-spec = importlib.util.spec_from_file_location(
-    "check_import_time_devices",
-    os.path.join(ROOT, "bin", "check_import_time_devices.py"))
-lint = importlib.util.module_from_spec(spec)
-spec.loader.exec_module(lint)
+
+def _load(name):
+    spec = importlib.util.spec_from_file_location(
+        name, os.path.join(ROOT, "bin", f"{name}.py"))
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+lint = _load("check_import_time_devices")
+swallows = _load("check_exception_swallows")
 
 
 def test_repo_has_no_import_time_device_probes():
@@ -43,3 +51,52 @@ def test_detector_flags_import_time_default_args(tmp_path):
         "def f(n=len(jax.devices())):\n"
         "    return n\n")
     assert len(lint.check_file(str(bad))) == 1
+
+
+# --- silent broad-exception swallows ---------------------------------------
+
+def test_repo_has_no_silent_exception_swallows():
+    violations = swallows.check_repo(ROOT)
+    assert violations == [], "\n".join(violations)
+
+
+def test_swallow_detector_flags_silent_broad_handlers(tmp_path):
+    bad = tmp_path / "bad.py"
+    bad.write_text(
+        "def f():\n"
+        "    try:\n"
+        "        work()\n"
+        "    except Exception:\n"       # silent broad: flagged
+        "        pass\n"
+        "    try:\n"
+        "        work()\n"
+        "    except:\n"                 # silent bare: flagged
+        "        ...\n"
+        "    try:\n"
+        "        work()\n"
+        "    except (ValueError, Exception):\n"  # broad inside tuple: flagged
+        "        pass\n")
+    out = swallows.check_file(str(bad))
+    assert len(out) == 3
+    assert ":4:" in out[0] and ":8:" in out[1] and ":12:" in out[2]
+
+
+def test_swallow_detector_allows_narrow_logged_and_del(tmp_path):
+    ok = tmp_path / "ok.py"
+    ok.write_text(
+        "def f():\n"
+        "    try:\n"
+        "        work()\n"
+        "    except OSError:\n"          # narrow: a documented condition
+        "        pass\n"
+        "    try:\n"
+        "        work()\n"
+        "    except Exception as e:\n"   # broad but handled (logged)
+        "        log(e)\n"
+        "class C:\n"
+        "    def __del__(self):\n"
+        "        try:\n"
+        "            self.close()\n"
+        "        except Exception:\n"    # shutdown teardown race: idiomatic
+        "            pass\n")
+    assert swallows.check_file(str(ok)) == []
