@@ -379,6 +379,9 @@ def fastgen_main(emit: bool = True, *, n_req=None, prompt_mu=None,
             config={"block_size": block_size, "num_blocks": n_blocks,
                     "max_seqs": max_live, "chunk": chunk,
                     "max_seq_len": MAX_LEN,
+                    # SLO histograms ride along for free in the artifact
+                    # (host-side dict ops; BENCH_TELEMETRY=0 disables)
+                    "telemetry": os.environ.get("BENCH_TELEMETRY") != "0",
                     **({"decode_window": decode_window}
                        if decode_window else {}),
                     **({"max_inflight": max_inflight}
@@ -406,6 +409,10 @@ def fastgen_main(emit: bool = True, *, n_req=None, prompt_mu=None,
             if k == "d2h_latency_s":    # one-time init-probe, not a counter
                 continue
             eng.stats[k] = 0 if isinstance(eng.stats[k], int) else 0.0
+        # zero the telemetry registry like the stats dict: each measured
+        # run's histograms stand alone in the artifact
+        if eng._telem.enabled:
+            eng._telem.registry.reset()
         if trace_dir:
             import contextlib
             import shutil
@@ -518,6 +525,11 @@ def fastgen_main(emit: bool = True, *, n_req=None, prompt_mu=None,
                  "tp_ring_matmuls", "tp_ring_steps", "tp_bytes_permuted",
                  "tp_fallbacks")},
             "device_probe": device_probe,
+            # telemetry snapshot (telemetry/): the SLO latency histograms
+            # as percentile summaries — TTFT/TBT/queue-wait/occupancy per
+            # measured run, for free next to the SLA scalars above
+            "telemetry": eng._telem.slo_summary() if eng._telem.enabled
+            else None,
         }
 
     eng_main, probe_main = build_engine(max_seqs)

@@ -1,0 +1,127 @@
+#!/usr/bin/env python
+"""Repo lint: every emitted metric/span tag must be a valid Prometheus
+metric name after sanitization.
+
+The /metrics endpoint (telemetry/exposition.py) renders every registered
+metric; a tag that can't sanitize to ``[a-zA-Z_:][a-zA-Z0-9_:]*`` would make
+the exposition raise — a 500 on every scrape until someone notices the
+dashboard went dark. The registry already raises at CREATION time
+(telemetry/metrics.py ``sanitize_metric_name``), but that fires on the
+first hot-path emit of a rarely-taken branch; this lint moves the failure
+to test time by checking every STRING LITERAL passed as the first argument
+of a metric/span emit call (``counter``/``gauge``/``histogram``/``span``/
+``step_span``/``note``) plus ``write_counters`` tag prefixes.
+
+Dynamic (non-literal) names can't be checked statically — the runtime
+sanitizer remains the backstop for those.
+
+Usage: ``python bin/check_metric_names.py [root]`` — prints violations as
+``path:line: message``, exits nonzero if any. Enforced from
+tests/test_repo_lint.py.
+"""
+from __future__ import annotations
+
+import ast
+import os
+import re
+import sys
+
+#: method names whose first string-literal argument is a metric/span tag
+EMIT_METHODS = ("counter", "gauge", "histogram", "span", "step_span", "note")
+
+#: methods whose ``prefix`` kwarg (or the given positional index) prepends
+#: to metric tags — write_counters(counters, step, prefix) and the
+#: engine's _emit_counters(counters, prefix) that forwards to it
+PREFIX_METHODS = {"write_counters": 2, "_emit_counters": 1}
+
+_VALID_NAME = re.compile(r"[a-zA-Z_:][a-zA-Z0-9_:]*\Z")
+_INVALID_CHARS = re.compile(r"[^a-zA-Z0-9_:]")
+
+
+def sanitize(name: str) -> str:
+    """Mirror of telemetry/metrics.py ``sanitize_metric_name`` (kept
+    dependency-free so the lint never imports jax); a drift test in
+    tests/test_telemetry.py pins the two together."""
+    out = _INVALID_CHARS.sub("_", name)
+    if out and out[0].isdigit():
+        out = "_" + out
+    return out
+
+
+def tag_problem(tag: str) -> str | None:
+    """None if ``tag`` survives sanitization as a valid Prometheus name."""
+    s = sanitize(tag)
+    if not _VALID_NAME.fullmatch(s):
+        return (f"tag {tag!r} sanitizes to {s!r}, which is not a valid "
+                f"Prometheus metric name ([a-zA-Z_:][a-zA-Z0-9_:]*)")
+    return None
+
+
+def _literal_tags(node: ast.Call) -> list[tuple[str, str]]:
+    """(role, literal) tags this emit call carries, if statically known."""
+    f = node.func
+    if not isinstance(f, ast.Attribute):
+        return []
+    out: list[tuple[str, str]] = []
+    if f.attr in EMIT_METHODS and node.args \
+            and isinstance(node.args[0], ast.Constant) \
+            and isinstance(node.args[0].value, str):
+        out.append((f.attr, node.args[0].value))
+    if f.attr in PREFIX_METHODS:
+        idx = PREFIX_METHODS[f.attr]
+        for kw in node.keywords:
+            if kw.arg == "prefix" and isinstance(kw.value, ast.Constant) \
+                    and isinstance(kw.value.value, str):
+                out.append((f.attr, kw.value.value + "x"))  # prefix + tag
+        if len(node.args) > idx and isinstance(node.args[idx], ast.Constant) \
+                and isinstance(node.args[idx].value, str):
+            out.append((f.attr, node.args[idx].value + "x"))
+    return out
+
+
+def check_file(path: str) -> list[str]:
+    with open(path, encoding="utf-8") as f:
+        src = f.read()
+    try:
+        tree = ast.parse(src, filename=path)
+    except SyntaxError as e:
+        return [f"{path}:{e.lineno}: unparseable ({e.msg})"]
+    out: list[str] = []
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Call):
+            continue
+        for role, tag in _literal_tags(node):
+            problem = tag_problem(tag)
+            if problem:
+                out.append(f"{path}:{node.lineno}: {role}() {problem}")
+    return out
+
+
+def check_repo(root: str) -> list[str]:
+    out: list[str] = []
+    targets = []
+    for dirpath, _, files in os.walk(os.path.join(root, "deepspeed_tpu")):
+        targets += [os.path.join(dirpath, f) for f in files
+                    if f.endswith(".py")]
+    for extra in ("bench.py",):
+        p = os.path.join(root, extra)
+        if os.path.exists(p):
+            targets.append(p)
+    for path in sorted(targets):
+        out += check_file(path)
+    return out
+
+
+def main(argv: list[str]) -> int:
+    root = argv[1] if len(argv) > 1 else \
+        os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    violations = check_repo(root)
+    for v in violations:
+        print(v)
+    if violations:
+        print(f"{len(violations)} un-exposable metric/span tag(s) found")
+    return 1 if violations else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main(sys.argv))

@@ -208,6 +208,9 @@ class MonitorBackendConfig:
     enabled: bool = False
     output_path: str = ""
     job_name: str = "DeepSpeedTPUJob"
+    # prometheus extras: scrape endpoint port (None = render-only, no HTTP
+    # server; 0 = ephemeral port, logged at startup)
+    port: int | None = None
     # wandb extras
     team: str | None = None
     group: str | None = None
@@ -219,6 +222,40 @@ class MonitorBackendConfig:
     experiment_key: str | None = None
     online: bool | None = None
     mode: str | None = None
+
+
+@dataclass
+class TelemetryConfig:
+    """Unified observability (telemetry/): span tracer, metrics registry
+    with serving-SLO + training-health instruments, MFU/goodput, optional
+    Prometheus HTTP endpoint, flight recorder.
+
+    No single reference analogue — the reference scatters this across
+    monitor/, comms_logger and the flops profiler; here one process-wide
+    substrate feeds all of them. Everything degrades to no-ops when
+    disabled (DS_TPU_TELEMETRY=1 enables without a config edit)."""
+    enabled: bool = False
+    #: span ring-buffer capacity (most recent N spans retained)
+    span_buffer: int = 4096
+    #: mirror spans into jax.profiler Trace/StepTraceAnnotation so host
+    #: spans overlay the xplane device trace (profiling/trace.py)
+    mirror_jax: bool = True
+    #: serve /metrics + /healthz on this port (None = off; 0 = ephemeral)
+    http_port: int | None = None
+    #: flight recorder: discrete events retained for postmortem dumps
+    flight_recorder: int = 256
+    #: where watchdog/divergence dumps land (None → DS_TPU_FLIGHT_RECORDER
+    #: env var, else log-only)
+    flight_recorder_path: str | None = None
+    #: MFU denominator override (per-chip dense bf16 peak); None = probe
+    #: the device kind (telemetry/mfu.py table; unknown/CPU → no MFU gauge)
+    peak_tflops: float | None = None
+
+    def __post_init__(self):
+        if self.span_buffer < 1:
+            raise ValueError("telemetry.span_buffer must be >= 1")
+        if self.flight_recorder < 1:
+            raise ValueError("telemetry.flight_recorder must be >= 1")
 
 
 @dataclass
@@ -416,6 +453,9 @@ class Config:
     csv_monitor: MonitorBackendConfig = field(default_factory=MonitorBackendConfig)
     wandb: MonitorBackendConfig = field(default_factory=MonitorBackendConfig)
     comet: MonitorBackendConfig = field(default_factory=MonitorBackendConfig)
+    prometheus: MonitorBackendConfig = field(
+        default_factory=MonitorBackendConfig)
+    telemetry: TelemetryConfig = field(default_factory=TelemetryConfig)
     data_types: DataTypesConfig = field(default_factory=DataTypesConfig)
     checkpoint: CheckpointConfig = field(default_factory=CheckpointConfig)
     resilience: ResilienceConfig = field(default_factory=ResilienceConfig)
@@ -455,6 +495,8 @@ class Config:
             "csv_monitor": MonitorBackendConfig,
             "wandb": MonitorBackendConfig,
             "comet": MonitorBackendConfig,
+            "prometheus": MonitorBackendConfig,
+            "telemetry": TelemetryConfig,
             "data_types": DataTypesConfig,
             "checkpoint": CheckpointConfig,
             "resilience": ResilienceConfig,

@@ -82,7 +82,39 @@ def feature_report() -> list[tuple[str, bool, str]]:
     # C++ toolchain (for building native ops from source)
     cxx = shutil.which("g++") or shutil.which("clang++")
     feats.append(("C++ toolchain", cxx is not None, cxx or "no g++/clang++"))
+
+    # telemetry / monitor backends (telemetry/ + monitor/): which push
+    # backends can actually activate, and where the pull endpoint +
+    # flight recorder would land for this process
+    for name, mods in (("monitor: tensorboard",
+                        ("torch.utils.tensorboard", "tensorboardX")),
+                       ("monitor: wandb", ("wandb",)),
+                       ("monitor: comet", ("comet_ml",))):
+        hit = next((m for m in mods if _importable(m)), None)
+        feats.append((name, hit is not None,
+                      f"{hit} importable" if hit else "package not installed"))
+    feats.append(("monitor: prometheus", True,
+                  "stdlib exposition (always available)"))
+    port = os.environ.get("DS_TPU_TELEMETRY_PORT")
+    telem_on = os.environ.get("DS_TPU_TELEMETRY", "") not in ("", "0", "false")
+    feats.append((
+        "telemetry (spans/metrics/SLOs)", True,
+        ("enabled via DS_TPU_TELEMETRY" if telem_on
+         else "disabled (config telemetry.enabled / DS_TPU_TELEMETRY=1)")
+        + (f", /metrics port {port}" if port else ", no HTTP port")))
+    fr = os.environ.get("DS_TPU_FLIGHT_RECORDER")
+    feats.append(("flight recorder", True,
+                  f"dumps to {fr}" if fr
+                  else "log-only (set DS_TPU_FLIGHT_RECORDER or "
+                       "telemetry.flight_recorder_path)"))
     return feats
+
+
+def _importable(mod_name: str) -> bool:
+    try:
+        return importlib.util.find_spec(mod_name) is not None
+    except (ImportError, ValueError, ModuleNotFoundError):
+        return False
 
 
 def main(hide_errors: bool = False) -> str:

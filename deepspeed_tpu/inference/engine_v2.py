@@ -199,6 +199,14 @@ class RaggedInferenceConfig:
     #: True/False forces the choice for every quantized dense matmul
     #: (profiling escape hatch; int4 always keeps the Pallas kernel).
     quant_small_m_xla: bool | None = None
+    #: serving-SLO telemetry (telemetry/): TTFT / time-between-tokens /
+    #: queue-wait histograms, per-step occupancy, KV-page utilization,
+    #: host spans around dispatch/drain. True enables the PROCESS-WIDE
+    #: telemetry instance (shared /metrics with training + monitor
+    #: backends); None follows its current state (DS_TPU_TELEMETRY /
+    #: a training engine's config section); False pins this engine to a
+    #: private disabled instance regardless.
+    telemetry: bool | None = None
 
 
 class InferenceEngineV2:
@@ -392,6 +400,19 @@ class InferenceEngineV2:
         # riding d2h; committed lazily (see _drain)
         from collections import deque
         self._inflight: deque = deque()
+        # serving SLO instruments (telemetry/) — all no-ops when disabled
+        from .. import telemetry as _telemetry
+        if cfg.telemetry:
+            _telemetry.configure(enabled=True)
+        self._telem = _telemetry.get_telemetry() if cfg.telemetry is not False \
+            else _telemetry.Telemetry(enabled=False)
+        self.scheduler._telem = self._telem   # cfg.telemetry=False pins both
+        self._admit_t: dict[int, float] = {}      # uid → put() time
+        self._first_sched: set[int] = set()       # uids past their 1st chunk
+        self._last_commit_t: dict[int, float] = {}
+        if self._telem.enabled:
+            self._telem.set_health(serving=True, max_seqs=cfg.max_seqs,
+                                   num_blocks=cfg.num_blocks)
         # mixed-load alternation: True → the next dispatch prefers the
         # decode window/plan over another prefill step
         self._serve_toggle = False
@@ -1602,11 +1623,12 @@ class InferenceEngineV2:
         self.stats["plan_s"] += time.perf_counter() - t0
 
         t0 = time.perf_counter()
-        fn = self._window_program(W)
-        self._rng, sub = jax.random.split(self._rng)
-        self.kv_pool, self._last_tok, toks, iters = fn(
-            self.params, self.kv_pool, self._last_tok, tok0, use_last,
-            pos0, lens0, tables, rem, eos, sub)
+        with self._telem.span("dispatch", kind="window", W=W):
+            fn = self._window_program(W)
+            self._rng, sub = jax.random.split(self._rng)
+            self.kv_pool, self._last_tok, toks, iters = fn(
+                self.params, self.kv_pool, self._last_tok, tok0, use_last,
+                pos0, lens0, tables, rem, eos, sub)
         # dispatch-time speculative advance: KV for positions up to
         # len_sched-1+n-1 is now scheduled, n new samples are in flight
         for s in live:
@@ -1621,6 +1643,10 @@ class InferenceEngineV2:
         self.stats["dispatch_s"] += time.perf_counter() - t0
         self.stats["dispatches"] += 1
         self.stats["windows"] += 1
+        if self._telem.enabled:
+            # window occupancy is row-based: live decoders / max slots
+            self._record_dispatch_telemetry("decode_window", len(live),
+                                            self.state.max_seqs, ())
         return True
 
     def _dispatch_next(self) -> bool:
@@ -1658,13 +1684,14 @@ class InferenceEngineV2:
                     f"chunks starting page-misaligned (slot_map col 0 = "
                     f"{plan.slot_map[bad, 0].tolist()}, block_size {bs})")
         t0 = time.perf_counter()
-        fn = self._program(T, plan.token_ids.shape[0])
-        self._rng, sub = jax.random.split(self._rng)
-        self.kv_pool, self._last_tok, toks = fn(
-            self.params, self.kv_pool, self._last_tok,
-            plan.token_ids, plan.positions, plan.slot_map,
-            plan.block_tables, plan.seq_lens, plan.sample_idx,
-            plan.do_sample, plan.use_last, plan.row_slots, sub)
+        with self._telem.span("dispatch", kind=plan.kind):
+            fn = self._program(T, plan.token_ids.shape[0])
+            self._rng, sub = jax.random.split(self._rng)
+            self.kv_pool, self._last_tok, toks = fn(
+                self.params, self.kv_pool, self._last_tok,
+                plan.token_ids, plan.positions, plan.slot_map,
+                plan.block_tables, plan.seq_lens, plan.sample_idx,
+                plan.do_sample, plan.use_last, plan.row_slots, sub)
         self.scheduler.mark_dispatched(plan)
         toks.copy_to_host_async()
         self._inflight.append({"kind": "plan", "plan": plan, "toks": toks,
@@ -1683,6 +1710,10 @@ class InferenceEngineV2:
         else:
             self.stats["decode_steps"] += 1
             self.stats["decode_tokens"] += n_tok
+        if self._telem.enabled:
+            self._record_dispatch_telemetry(
+                plan.kind, n_tok, int(np.prod(plan.token_ids.shape)),
+                plan.uids)
         return True
 
     def _drain(self, force: bool = False, drain_all: bool = False) -> dict:
@@ -1705,7 +1736,8 @@ class InferenceEngineV2:
             if not ready:
                 self.stats["forced_drains"] += 1
                 t0 = time.perf_counter()
-                toks_h = np.asarray(entry["toks"])
+                with self._telem.span("drain_block", kind=entry["kind"]):
+                    toks_h = np.asarray(entry["toks"])
                 self.stats["drain_block_s"] += time.perf_counter() - t0
             else:
                 self.stats["opportunistic_drains"] += 1
@@ -1715,6 +1747,8 @@ class InferenceEngineV2:
             t0 = time.perf_counter()
             self._commit_entry(entry, toks_h, emitted)
             self.stats["commit_s"] += time.perf_counter() - t0
+        if emitted and self._telem.enabled:
+            self._record_commit_telemetry(emitted)
         return emitted
 
     def _commit_entry(self, entry: dict, toks_h: np.ndarray,
@@ -1765,6 +1799,11 @@ class InferenceEngineV2:
             raise RuntimeError("cannot schedule: pool/slots exhausted")
         self.state.admit(uid, toks, max_new_tokens, eos_id=eos_token_id)
         self._results[uid] = []
+        if self._telem.enabled:
+            self._admit_t[uid] = time.perf_counter()
+            self._telem.registry.counter(
+                "serving_requests_total",
+                help="requests admitted (put)").inc()
 
     def query(self, uid: int) -> dict:
         """Request status (reference ``query`` :158)."""
@@ -1795,7 +1834,78 @@ class InferenceEngineV2:
             self._drain(force=True)         # pops (at least) the oldest
         if uid in self.state.seqs:
             self.state.release(uid)
+        self._admit_t.pop(uid, None)
+        self._first_sched.discard(uid)
+        self._last_commit_t.pop(uid, None)
         return self._results.pop(uid, [])
+
+    def _record_dispatch_telemetry(self, kind: str, useful: int,
+                                   budget: int, uids) -> None:
+        """Dispatch-side SLO instruments: queue wait (admission → first
+        scheduled prefill chunk), per-step occupancy (useful/budget — the
+        honest prefill-MFU accounting as a live histogram), KV-page
+        utilization. Caller gates on ``self._telem.enabled``."""
+        from ..telemetry import RATIO_BUCKETS
+
+        now = time.perf_counter()
+        reg = self._telem.registry
+        for uid in uids:
+            if uid >= 0 and uid not in self._first_sched:
+                self._first_sched.add(uid)
+                t_admit = self._admit_t.get(uid)
+                if t_admit is not None:
+                    reg.histogram(
+                        "serving_queue_wait_s",
+                        help="admission (put) → first scheduled prefill "
+                             "chunk").observe(now - t_admit)
+        if budget > 0:
+            reg.histogram(
+                f"serving_{kind}_occupancy", buckets=RATIO_BUCKETS,
+                help="useful fraction of the step's paid token/row budget"
+            ).observe(useful / budget)
+        if kind in ("prefill", "decode"):
+            # the prefill-vs-decode token split (window tokens land on the
+            # commit side as serving_tokens_total — speculative here)
+            reg.counter(f"serving_{kind}_tokens_total",
+                        help="useful tokens dispatched in pure "
+                             f"{kind} plans").inc(useful)
+        alloc = self.state.allocator
+        cap = max(alloc.num_blocks - 1, 1)      # block 0 is the trash slot
+        reg.gauge("serving_kv_page_utilization",
+                  help="allocated fraction of the paged KV pool").set(
+            1.0 - alloc.free_blocks / cap)
+
+    def _record_commit_telemetry(self, emitted: dict) -> None:
+        """Commit-side SLOs: TTFT (admission → first committed token) and
+        observed per-token time-between-tokens — a window committing n
+        tokens dt after the previous commit contributes n samples of dt/n
+        (the bench's amortized-burst convention, live)."""
+        now = time.perf_counter()
+        reg = self._telem.registry
+        total = 0
+        for uid, toks in emitted.items():
+            n = len(toks)
+            if not n:
+                continue
+            total += n
+            last = self._last_commit_t.get(uid)
+            if last is None:
+                t_admit = self._admit_t.get(uid)
+                if t_admit is not None:
+                    reg.histogram(
+                        "serving_ttft_s",
+                        help="admission (put) → first committed token"
+                    ).observe(now - t_admit)
+            else:
+                reg.histogram(
+                    "serving_tbt_s",
+                    help="observed per-token time between committed tokens"
+                ).observe((now - last) / n, n=n)
+            self._last_commit_t[uid] = now
+        if total:
+            reg.counter("serving_tokens_total",
+                        help="committed (accepted) generated tokens"
+                        ).inc(total)
 
     def _refresh_tp_stats(self) -> None:
         """Accumulate the ring collective-matmul counters (trace-time,

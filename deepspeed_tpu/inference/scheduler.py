@@ -18,6 +18,7 @@ from __future__ import annotations
 
 import numpy as np
 
+from ..telemetry import get_telemetry
 from .ragged import SequenceDescriptor, StateManager, StepPlan
 
 
@@ -25,6 +26,9 @@ class SplitFuseScheduler:
     def __init__(self, state: StateManager, chunk: int, pack: bool = False):
         self.state = state
         self.chunk = chunk
+        # process-wide telemetry (telemetry/); configure() mutates the
+        # instance in place, so caching the reference here stays live
+        self._telem = get_telemetry()
         #: token-budget prefill packing (VERDICT r04 weak #2: prefill
         #: steps ran 44% useful tokens): when fewer than max_seqs rows
         #: have work, the plan carries EXACTLY the rows that have work
@@ -178,7 +182,31 @@ class SplitFuseScheduler:
                 T //= 2
         return out
 
+    def queue_depth(self) -> int:
+        """Sequences with unscheduled work — the serving backlog gauge."""
+        return sum(1 for seq in self.state.seqs.values()
+                   if not seq.sched_done)
+
     def next_step(self, prefer: str | None = None) -> StepPlan | None:
+        """Plan-building entry point; see :meth:`_next_step_inner` for the
+        policy. Telemetry wrapper: plan construction runs under a
+        ``sched_plan`` span and the queue-depth gauge updates per call —
+        host plan-build time showing up here is the signal that the C++
+        atom builder (csrc) stopped engaging."""
+        telem = self._telem
+        if not telem.enabled:
+            return self._next_step_inner(prefer)
+        telem.registry.gauge(
+            "serving_queue_depth",
+            help="sequences with unscheduled work").set(self.queue_depth())
+        with telem.span("sched_plan") as sp:
+            plan = self._next_step_inner(prefer)
+            if plan is not None:
+                sp.set(kind=plan.kind, rows=plan.token_ids.shape[0],
+                       T=plan.token_ids.shape[1])
+        return plan
+
+    def _next_step_inner(self, prefer: str | None = None) -> StepPlan | None:
         """Build the next step plan, or None if nothing to run.
 
         Plans from the SCHEDULED (speculative) view so the engine can

@@ -103,6 +103,45 @@ class CometMonitor(Monitor):
             self.experiment.flush()
 
 
+class PrometheusMonitor(Monitor):
+    """Prometheus text-format exposition of monitor events.
+
+    No reference analogue (the reference monitor/ pushes to TB/W&B/CSV);
+    production serving wants a PULL endpoint. Events land as gauges named
+    by their sanitized tag in the PROCESS-WIDE telemetry registry
+    (telemetry/), so one ``/metrics`` page carries both the write_events
+    stream (Resilience/*, Train/*, user scalars) and the engines' native
+    SLO instruments. ``config.port`` starts the stdlib HTTP endpoint
+    (0 = ephemeral); ``port: null`` keeps it render-only — reachable via
+    ``telemetry.get_telemetry().registry.render_prometheus()`` or a
+    later ``start_http``."""
+
+    def __init__(self, config):
+        super().__init__(config)
+        self.registry = None
+        if not self.enabled:
+            return
+        from ..telemetry import get_telemetry, sanitize_metric_name
+
+        self._sanitize = sanitize_metric_name
+        telem = get_telemetry()
+        self.registry = telem.registry
+        port = getattr(config, "port", None)
+        if port is not None:
+            try:
+                telem.start_http(int(port))
+            except OSError as e:   # a busy port must not kill training
+                logger.warning(f"prometheus monitor: cannot bind port "
+                               f"{port} ({e}); exposition is render-only")
+
+    def write_events(self, event_list: Sequence[tuple]) -> None:
+        if not self.enabled:
+            return
+        for tag, value, step in event_list:
+            self.registry.gauge(self._sanitize(tag)).set(float(value))
+            self.registry.gauge("monitor_last_step").set(float(step))
+
+
 class CSVMonitor(Monitor):
     """One csv per tag under output_path/job_name (reference
     csv_monitor.py)."""

@@ -32,14 +32,16 @@ class MonitorMaster(Monitor):
     monitor.py:30)."""
 
     def __init__(self, config):
-        from .backends import (CometMonitor, CSVMonitor, TensorBoardMonitor,
-                               WandbMonitor)
+        from .backends import (CometMonitor, CSVMonitor, PrometheusMonitor,
+                               TensorBoardMonitor, WandbMonitor)
 
         self.backends: list[Monitor] = []
+        self._backend_warned: set[str] = set()
         for attr, cls in (("tensorboard", TensorBoardMonitor),
                           ("wandb", WandbMonitor),
                           ("csv_monitor", CSVMonitor),
-                          ("comet", CometMonitor)):
+                          ("comet", CometMonitor),
+                          ("prometheus", PrometheusMonitor)):
             sub = getattr(config, attr, None)
             if sub is not None and getattr(sub, "enabled", False):
                 backend = cls(sub)
@@ -47,9 +49,25 @@ class MonitorMaster(Monitor):
                     self.backends.append(backend)
         self.enabled = bool(self.backends)
 
+    def _guarded(self, backend: Monitor, method: str, *args) -> None:
+        """One failing backend (full disk under CSV, a wandb network blip)
+        must never raise out of the train step or starve the others —
+        isolate, warn ONCE per backend+method, keep fanning out."""
+        try:
+            getattr(backend, method)(*args)
+        except Exception as e:
+            from ..utils.logging import logger
+
+            key = f"{type(backend).__name__}.{method}"
+            if key not in self._backend_warned:
+                self._backend_warned.add(key)
+                logger.warning(
+                    f"monitor backend {key} failed ({e!r}); further "
+                    f"failures of this backend are suppressed")
+
     def write_events(self, event_list: Sequence[Event]) -> None:
         for b in self.backends:
-            b.write_events(event_list)
+            self._guarded(b, "write_events", event_list)
 
     def write_counters(self, counters: dict, step: int,
                        prefix: str = "") -> None:
@@ -61,7 +79,11 @@ class MonitorMaster(Monitor):
             return
         self.write_events([(f"{prefix}{k}", float(v), int(step))
                            for k, v in counters.items()])
+        # counter emissions are low-frequency (steps_per_print / recovery
+        # events) and exist to be LOOKED AT — flush through to disk/backends
+        # so a crash right after doesn't eat the last window
+        self.flush()
 
     def flush(self) -> None:
         for b in self.backends:
-            b.flush()
+            self._guarded(b, "flush")

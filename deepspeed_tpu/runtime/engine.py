@@ -41,8 +41,11 @@ from ..parallel.topology import BATCH_AXES, MeshTopology
 from ..utils.logging import log_dist, logger
 from ..utils.timer import (
     BACKWARD_GLOBAL_TIMER,
+    BACKWARD_MICRO_TIMER,
     FORWARD_GLOBAL_TIMER,
+    FORWARD_MICRO_TIMER,
     STEP_GLOBAL_TIMER,
+    STEP_MICRO_TIMER,
     TRAIN_BATCH_TIMER,
     SynchronizedWallClockTimer,
     ThroughputTimer,
@@ -274,6 +277,25 @@ class DeepSpeedEngine:
 
         self.resilience = ResilienceManager(self, config.resilience)
         self._monitor_master = None   # lazy MonitorMaster (monitor/)
+
+        # telemetry (telemetry/): spans + SLO/health metrics + MFU/goodput
+        # + flight recorder. The process-wide instance is shared with
+        # engine_v2 / checkpointing / resilience so /metrics is one pane;
+        # configure() mutates it in place when this engine enables it.
+        from .. import telemetry as _telemetry
+
+        if config.telemetry.enabled:
+            _telemetry.configure(config.telemetry)
+        self._telem = _telemetry.get_telemetry()
+        self._mfu_tracker: _telemetry.MFUTracker | None = None
+        self._step_flops: float | None = None  # lazy XLA cost-model read
+        if self._telem.enabled:
+            peak = (config.telemetry.peak_tflops * 1e12
+                    if config.telemetry.peak_tflops
+                    else _telemetry.device_peak_flops())
+            self._mfu_tracker = _telemetry.MFUTracker(peak_flops=peak)
+            self._telem.set_health(job="train",
+                                   zero_stage=config.zero_optimization.stage)
         self._resume_tag: str | None = None
         self._ckpt_commit_error = None
 
@@ -1171,7 +1193,24 @@ class DeepSpeedEngine:
         divergence sentinel observes the fused non-finite flag AFTER it and
         may rewind (``engine.last_step_rewound`` — re-derive data order
         from the restored ``engine.global_steps``) or raise
-        ``DivergenceError`` once the rewind budget is spent."""
+        ``DivergenceError`` once the rewind budget is spent.
+
+        Telemetry (telemetry/): when enabled, the step runs under a
+        ``StepTraceAnnotation``-mirrored span (host timeline overlays the
+        xplane device trace) and feeds the training-health instruments —
+        step-time histogram, tokens/s, MFU, and goodput that discounts
+        sentinel-skipped and rewound steps."""
+        telem = self._telem
+        if not telem.enabled:
+            return self._train_batch_inner(batch)
+        step_before = self.global_steps
+        skipped_before = self.skipped_steps
+        with telem.step_span("train_batch", self.global_steps):
+            loss = self._train_batch_inner(batch)
+        self._record_train_telemetry(batch, step_before, skipped_before)
+        return loss
+
+    def _train_batch_inner(self, batch: dict) -> jax.Array:
         res = self.resilience
         res.check_preemption()
         self.tput_timer.start()
@@ -1185,6 +1224,8 @@ class DeepSpeedEngine:
             self.tput_timer.stop(sync_val=loss)
             if self.global_steps % self.config.steps_per_print == 0:
                 log_dist(f"step={self.global_steps} loss={float(loss):.4f}")
+                if self.config.wall_clock_breakdown:
+                    self._emit_timer_means()
             self._last_loss = loss
             res.observe_step(loss, None)
             return loss
@@ -1201,6 +1242,15 @@ class DeepSpeedEngine:
                 params=self.num_parameters(),
                 latency_s=self.tput_timer.last_step_s
                 if self.config.wall_clock_breakdown else None)
+        if self._step_flops is None and self._mfu_tracker is not None:
+            # MFU numerator: the compiled step's XLA cost-model FLOPs —
+            # probed HERE because only this scope holds the batch in its
+            # final (sharded, gas-dim) shape; the executable cache makes
+            # the read free after the first step's compile
+            self._step_flops = self._cost_model_flops(
+                profile_target, (self.state, batch))
+            if self._step_flops:
+                self._mfu_tracker.flops_per_step = self._step_flops
         finite = None
         if self._offload_opt is not None:
             with res.guard("train_step"):
@@ -1236,6 +1286,8 @@ class DeepSpeedEngine:
         if self.global_steps % self.config.steps_per_print == 0:
             log_dist(f"step={self.global_steps} loss={float(loss):.4f} "
                      f"lr={float(self.lr_schedule(self.state.opt_state.step)):.3e}")
+            if self.config.wall_clock_breakdown:
+                self._emit_timer_means()
         self._last_loss = loss
         res.observe_step(loss, finite)
         return loss
@@ -1341,6 +1393,9 @@ class DeepSpeedEngine:
         self._accum_count = 0
         self.global_steps += 1
         self.timers(STEP_GLOBAL_TIMER).stop()
+        if self.config.wall_clock_breakdown \
+                and self.global_steps % self.config.steps_per_print == 0:
+            self._emit_timer_means()   # fwd/bwd/step means → dashboards
         if self._last_loss is not None:
             self.resilience.observe_step(self._last_loss, finite)
 
@@ -1413,6 +1468,91 @@ class DeepSpeedEngine:
             self._monitor_master = MonitorMaster(self.config)
         self._monitor_master.write_counters(counters, self.global_steps,
                                             prefix=prefix)
+
+    #: wall_clock_breakdown timers exported to dashboards (means, ms)
+    _BREAKDOWN_TIMERS = (TRAIN_BATCH_TIMER, FORWARD_GLOBAL_TIMER,
+                         BACKWARD_GLOBAL_TIMER, STEP_GLOBAL_TIMER,
+                         FORWARD_MICRO_TIMER, BACKWARD_MICRO_TIMER,
+                         STEP_MICRO_TIMER)
+
+    def _emit_timer_means(self) -> None:
+        """Fan the wall_clock_breakdown timer MEANS out through
+        ``MonitorMaster.write_counters`` (and telemetry gauges) every
+        ``steps_per_print`` — previously the breakdown only reached the
+        log, invisible to dashboards. Emitted timers reset, so each point
+        is the mean over the last print window."""
+        means: dict[str, float] = {}
+        for name in self._BREAKDOWN_TIMERS:
+            if self.timers.has(name):
+                t = self.timers.timers[name]
+                if t.count:
+                    means[f"{name}_ms"] = t.mean() * 1000.0
+                    t.reset()
+        if not means:
+            return
+        self._emit_counters(means, "Train/")
+        if self._telem.enabled:
+            for k, v in means.items():
+                self._telem.registry.gauge(f"train_{k}").set(v)
+
+    def _cost_model_flops(self, jitted_step, args: tuple) -> float:
+        """FLOPs of one compiled step from XLA's cost analysis (free: the
+        executable is cached). 0.0 marks 'unavailable' so the probe never
+        retries every step."""
+        try:
+            from ..profiling.flops_profiler import _normalize_costs
+
+            cost = _normalize_costs(
+                jitted_step.lower(*args).compile().cost_analysis())
+            return float(cost.get("flops", 0.0))
+        except Exception as e:  # telemetry must never kill training
+            logger.debug(f"step-flops probe failed ({e!r}); MFU disabled")
+            return 0.0
+
+    def _record_train_telemetry(self, batch: dict, step_before: int,
+                                skipped_before: int) -> None:
+        """Post-step training-health instruments (train_batch wrapper)."""
+        reg = self._telem.registry
+        dt = self.tput_timer.last_step_s
+        # without wall_clock_breakdown the timer stops unsynced and dt is
+        # ASYNC DISPATCH time (~ms for a ~100ms device step) — rate/MFU
+        # gauges computed from it would render as confident nonsense
+        # (same reason flops_profiler passes latency_s=None there); the
+        # raw histogram stays, labeled, for the breakdown-off case
+        synced = self.config.wall_clock_breakdown
+        if dt:
+            reg.histogram(
+                "train_step_time_s",
+                help="train_batch wall time per step (device-synced only "
+                     "under wall_clock_breakdown)").observe(dt)
+        tokens = 0
+        for leaf in jax.tree.leaves(batch):
+            shape = getattr(leaf, "shape", ())
+            if len(shape) >= 2:
+                tokens = int(shape[0]) * int(shape[1])
+                break
+        reg.counter("train_steps_total").inc()
+        if tokens:
+            reg.counter("train_tokens_total").inc(tokens)
+            if dt and synced:
+                reg.gauge("train_tokens_per_s").set(tokens / dt)
+        tracker = self._mfu_tracker
+        if tracker is not None and dt and synced:
+            rewound = self.resilience.last_step_rewound
+            skipped = self.skipped_steps > skipped_before
+            tracker.on_step(dt, useful=not (rewound or skipped))
+            if rewound:
+                # the rewind rolled global_steps back: everything between
+                # the restored step and the divergence was wasted work
+                tracker.discard_steps(max(0, step_before - self.global_steps))
+            m, g = tracker.mfu(), tracker.goodput()
+            if m is not None:
+                reg.gauge("train_mfu", help="model FLOPs utilization "
+                          "(XLA cost model / peak)").set(m)
+                reg.gauge("train_goodput", help="MFU counting only steps "
+                          "whose update survived (skips/rewinds discounted)"
+                          ).set(g)
+        self._telem.set_health(global_step=self.global_steps)
 
     # --- checkpointing (reference engine.py:3109/:2763) -----------------
     def save_checkpoint(self, save_dir: str, tag: str | None = None,

@@ -443,7 +443,8 @@ class ResilienceManager:
         self.sentinel = DivergenceSentinel(cfg) \
             if (cfg.sentinel or cfg.loss_spike_factor > 0) else None
         self.watchdog = HangWatchdog(cfg.watchdog_timeout_s,
-                                     exit_on_stall=cfg.watchdog_exit)
+                                     exit_on_stall=cfg.watchdog_exit,
+                                     on_stall=self._flight_dump_on_stall)
         self.preemption: PreemptionHandler | None = None
         if cfg.preemption_signals:
             self.preemption = PreemptionHandler.install(cfg.preemption_signals)
@@ -457,6 +458,20 @@ class ResilienceManager:
             "preemptions": 0, "aborts": 0,
         }
 
+    # -- telemetry (telemetry/) ------------------------------------------
+    @staticmethod
+    def _telemetry():
+        from ..telemetry import get_telemetry
+
+        return get_telemetry()
+
+    def _flight_dump_on_stall(self, report: str) -> None:
+        """Watchdog stall callback: the stack dump says WHERE the job is
+        stuck; the flight record adds WHAT it was doing — the most recent
+        spans, discrete events, and a metrics snapshot."""
+        self._telemetry().flight_dump(
+            "hang", detail=report.splitlines()[0] if report else None)
+
     # -- checkpoint bookkeeping (called from checkpointing.py) -----------
     def record_save_dir(self, save_dir: str) -> None:
         self.last_save_dir = save_dir
@@ -464,6 +479,9 @@ class ResilienceManager:
     def record_committed(self, save_dir: str, tag: str,
                          durations: dict | None = None) -> None:
         self.last_verified = (save_dir, tag)
+        self._telemetry().note("checkpoint_commit", tag=tag,
+                               **{k: round(v, 3)
+                                  for k, v in (durations or {}).items()})
         if durations:
             self.engine._emit_counters(durations, "Checkpoint/")
 
@@ -499,6 +517,8 @@ class ResilienceManager:
         if cause is None:
             return
         self.counters["preemptions"] += 1
+        self._telemetry().note("preemption", cause=cause,
+                               step=self.engine.global_steps)
         path = None
         try:
             path = self.priority_save()
@@ -559,6 +579,8 @@ class ResilienceManager:
         if action == "ok":
             return
         self.counters["bad_steps"] += 1
+        self._telemetry().note("bad_step", step=self.engine.global_steps,
+                               action=action, loss=loss_f)
         if action in ("skip", "spike"):
             if action == "skip":
                 self.counters["skipped_steps"] += 1
@@ -571,6 +593,9 @@ class ResilienceManager:
         if action == "abort":
             self.counters["aborts"] += 1
             self._emit_sentinel_events()
+            self._telemetry().flight_dump(
+                "divergence", detail=f"abort at step "
+                f"{self.engine.global_steps} (loss={loss_f})")
             raise DivergenceError(
                 f"training diverged: {self.sentinel.bad_streak} consecutive "
                 f"bad steps at step {self.engine.global_steps} after "
@@ -584,6 +609,9 @@ class ResilienceManager:
             self.last_save_dir
         if load_dir is None:
             self.counters["aborts"] += 1
+            self._telemetry().flight_dump(
+                "divergence", detail=f"no checkpoint to rewind to at step "
+                f"{self.engine.global_steps}")
             raise DivergenceError(
                 f"training diverged at step {self.engine.global_steps} "
                 f"(loss={loss_f}) and there is no checkpoint to rewind to "
@@ -596,6 +624,9 @@ class ResilienceManager:
         self.sentinel.note_rewind()
         self.counters["rewinds"] += 1
         self.last_step_rewound = True
+        self._telemetry().note("rewind", from_step=bad_step,
+                               to_step=self.engine.global_steps,
+                               loss=loss_f)
         logger.warning(
             f"sentinel: REWOUND from step {bad_step} (loss={loss_f}) to "
             f"verified checkpoint at step {self.engine.global_steps} "

@@ -1,0 +1,220 @@
+"""Unified observability: span tracer, metrics registry, MFU/goodput,
+Prometheus exposition, flight recorder.
+
+One process-wide :class:`Telemetry` instance (:func:`get_telemetry`) is
+shared by the training engine, the inference engine, the scheduler,
+checkpointing, resilience and the monitor backends, so ``/metrics`` is one
+pane of glass for the whole job. It exists from first access but starts
+DISABLED: every hot-path call is a cheap ``enabled`` check, ``span()``
+returns a shared null object, nothing buffers, no server binds. Enable via
+
+- config: ``{"telemetry": {"enabled": true, "http_port": 9100, ...}}``
+  (the training engine calls :func:`configure` from its config section),
+- engine_v2: ``RaggedInferenceConfig(telemetry=True)``,
+- env: ``DS_TPU_TELEMETRY=1`` (+ ``DS_TPU_TELEMETRY_PORT`` for the HTTP
+  endpoint) — the bench/driver path, no config edit needed.
+
+``configure()`` mutates the default instance IN PLACE so references cached
+by already-constructed engines stay live.
+"""
+from __future__ import annotations
+
+import os
+import threading
+
+from ..utils.logging import logger
+from .metrics import (LATENCY_BUCKETS_S, RATIO_BUCKETS, Counter, Gauge,
+                      Histogram, MetricsRegistry, sanitize_metric_name)
+from .mfu import MFUTracker, device_peak_flops, goodput, mfu
+from .recorder import FlightRecorder
+from .spans import NULL_SPAN, SpanTracer
+from .exposition import TelemetryHTTPServer
+
+__all__ = [
+    "Telemetry", "get_telemetry", "configure",
+    "SpanTracer", "MetricsRegistry", "Counter", "Gauge", "Histogram",
+    "FlightRecorder", "TelemetryHTTPServer", "MFUTracker",
+    "mfu", "goodput", "device_peak_flops", "sanitize_metric_name",
+    "LATENCY_BUCKETS_S", "RATIO_BUCKETS", "NULL_SPAN",
+]
+
+
+class Telemetry:
+    """The observability bundle. ``enabled`` gates recording; the registry
+    and recorder objects always exist (the Prometheus monitor backend and
+    crash dumps may use them regardless)."""
+
+    def __init__(self, enabled: bool = False, span_buffer: int = 4096,
+                 mirror_jax: bool = True, flight_recorder: int = 256,
+                 flight_recorder_path: str | None = None):
+        self.enabled = bool(enabled)
+        self.tracer = SpanTracer(capacity=span_buffer, enabled=enabled,
+                                 mirror_jax=mirror_jax)
+        self.registry = MetricsRegistry()
+        self.recorder = FlightRecorder(tracer=self.tracer,
+                                       registry=self.registry,
+                                       capacity=flight_recorder,
+                                       path=flight_recorder_path)
+        self.server: TelemetryHTTPServer | None = None
+        self._health_extra: dict = {}
+
+    # -- recording shorthands -------------------------------------------
+    def span(self, name: str, **args):
+        if not self.enabled:
+            return NULL_SPAN
+        return self.tracer.span(name, **args)
+
+    def step_span(self, name: str, step: int, **args):
+        if not self.enabled:
+            return NULL_SPAN
+        return self.tracer.step_span(name, step, **args)
+
+    def note(self, kind: str, **data) -> None:
+        self.recorder.note(kind, **data)
+
+    # -- lifecycle -------------------------------------------------------
+    def reconfigure(self, *, enabled: bool | None = None,
+                    span_buffer: int | None = None,
+                    mirror_jax: bool | None = None,
+                    flight_recorder: int | None = None,
+                    flight_recorder_path: str | None = None,
+                    http_port: int | None = None) -> "Telemetry":
+        """In-place update so cached references stay valid. The span ring
+        is rebuilt only when its capacity changes (history is then lost)."""
+        if enabled is not None:
+            self.enabled = bool(enabled)
+            self.tracer.enabled = bool(enabled)
+        if mirror_jax is not None:
+            self.tracer.mirror_jax = bool(mirror_jax)
+        if span_buffer is not None and span_buffer != self.tracer.capacity:
+            self.tracer = SpanTracer(capacity=span_buffer,
+                                     enabled=self.enabled,
+                                     mirror_jax=self.tracer.mirror_jax)
+            self.recorder.tracer = self.tracer
+        if flight_recorder is not None \
+                and flight_recorder != self.recorder.capacity:
+            self.recorder = FlightRecorder(
+                tracer=self.tracer, registry=self.registry,
+                capacity=flight_recorder, path=self.recorder.path)
+        if flight_recorder_path is not None:
+            self.recorder.path = flight_recorder_path
+        if http_port is not None:
+            try:
+                self.start_http(http_port)
+            except OSError as e:   # a busy port must not kill the job
+                logger.error(f"telemetry: cannot bind /metrics port "
+                             f"{http_port} ({e}); exposition is render-only")
+        return self
+
+    def start_http(self, port: int = 0) -> int:
+        """Start (or return) the /metrics + /healthz endpoint; idempotent.
+        Explicit calls work even when recording is disabled — a user
+        configuring the PrometheusMonitor backend wants the scrape either
+        way."""
+        if self.server is None:
+            server = TelemetryHTTPServer(self.registry,
+                                         health_fn=self._health)
+            server.start(port)      # raises on a busy port — don't keep a
+            self.server = server    # dead server blocking later attempts
+        elif port not in (0, self.server.port):
+            logger.warning(
+                f"telemetry: /metrics already bound on port "
+                f"{self.server.port}; ignoring request for port {port} "
+                f"(one endpoint per process)")
+        return self.server.port
+
+    def stop_http(self) -> None:
+        if self.server is not None:
+            self.server.stop()
+            self.server = None
+
+    def set_health(self, **fields) -> None:
+        """Attach job identity / progress fields to /healthz responses."""
+        self._health_extra.update(fields)
+
+    def _health(self) -> dict:
+        h = dict(self._health_extra)
+        h["telemetry_enabled"] = self.enabled
+        h["spans_recorded"] = self.tracer.total_recorded
+        return h
+
+    # -- reading ---------------------------------------------------------
+    def snapshot(self) -> dict:
+        return self.registry.snapshot()
+
+    def flight_dump(self, reason: str, path: str | None = None,
+                    detail: str | None = None) -> dict:
+        return self.recorder.dump(reason, path=path, detail=detail)
+
+    def slo_summary(self) -> dict:
+        """Compact percentile view of every histogram (bench artifacts,
+        log lines): {name: {p50, p95, p99, mean, count}}."""
+        out: dict = {}
+        for name, fam in self.registry.snapshot().items():
+            if fam["type"] != "histogram":
+                continue
+            if not fam["series"]:
+                continue
+            h = Histogram(buckets=fam["series"][0]["bounds"])
+            # merge label series under the family for the summary view;
+            # series created with DIFFERENT buckets (the registry allows
+            # it per label set) cannot fold — skip them rather than
+            # mis-bin or crash the bench artifact assembly
+            for s in fam["series"]:
+                if tuple(s["bounds"]) != h.bounds:
+                    continue
+                for i, c in enumerate(s["counts"]):
+                    h.counts[i] += c
+                h.sum += s["sum"]
+                h.count += s["count"]
+            if not h.count:
+                continue
+            out[name] = {
+                "p50": round(h.percentile(50), 6),
+                "p95": round(h.percentile(95), 6),
+                "p99": round(h.percentile(99), 6),
+                "mean": round(h.mean, 6),
+                "count": h.count,
+            }
+        return out
+
+
+_default: Telemetry | None = None
+_default_lock = threading.Lock()
+
+
+def get_telemetry() -> Telemetry:
+    """The process-wide instance; created disabled unless DS_TPU_TELEMETRY
+    is set truthy in the environment."""
+    global _default
+    if _default is None:
+        with _default_lock:
+            if _default is None:
+                env_on = os.environ.get("DS_TPU_TELEMETRY", "") \
+                    not in ("", "0", "false")
+                t = Telemetry(enabled=env_on)
+                if env_on:
+                    port = os.environ.get("DS_TPU_TELEMETRY_PORT")
+                    if port is not None:
+                        try:
+                            t.start_http(int(port))
+                        except (OSError, ValueError) as e:
+                            logger.error(f"DS_TPU_TELEMETRY_PORT: {e}")
+                _default = t
+    return _default
+
+
+def configure(config=None, **overrides) -> Telemetry:
+    """Enable/retune the process-wide instance from a config section
+    (duck-typed: ``config.enabled``, ``config.span_buffer``, ...). Called
+    by engines at init; explicit kwargs win over the section."""
+    t = get_telemetry()
+    kw: dict = {}
+    if config is not None:
+        for k in ("enabled", "span_buffer", "mirror_jax", "flight_recorder",
+                  "flight_recorder_path", "http_port"):
+            v = getattr(config, k, None)
+            if v is not None:
+                kw[k] = v
+    kw.update(overrides)
+    return t.reconfigure(**kw)

@@ -1,0 +1,91 @@
+"""Opt-in stdlib HTTP endpoint: ``/metrics`` (Prometheus text format) and
+``/healthz`` (liveness JSON) for scraping live jobs.
+
+Stdlib-only by constraint (the image has no prometheus_client and the repo
+may not grow dependencies) and by taste: the exposition format is lines of
+text, and ``ThreadingHTTPServer`` on a daemon thread is enough for a
+scraper hitting the job every 15s. The server binds localhost by default —
+exposing beyond the host is a deployment decision (port-forward / sidecar),
+not a framework default.
+"""
+from __future__ import annotations
+
+import json
+import threading
+import time
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+from ..utils.logging import logger
+
+PROMETHEUS_CONTENT_TYPE = "text/plain; version=0.0.4; charset=utf-8"
+
+
+class TelemetryHTTPServer:
+    """Serve a registry's metrics + a health probe.
+
+    ``health_fn`` (optional) returns a dict merged into the ``/healthz``
+    body — wire job identity / step counters in there. ``port=0`` binds an
+    ephemeral port (tests); read it back from ``self.port``.
+    """
+
+    def __init__(self, registry, health_fn=None, host: str = "127.0.0.1"):
+        self.registry = registry
+        self.health_fn = health_fn
+        self.host = host
+        self.port: int | None = None
+        self._httpd: ThreadingHTTPServer | None = None
+        self._thread: threading.Thread | None = None
+        self._t0 = time.time()
+
+    def start(self, port: int = 0) -> int:
+        if self._httpd is not None:
+            return self.port
+        server = self
+
+        class Handler(BaseHTTPRequestHandler):
+            def do_GET(self):
+                try:
+                    if self.path.split("?")[0] == "/metrics":
+                        body = server.registry.render_prometheus().encode()
+                        ctype = PROMETHEUS_CONTENT_TYPE
+                    elif self.path.split("?")[0] == "/healthz":
+                        health = {"status": "ok",
+                                  "uptime_s": round(time.time() - server._t0, 3)}
+                        if server.health_fn is not None:
+                            health.update(server.health_fn())
+                        body = (json.dumps(health) + "\n").encode()
+                        ctype = "application/json"
+                    else:
+                        self.send_error(404)
+                        return
+                except Exception as e:   # a scrape must never kill the job
+                    logger.warning(f"telemetry endpoint error: {e!r}")
+                    self.send_error(500)
+                    return
+                self.send_response(200)
+                self.send_header("Content-Type", ctype)
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def log_message(self, fmt, *args):   # scraper chatter off stderr
+                logger.debug(f"telemetry http: {fmt % args}")
+
+        self._httpd = ThreadingHTTPServer((self.host, int(port)), Handler)
+        self._httpd.daemon_threads = True
+        self.port = self._httpd.server_address[1]
+        self._thread = threading.Thread(
+            target=self._httpd.serve_forever, name="telemetry-http",
+            daemon=True)
+        self._thread.start()
+        logger.info(f"telemetry: serving /metrics + /healthz on "
+                    f"http://{self.host}:{self.port}")
+        return self.port
+
+    def stop(self) -> None:
+        if self._httpd is None:
+            return
+        self._httpd.shutdown()
+        self._httpd.server_close()
+        self._httpd = None
+        self._thread = None

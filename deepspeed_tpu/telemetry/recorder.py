@@ -1,0 +1,92 @@
+"""Flight recorder: the last-N telemetry events, dumped as structured JSON
+when something dies.
+
+The resilience layer's hang watchdog already dumps WHERE the job was stuck
+(all-thread stacks); the flight recorder adds WHAT it was doing — the most
+recent spans, discrete events (bad steps, rewinds, preemptions, checkpoint
+commits), and a metrics snapshot — so a postmortem reads like a timeline
+instead of a core dump. Dumps are triggered by the watchdog, by
+``DivergenceError``, and by preemption exits (runtime/resilience.py), or
+manually via :meth:`dump`.
+"""
+from __future__ import annotations
+
+import collections
+import json
+import os
+import time
+
+from ..utils.logging import logger
+
+
+class FlightRecorder:
+    """Bounded deque of discrete events + access to the span ring and
+    metrics registry at dump time. ``note()`` is safe to call even when
+    telemetry is disabled — postmortem breadcrumbs are cheap and only read
+    on catastrophic exits."""
+
+    def __init__(self, tracer=None, registry=None, capacity: int = 256,
+                 path: str | None = None):
+        self.tracer = tracer
+        self.registry = registry
+        self.capacity = int(capacity)
+        #: default dump target; DS_TPU_FLIGHT_RECORDER overrides, dump(path=)
+        #: overrides both. None → log-only dump.
+        self.path = path or os.environ.get("DS_TPU_FLIGHT_RECORDER")
+        self._events: collections.deque = collections.deque(maxlen=capacity)
+        self.dumps = 0
+
+    def note(self, kind: str, **data) -> None:
+        """Record a discrete event (bad step, rewind, ckpt commit, ...)."""
+        rec = {"t": time.time(), "kind": kind}
+        if data:
+            rec.update(data)
+        self._events.append(rec)
+
+    def events(self) -> list[dict]:
+        return list(self._events)
+
+    def record(self, reason: str, detail: str | None = None,
+               max_spans: int = 128) -> dict:
+        """Assemble the postmortem record (no I/O)."""
+        rec = {
+            "reason": reason,
+            "time": time.time(),
+            "pid": os.getpid(),
+            "events": self.events(),
+            "spans": (self.tracer.events(last=max_spans)
+                      if self.tracer is not None else []),
+            "metrics": (self.registry.snapshot()
+                        if self.registry is not None else {}),
+        }
+        if detail:
+            rec["detail"] = detail
+        return rec
+
+    def dump(self, reason: str, path: str | None = None,
+             detail: str | None = None) -> dict:
+        """Write the postmortem record as one JSON file (append-numbered so
+        repeated dumps of a flapping job don't clobber each other); always
+        returns the record even when the write fails — the caller is
+        usually mid-crash and must not die in its own error handler."""
+        rec = self.record(reason, detail=detail)
+        target = path or self.path
+        self.dumps += 1
+        if target:
+            final = target if self.dumps == 1 \
+                else f"{target}.{self.dumps}"
+            try:
+                d = os.path.dirname(os.path.abspath(final))
+                os.makedirs(d, exist_ok=True)
+                with open(final, "w") as f:
+                    json.dump(rec, f, indent=1, default=repr)
+                rec["dump_path"] = final
+                logger.error(f"flight recorder: '{reason}' dump → {final} "
+                             f"({len(rec['events'])} events, "
+                             f"{len(rec['spans'])} spans)")
+            except OSError as e:
+                logger.error(f"flight recorder write failed: {e}")
+        else:
+            logger.error(f"flight recorder ('{reason}'): "
+                         f"last events: {rec['events'][-10:]}")
+        return rec

@@ -1,9 +1,12 @@
 """Repo lint: no module-import-time jax device probes outside _jax_compat
 (bin/check_import_time_devices.py — the round-5 postmortem rule: the first
 ``jax.devices()`` belongs behind a watchdog at CALL time, and import-time
-probes freeze the platform before set_cpu_devices can run), and no silent
+probes freeze the platform before set_cpu_devices can run), no silent
 ``except Exception: pass`` swallows (bin/check_exception_swallows.py —
-recovery paths must not eat the faults the resilience layer surfaces)."""
+recovery paths must not eat the faults the resilience layer surfaces), and
+no emitted metric/span tag that can't sanitize to a valid Prometheus
+metric name (bin/check_metric_names.py — /metrics must never 500 on a
+scrape because a rare branch registered a bad tag)."""
 import importlib.util
 import os
 
@@ -21,6 +24,7 @@ def _load(name):
 
 lint = _load("check_import_time_devices")
 swallows = _load("check_exception_swallows")
+metric_lint = _load("check_metric_names")
 
 
 def test_repo_has_no_import_time_device_probes():
@@ -79,6 +83,39 @@ def test_swallow_detector_flags_silent_broad_handlers(tmp_path):
     out = swallows.check_file(str(bad))
     assert len(out) == 3
     assert ":4:" in out[0] and ":8:" in out[1] and ":12:" in out[2]
+
+
+# --- Prometheus-safe metric/span tags ---------------------------------------
+
+def test_repo_metric_tags_are_prometheus_safe():
+    violations = metric_lint.check_repo(ROOT)
+    assert violations == [], "\n".join(violations)
+
+
+def test_metric_tag_detector_flags_unsalvageable_literals(tmp_path):
+    bad = tmp_path / "bad.py"
+    bad.write_text(
+        "def f(reg, telem, mm):\n"
+        "    reg.counter('')\n"                       # empty: flagged
+        "    telem.span('\\u00e9\\u00e9')\n"          # sanitizes to '__': ok
+        "    reg.histogram('serving/ttft s')\n"       # '/'+' ' → '_': ok
+        "    reg.gauge(name_var)\n"                   # dynamic: not checked
+        "    mm.write_counters({}, 3, prefix='Train/')\n"   # ok
+        "    eng._emit_counters({}, 'Checkpoint/')\n"       # positional: ok
+        "    reg.counter('9lives')\n")                # digit start: salvaged
+    out = metric_lint.check_file(str(bad))
+    assert len(out) == 1 and ":2:" in out[0] and "counter()" in out[0]
+
+
+def test_metric_tag_detector_matches_runtime_sanitizer():
+    """The lint's dependency-free sanitize mirror must agree with the
+    runtime sanitizer it stands in for (drift here would let the lint
+    pass tags the exposition rejects, or vice versa)."""
+    from deepspeed_tpu.telemetry import sanitize_metric_name
+
+    for tag in ("Resilience/rewinds", "Train/fwd_ms", "a b-c.d", "9x",
+                "serving_ttft_s", "x:y", "__", "é"):
+        assert metric_lint.sanitize(tag) == sanitize_metric_name(tag), tag
 
 
 def test_swallow_detector_allows_narrow_logged_and_del(tmp_path):
