@@ -23,6 +23,8 @@ The same artifact carries (in ``detail``):
   SLA-conditioned effective throughput (README.md:156 convention).
 
 ``BENCH_MODE=fastgen`` runs only the serving benchmark standalone.
+``BENCH_MODE=prefix_cache`` runs the shared-system-prompt workload: cold
+vs warm TTFT and prefill-tokens-computed through the radix prefix cache.
 Opt-outs: BENCH_SKIP_FASTGEN / BENCH_SKIP_LARGE / BENCH_SKIP_STREAM /
 BENCH_SKIP_LONG_FASTGEN (each =1), for constrained hosts.
 """
@@ -920,6 +922,110 @@ def tp_matmul_main():
     }), flush=True)
 
 
+def prefix_cache_main():
+    """``BENCH_MODE=prefix_cache``: shared-system-prompt serving, cold vs
+    warm (inference/prefix_cache.py — the radix reuse layer over the paged
+    pool).
+
+    Workload: ``BENCH_PC_REQUESTS`` requests sharing one
+    ``BENCH_PC_SYSTEM``-token system prompt, each with a unique
+    ``BENCH_PC_SUFFIX``-token tail and ``BENCH_PC_GEN`` generated tokens.
+    Phase COLD serves it on a fresh engine (hits only from cross-request
+    sharing as earlier requests publish their pages); phase WARM repeats
+    the exact prompts on the now-populated cache (the multi-turn /
+    repeated-template regime). The artifact reports per-phase p50 TTFT
+    (admission → first token), prefill tokens actually computed, and hit
+    rate — vs_baseline is the warm/cold prefill-compute reduction."""
+    from deepspeed_tpu.inference import InferenceEngineV2
+    from deepspeed_tpu.models import build_model
+    from deepspeed_tpu.parallel.topology import MeshTopology
+
+    model_name = os.environ.get("BENCH_MODEL", "gpt2-350m")
+    n_req = int(os.environ.get("BENCH_PC_REQUESTS", "16"))
+    sys_len = int(os.environ.get("BENCH_PC_SYSTEM", "512"))
+    sfx_len = int(os.environ.get("BENCH_PC_SUFFIX", "32"))
+    gen_len = int(os.environ.get("BENCH_PC_GEN", "32"))
+    max_seqs = int(os.environ.get("BENCH_MAX_SEQS", "8"))
+    chunk = int(os.environ.get("BENCH_CHUNK", "128"))
+    block_size = int(os.environ.get("BENCH_BLOCK_SIZE", "128"))
+    max_len = sys_len + sfx_len + gen_len + block_size
+
+    model = build_model(model_name, max_seq_len=max_len)
+    r = np.random.default_rng(0)
+    vocab = model.config.vocab_size
+    system = [int(t) for t in r.integers(0, vocab, sys_len)]
+    prompts = [system + [int(t) for t in r.integers(0, vocab, sfx_len)]
+               for _ in range(n_req)]
+
+    blocks_per_seq = -(-max_len // block_size)
+    eng = InferenceEngineV2(
+        model, rng=jax.random.PRNGKey(0),
+        config={"block_size": block_size, "chunk": chunk,
+                "max_seqs": max_seqs, "max_seq_len": max_len,
+                # room for live sequences AND the shared prefix pages
+                "num_blocks": (max_seqs + 2) * blocks_per_seq + 1,
+                "prefix_cache": True, "greedy": True},
+        topology=MeshTopology({"tensor": 1, "data": 1}))
+
+    def phase(uid0):
+        for k in eng.stats:
+            if k != "d2h_latency_s":
+                eng.stats[k] = 0 if isinstance(eng.stats[k], int) else 0.0
+        pending = list(range(n_req))
+        live, admit_t, ttft = set(), {}, {}
+        t0 = time.perf_counter()
+        while pending or live:
+            while pending and len(live) < max_seqs and \
+                    eng.can_schedule(len(prompts[pending[0]]), gen_len):
+                i = pending.pop(0)
+                eng.put(uid0 + i, list(prompts[i]), gen_len)
+                admit_t[uid0 + i] = time.perf_counter()
+                live.add(uid0 + i)
+            stepped = eng.step()
+            now = time.perf_counter()
+            for uid in stepped:
+                ttft.setdefault(uid, now - admit_t[uid])
+            for uid in list(live):
+                seq = eng.state.seqs.get(uid)
+                if seq is not None and seq.done:
+                    eng.flush(uid)          # publishes full pages
+                    live.remove(uid)
+        st = eng.stats
+        return {
+            "wall_s": round(time.perf_counter() - t0, 3),
+            "p50_ttft_s": round(float(np.percentile(
+                list(ttft.values()), 50)), 4),
+            "p95_ttft_s": round(float(np.percentile(
+                list(ttft.values()), 95)), 4),
+            "prefill_tokens_computed": st["prefill_tokens"],
+            "prefix_hit_tokens": st["prefix_hit_tokens"],
+            "prefix_hit_rate": st["prefix_hit_rate"],
+        }
+
+    cold = phase(0)
+    warm = phase(10_000)
+    pc = eng.prefix_cache_stats()
+    drop = 1.0 - warm["prefill_tokens_computed"] \
+        / max(cold["prefill_tokens_computed"], 1)
+    print(json.dumps({
+        "metric": f"{model_name} shared-prefix serving, {n_req} reqs x "
+                  f"({sys_len} shared + {sfx_len} unique) prompt tokens "
+                  f"({_devices()[0].device_kind})",
+        "value": warm["p50_ttft_s"],
+        "unit": "s warm p50 TTFT (cold: " f"{cold['p50_ttft_s']})",
+        "vs_baseline": round(cold["p50_ttft_s"]
+                             / max(warm["p50_ttft_s"], 1e-9), 2),
+        "detail": {
+            "cold": cold, "warm": warm,
+            "warm_prefill_compute_drop": round(drop, 4),
+            "prefix_cache": pc,
+            "baseline": "same prompts, same engine: cold run populates "
+                        "the radix cache, warm run serves from it "
+                        "(vs_baseline = cold/warm p50 TTFT)",
+        },
+    }), flush=True)
+
+
 def main():
     # the FIRST device touch, under a bounded watchdog: a downed PJRT
     # tunnel must produce a structured JSON error line, never a hang
@@ -927,6 +1033,8 @@ def main():
     _bring_up_backend()
     if os.environ.get("BENCH_MODE") == "tp_matmul":
         return tp_matmul_main()
+    if os.environ.get("BENCH_MODE") == "prefix_cache":
+        return prefix_cache_main()
     if os.environ.get("BENCH_MODE") == "fastgen":
         return fastgen_main(with_sequential=True, sla=True)
     if os.environ.get("BENCH_MODE") == "fastgen_sweep":

@@ -1,0 +1,168 @@
+#!/usr/bin/env python
+"""Repo lint: block-list mutations must go through the refcounted API.
+
+With the shared-prefix KV cache (inference/prefix_cache.py), a pool block
+can be owned by the free list, the prefix trie (refcounted, shared by live
+sequences), or one sequence's owned tail. That invariant only holds while
+every mutation flows through ``StateManager``'s refcounted alloc/free API
+(``admit`` / ``release`` / ``_alloc``): a stray ``allocator.free(...)`` in
+engine code would free a page the trie still serves (stale-read), and a
+direct ``seq.blocks = ...`` would skip the refcount bookkeeping entirely.
+This AST check (the check_exception_swallows.py shape) rejects, anywhere
+in ``deepspeed_tpu/`` outside the allowlisted ``StateManager`` methods:
+
+- calls through an ``allocator`` attribute to ``allocate``/``free``;
+- calls through a ``prefix_cache`` attribute to the ownership-mutating
+  surface (``match``/``acquire``/``release``/``publish``/``evict`` —
+  ``match`` included because a matched chain must be acquired in the same
+  host operation, before any other admit/evict can run);
+- assignments to a ``.blocks`` attribute, and mutating method calls on
+  one (``.blocks.append(...)`` etc.).
+
+Reads (``allocator.free_blocks``, ``prefix_cache.stats()``, iterating
+``seq.blocks``) are fine anywhere.
+
+Usage: ``python bin/check_state_invariants.py [root]`` — prints violations
+as ``path:line: message`` and exits nonzero if any. Enforced from
+tests/test_repo_lint.py.
+"""
+from __future__ import annotations
+
+import ast
+import os
+import sys
+
+#: the one file hosting the refcounted API
+STATE_FILE = "deepspeed_tpu/inference/ragged.py"
+
+#: (rule, function name) pairs allowed inside STATE_FILE
+ALLOWED = {
+    "allocator": {"_alloc", "release"},
+    "prefix_cache": {"admit", "release", "_alloc"},
+    "blocks": {"admit"},
+}
+
+#: mutating list-method names (on a ``.blocks`` attribute)
+LIST_MUTATORS = {"append", "extend", "insert", "pop", "remove", "clear",
+                 "sort", "reverse"}
+
+#: prefix-cache methods that change block ownership / pinning
+CACHE_MUTATORS = {"match", "acquire", "release", "publish", "evict"}
+
+
+def _chain(node: ast.expr) -> list[str]:
+    """Attribute chain names, outermost last: self.allocator.free ->
+    ['self', 'allocator', 'free'] ('' for non-name bases)."""
+    out: list[str] = []
+    while isinstance(node, ast.Attribute):
+        out.append(node.attr)
+        node = node.value
+    out.append(node.id if isinstance(node, ast.Name) else "")
+    return out[::-1]
+
+
+class _Visitor(ast.NodeVisitor):
+    def __init__(self, path: str, in_state_file: bool):
+        self.path = path
+        self.in_state_file = in_state_file
+        self.violations: list[str] = []
+        self._func_stack: list[str] = []
+
+    def _visit_fn(self, node):
+        self._func_stack.append(node.name)
+        self.generic_visit(node)
+        self._func_stack.pop()
+
+    visit_FunctionDef = _visit_fn
+    visit_AsyncFunctionDef = _visit_fn
+
+    def _allowed(self, rule: str) -> bool:
+        return self.in_state_file and any(
+            f in ALLOWED[rule] for f in self._func_stack)
+
+    def _flag(self, node: ast.AST, rule: str, what: str) -> None:
+        if not self._allowed(rule):
+            ok = ", ".join(sorted(ALLOWED[rule]))
+            self.violations.append(
+                f"{self.path}:{node.lineno}: {what} outside the refcounted "
+                f"StateManager API (allowed only in {STATE_FILE} "
+                f"{ok}) — route through admit/release")
+
+    def visit_Call(self, node: ast.Call):
+        if isinstance(node.func, ast.Attribute):
+            chain = _chain(node.func)
+            if len(chain) >= 2:
+                # private aliases count: engine_v2 holds the cache as
+                # self._prefix_cache — a stray mutator through THAT name
+                # is exactly the misuse this lint exists to catch
+                base, meth = chain[-2].lstrip("_"), chain[-1]
+                if base == "allocator" and meth in ("allocate", "free"):
+                    self._flag(node, "allocator",
+                               f"direct allocator.{meth}() call")
+                elif base == "prefix_cache" and meth in CACHE_MUTATORS:
+                    self._flag(node, "prefix_cache",
+                               f"direct prefix_cache.{meth}() call")
+                elif base == "blocks" and meth in LIST_MUTATORS \
+                        and len(chain) >= 3:
+                    # len >= 3: only ATTRIBUTE block lists (seq.blocks.*);
+                    # a bare local list that happens to be named `blocks`
+                    # (the scheduler's plan-building scratch) is fine
+                    self._flag(node, "blocks",
+                               f"block-list mutation .blocks.{meth}()")
+        self.generic_visit(node)
+
+    def _check_targets(self, node, targets) -> None:
+        for t in targets:
+            if isinstance(t, ast.Attribute) and t.attr == "blocks":
+                self._flag(node, "blocks",
+                           "assignment to a .blocks attribute")
+            elif isinstance(t, (ast.Tuple, ast.List)):
+                self._check_targets(node, t.elts)
+
+    def visit_Assign(self, node: ast.Assign):
+        self._check_targets(node, node.targets)
+        self.generic_visit(node)
+
+    def visit_AugAssign(self, node: ast.AugAssign):
+        self._check_targets(node, [node.target])
+        self.generic_visit(node)
+
+
+def check_file(path: str) -> list[str]:
+    with open(path, encoding="utf-8") as f:
+        src = f.read()
+    try:
+        tree = ast.parse(src, filename=path)
+    except SyntaxError as e:
+        return [f"{path}:{e.lineno}: unparseable ({e.msg})"]
+    in_state = path.replace(os.sep, "/").endswith(STATE_FILE)
+    v = _Visitor(path, in_state)
+    v.visit(tree)
+    return v.violations
+
+
+def check_repo(root: str) -> list[str]:
+    out: list[str] = []
+    pkg = os.path.join(root, "deepspeed_tpu")
+    targets = []
+    for dirpath, _, files in os.walk(pkg):
+        targets += [os.path.join(dirpath, f) for f in files
+                    if f.endswith(".py")]
+    for path in sorted(targets):
+        out += check_file(path)
+    return out
+
+
+def main(argv: list[str]) -> int:
+    root = argv[1] if len(argv) > 1 else \
+        os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    violations = check_repo(root)
+    for v in violations:
+        print(v)
+    if violations:
+        print(f"{len(violations)} block-list ownership violation(s) found")
+    return 1 if violations else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main(sys.argv))

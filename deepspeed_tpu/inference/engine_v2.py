@@ -161,6 +161,25 @@ class RaggedInferenceConfig:
     #: ``SplitFuseScheduler.program_shape_menu``); off in rolling-window
     #: mode.
     prefill_pack: bool = True
+    #: content-addressed shared-prefix KV cache over the paged pool
+    #: (vLLM PagedAttention block sharing + SGLang RadixAttention, TPU
+    #: formulation — inference/prefix_cache.py): full KV pages are keyed
+    #: by their token-id chain from the root in a radix index held by
+    #: StateManager. Admit walks the trie and points the new sequence's
+    #: block table at the longest cached page-aligned prefix (refcount++,
+    #: zero copy — pages are position-ordered, so the attention kernels
+    #: need no change) and prefill chunking starts at the cached
+    #: boundary; released sequences publish their full computed pages
+    #: into the trie instead of freeing them; unreferenced pages form an
+    #: LRU reclaimed only under allocation pressure (referenced or
+    #: in-flight pages never are). None = auto: ON for pack-mode linear
+    #: serving; OFF under fp8-KV pages (cross-request reuse parity
+    #: unproven at e4m3 granularity — see tests) and always off in
+    #: rolling-window ring mode, where page slots are reused in place and
+    #: a published page's content would change under a reader. True
+    #: forces it on (still refuses ring mode; allowed with fp8-KV for
+    #: parity work); False disables.
+    prefix_cache: bool | None = None
     #: KV-cache dtype: None = compute dtype (bf16); "fp8" stores the pool
     #: as float8_e4m3 — the TPU-native form of FastGen's quantized KV
     #: (scale-free: e4m3's dynamic range covers K/V activations, so pages
@@ -251,6 +270,27 @@ class InferenceEngineV2:
         self.scheduler = SplitFuseScheduler(
             self.state, cfg.chunk,
             pack=cfg.prefill_pack and not self._ring_tokens)
+
+        # --- shared-prefix KV cache (radix reuse over the pool) ----------
+        use_pc = cfg.prefix_cache
+        if use_pc is None:
+            use_pc = (self.scheduler.pack and not self._ring_tokens
+                      and cfg.kv_cache_dtype != "fp8")
+        if use_pc and self._ring_tokens:
+            raise ValueError(
+                "prefix_cache=True cannot combine with a sliding-window "
+                "rolling KV ring: ring tables reuse page slots in place, "
+                "so a published page's content would change under a "
+                "reader (serve linear or set prefix_cache=False)")
+        self._prefix_cache = None
+        if use_pc:
+            from .prefix_cache import PrefixCache
+            self._prefix_cache = PrefixCache(cfg.block_size)
+            self.state.attach_prefix_cache(self._prefix_cache)
+        # DS_TPU_STATE_AUDIT=1: full-pool ownership/refcount audit after
+        # every release (debug mode — O(pool) per flush)
+        import os as _os
+        self._audit_state = _os.environ.get("DS_TPU_STATE_AUDIT") == "1"
 
         # --- weights: same tree as the trainer, TP-sharded ---------------
         self.params, plan = load_tp_params(model, params, rng, topology,
@@ -382,6 +422,12 @@ class InferenceEngineV2:
             else 0
         self._tp_ring_force = cfg.tp_overlap is True
         self._tp_counter_base = overlap_counters.snapshot()
+        if self._tp_ring_n:
+            # ROADMAP odd-row item: pad packed prefill plans to the ring
+            # multiple so exact-k programs with rows % tp != 0 ring
+            # (masked empty rows) instead of falling back to the blocking
+            # path; no-op when packing is off
+            self.scheduler.row_multiple = self._tp_ring_n
 
         self._programs: dict[int, Any] = {}
         self._rng = jax.random.PRNGKey(17)
@@ -424,6 +470,11 @@ class InferenceEngineV2:
                       "window_iters_max": 0, "forced_drains": 0,
                       "opportunistic_drains": 0, "prefill_budget_tokens": 0,
                       "prefill_tokens": 0, "decode_tokens": 0,
+                      # shared-prefix KV cache (prefix_cache.py): prompt
+                      # tokens served from the trie vs looked up, per-run
+                      # (bench zeroes these with the rest of the dict)
+                      "prefix_hit_tokens": 0, "prefix_lookup_tokens": 0,
+                      "prefix_hit_rate": 0.0,
                       # ring collective-matmul overlap (trace-time deltas
                       # from parallel/tensor.py — see _refresh_tp_stats)
                       "tp_ring_matmuls": 0, "tp_ring_steps": 0,
@@ -1797,13 +1848,31 @@ class InferenceEngineV2:
             raise ValueError("prompt + max_new_tokens exceeds max_seq_len")
         if not self.state.can_admit(len(toks), max_new_tokens):
             raise RuntimeError("cannot schedule: pool/slots exhausted")
-        self.state.admit(uid, toks, max_new_tokens, eos_id=eos_token_id)
+        with self._telem.span("admit", prompt=len(toks)):
+            seq = self.state.admit(uid, toks, max_new_tokens,
+                                   eos_id=eos_token_id)
         self._results[uid] = []
+        if self._prefix_cache is not None:
+            st = self.stats
+            st["prefix_hit_tokens"] += seq.prefix_hit_tokens
+            st["prefix_lookup_tokens"] += len(toks)
+            st["prefix_hit_rate"] = round(
+                st["prefix_hit_tokens"] / max(st["prefix_lookup_tokens"], 1),
+                4)
         if self._telem.enabled:
             self._admit_t[uid] = time.perf_counter()
             self._telem.registry.counter(
                 "serving_requests_total",
                 help="requests admitted (put)").inc()
+            if self._prefix_cache is not None:
+                self._telem.registry.counter(
+                    "serving_prefix_hit_tokens_total",
+                    help="prompt tokens served from the shared-prefix KV "
+                         "cache").inc(seq.prefix_hit_tokens)
+                self._telem.registry.counter(
+                    "serving_prefix_lookup_tokens_total",
+                    help="prompt tokens looked up against the shared-"
+                         "prefix KV cache").inc(len(toks))
 
     def query(self, uid: int) -> dict:
         """Request status (reference ``query`` :158)."""
@@ -1834,10 +1903,25 @@ class InferenceEngineV2:
             self._drain(force=True)         # pops (at least) the oldest
         if uid in self.state.seqs:
             self.state.release(uid)
+            if self._audit_state:
+                # DS_TPU_STATE_AUDIT=1: every block owned by exactly one
+                # of {free list, trie, a live sequence's owned tail}, and
+                # trie refcounts equal live sharers — fails loudly on any
+                # leak the release/publish path could have introduced
+                self.state.audit()
         self._admit_t.pop(uid, None)
         self._first_sched.discard(uid)
         self._last_commit_t.pop(uid, None)
         return self._results.pop(uid, [])
+
+    def prefix_cache_stats(self) -> dict | None:
+        """Lifetime shared-prefix cache counters — cached/referenced page
+        counts, hit/lookup tokens, insert/dedup/evict totals (None when
+        the cache is disabled). The per-run view lives in ``stats``
+        (``prefix_hit_tokens`` / ``prefix_hit_rate``), which the bench
+        zeroes per measured phase."""
+        return None if self._prefix_cache is None \
+            else self._prefix_cache.stats()
 
     def _record_dispatch_telemetry(self, kind: str, useful: int,
                                    budget: int, uids) -> None:
@@ -1874,6 +1958,20 @@ class InferenceEngineV2:
         reg.gauge("serving_kv_page_utilization",
                   help="allocated fraction of the paged KV pool").set(
             1.0 - alloc.free_blocks / cap)
+        if self._prefix_cache is not None:
+            # ownership split behind the utilization number: cached pages
+            # (trie LRU, reclaimable) vs referenced (shared with live
+            # sequences) vs plainly owned tails vs free
+            pc = self._prefix_cache
+            cached, referenced = pc.cached_blocks, pc.referenced_blocks
+            for kind, val in (("free", alloc.free_blocks),
+                              ("prefix_cached", cached - referenced),
+                              ("prefix_referenced", referenced),
+                              ("seq_owned",
+                               cap - alloc.free_blocks - cached)):
+                reg.gauge("serving_kv_pages", labels={"kind": kind},
+                          help="paged-pool block ownership split"
+                          ).set(val)
 
     def _record_commit_telemetry(self, emitted: dict) -> None:
         """Commit-side SLOs: TTFT (admission → first committed token) and

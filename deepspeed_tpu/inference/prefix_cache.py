@@ -1,0 +1,282 @@
+"""Content-addressed shared-prefix KV cache: a radix/trie index over the
+paged pool (vLLM PagedAttention block sharing, Kwon et al. SOSP'23, plus
+SGLang RadixAttention's prefix tree, Zheng et al. 2024 — TPU formulation).
+
+Every node is ONE FULL KV page keyed by the page's token ids *under its
+parent chain*: the trie path from the root is exactly the rolling-hash
+commitment of the whole prefix (a page's identity includes every token
+before it), implemented structurally so there are no hash collisions to
+reason about. Node → pool block id + refcount:
+
+- ``match`` walks the trie with a prompt and returns the longest chain of
+  cached full pages. The engine points the new sequence's block table at
+  those blocks (``acquire`` refs them) — pages are position-ordered, so
+  ``paged_ragged_attention`` needs no kernel change — and prefill starts
+  at the cached page boundary.
+- ``publish`` runs at sequence release: the sequence's full COMPUTED pages
+  become trie nodes (the blocks are donated to the cache instead of
+  freed); pages another sequence already published dedup (the duplicate
+  block is returned for freeing).
+- Unreferenced nodes form an LRU; ``evict`` reclaims them ONLY leaf-first
+  (an interior node's children are unreachable without it) and never
+  touches a referenced node. Referenced or in-flight pages are therefore
+  never reclaimed: live sequences hold refs from admit to release, and the
+  engine's flush path drains dispatched-but-uncommitted steps referencing
+  a uid before ``StateManager.release`` runs (the in-flight pin).
+
+The cache NEVER talks to the allocator or the device: it is pure host
+bookkeeping over block ids. :class:`~.ragged.StateManager` owns the
+allocator and is the only caller (bin/check_state_invariants.py enforces
+that every block-list mutation goes through that refcounted API).
+"""
+from __future__ import annotations
+
+import heapq
+from dataclasses import dataclass, field
+
+
+@dataclass
+class PageNode:
+    """One cached full page: ``key`` = this page's token ids (the chain
+    context lives in the path), ``block`` = the pool block holding its KV,
+    ``refs`` = live sequences whose block table points at ``block``."""
+    key: tuple[int, ...]
+    block: int
+    parent: "PageNode | None"
+    refs: int = 0
+    last_used: int = 0
+    children: dict[tuple[int, ...], "PageNode"] = field(default_factory=dict)
+
+    @property
+    def evictable(self) -> bool:
+        # leaf-first: children are only reachable THROUGH this node, so an
+        # interior node stays pinned while any descendant page exists
+        return self.refs == 0 and not self.children
+
+
+class PrefixCache:
+    """Radix index mapping prefix chains → pool block ids (host-side)."""
+
+    def __init__(self, block_size: int):
+        if block_size < 1:
+            raise ValueError("block_size must be >= 1")
+        self.block_size = block_size
+        self.root = PageNode(key=(), block=-1, parent=None, refs=1)
+        self._clock = 0              # LRU stamp (monotone per operation)
+        self._n_nodes = 0
+        # lifetime stats (the engine folds these into its stats dict)
+        self.hit_tokens = 0
+        self.lookup_tokens = 0
+        self.lookups = 0
+        self.inserted_pages = 0
+        self.deduped_pages = 0
+        self.evicted_pages = 0
+
+    # -- introspection ----------------------------------------------------
+    def __len__(self) -> int:
+        return self._n_nodes
+
+    def _nodes(self):
+        stack = list(self.root.children.values())
+        while stack:
+            n = stack.pop()
+            yield n
+            stack.extend(n.children.values())
+
+    @property
+    def cached_blocks(self) -> int:
+        """Blocks the trie owns (referenced + LRU)."""
+        return self._n_nodes
+
+    @property
+    def referenced_blocks(self) -> int:
+        return sum(1 for n in self._nodes() if n.refs > 0)
+
+    @property
+    def evictable_blocks(self) -> int:
+        """Blocks reclaimable under allocation pressure. Counts every
+        refs==0 node, not just current leaves: eviction cascades leaf-first
+        through an unreferenced chain, so the whole chain is reclaimable
+        (a refs==0 interior node with a referenced descendant is NOT
+        counted — the descendant pins the path). One post-order pass: this
+        sits on the admission hot path (StateManager.can_admit) and a
+        per-node subtree walk would go quadratic as the cache fills."""
+        n = 0
+        stack = [(c, False) for c in self.root.children.values()]
+        pinned: dict[int, bool] = {}        # id(node) -> subtree has refs
+        while stack:
+            node, expanded = stack.pop()
+            if not expanded:
+                stack.append((node, True))
+                stack.extend((c, False) for c in node.children.values())
+                continue
+            sub = node.refs > 0 or any(pinned[id(c)]
+                                       for c in node.children.values())
+            pinned[id(node)] = sub
+            if not sub:
+                n += 1
+        return n
+
+    def blocks(self) -> set[int]:
+        """Every block id the trie currently owns (pool audit)."""
+        return {n.block for n in self._nodes()}
+
+    # -- the read path ----------------------------------------------------
+    def match(self, tokens, max_tokens: int | None = None) -> list[PageNode]:
+        """Longest chain of cached full pages prefixing ``tokens``
+        (≤ ``max_tokens`` tokens). Read-only: callers that adopt the chain
+        must ``acquire`` it in the same host operation, before any other
+        admit/evict can run."""
+        bs = self.block_size
+        limit = len(tokens) if max_tokens is None else min(max_tokens,
+                                                           len(tokens))
+        node, out = self.root, []
+        for j in range(limit // bs):
+            child = node.children.get(tuple(tokens[j * bs:(j + 1) * bs]))
+            if child is None:
+                break
+            out.append(child)
+            node = child
+        self.lookups += 1
+        self.lookup_tokens += len(tokens)
+        self.hit_tokens += len(out) * bs
+        return out
+
+    def acquire(self, nodes: list[PageNode]) -> None:
+        """A sequence adopted this chain: pin every page."""
+        self._clock += 1
+        for n in nodes:
+            n.refs += 1
+            n.last_used = self._clock
+
+    def release(self, nodes: list[PageNode]) -> None:
+        """Drop a sequence's pins (pages stay cached; refs==0 pages become
+        LRU-evictable)."""
+        self._clock += 1
+        for n in nodes:
+            if n.refs <= 0:
+                raise RuntimeError(
+                    f"prefix cache refcount underflow on block {n.block}")
+            n.refs -= 1
+            n.last_used = self._clock
+
+    # -- the write path ---------------------------------------------------
+    def publish(self, tokens, blocks: list[int], n_shared: int,
+                n_tokens: int) -> list[int]:
+        """Fold a released sequence's pages into the trie.
+
+        ``blocks[j]`` holds page ``j`` of ``tokens``; the first
+        ``n_shared`` pages are EXISTING trie nodes the sequence acquired
+        at admit (their refs drop here), the rest are owned. Owned full
+        pages with computed KV (``n_tokens`` = tokens whose KV really is
+        in the pool) are inserted — their blocks now belong to the trie —
+        unless an identical chain node already exists (another sequence
+        published the same prefix first), in which case the duplicate
+        owned block is surrendered. Returns every block the caller must
+        hand back to the allocator: duplicates, partial pages, and the
+        unused reservation tail.
+        """
+        bs = self.block_size
+        n_full = min(n_tokens, len(tokens)) // bs
+        if n_full > len(blocks):
+            raise ValueError(f"{n_full} computed pages but only "
+                             f"{len(blocks)} blocks")
+        if n_shared > n_full:
+            raise ValueError(f"n_shared {n_shared} exceeds computed full "
+                             f"pages {n_full}")
+        self._clock += 1
+        node = self.root
+        to_free: list[int] = []
+        for j in range(n_full):
+            key = tuple(tokens[j * bs:(j + 1) * bs])
+            child = node.children.get(key)
+            if j < n_shared:
+                # the sequence's shared pages ARE these nodes by
+                # construction — a mismatch means the block table and the
+                # trie disagree about page content (stale-serve hazard)
+                if child is None or child.block != blocks[j]:
+                    raise RuntimeError(
+                        f"prefix cache chain mismatch at page {j}: "
+                        f"sequence shares block {blocks[j]} but the trie "
+                        f"holds {child.block if child else None}")
+                child.refs -= 1
+                if child.refs < 0:
+                    raise RuntimeError(
+                        f"prefix cache refcount underflow on block "
+                        f"{child.block}")
+            elif child is not None:
+                # dedup: same chain already cached — surrender our copy
+                to_free.append(blocks[j])
+                self.deduped_pages += 1
+            else:
+                child = PageNode(key=key, block=blocks[j], parent=node)
+                node.children[key] = child
+                self._n_nodes += 1
+                self.inserted_pages += 1
+            child.last_used = self._clock
+            node = child
+        to_free.extend(blocks[n_full:])
+        return to_free
+
+    # -- eviction ---------------------------------------------------------
+    def evict(self, n: int) -> list[int]:
+        """Reclaim up to ``n`` blocks, least-recently-used first, leaf-
+        first. Referenced pages (live sequences) are NEVER taken; interior
+        pages only fall after their whole subtree has. Returns the freed
+        block ids (ownership passes back to the caller/allocator).
+
+        Steady-state serving makes this the COMMON allocation path
+        (release publishes pages instead of freeing, so the free list
+        drains toward the trie): one scan seeds a heap of evictable
+        leaves, and a parent enters the heap when its last child falls —
+        O(nodes + k log nodes), not a full rescan per reclaimed block."""
+        out: list[int] = []
+        if n <= 0:
+            return out
+        heap: list[tuple[int, int, PageNode]] = []
+        tie = 0                     # PageNode isn't orderable
+        for node in self._nodes():
+            if node.evictable:
+                heapq.heappush(heap, (node.last_used, tie, node))
+                tie += 1
+        while heap and len(out) < n:
+            _, _, victim = heapq.heappop(heap)
+            del victim.parent.children[victim.key]
+            self._n_nodes -= 1
+            self.evicted_pages += 1
+            out.append(victim.block)
+            parent = victim.parent
+            if parent is not self.root and parent.evictable:
+                heapq.heappush(heap, (parent.last_used, tie, parent))
+                tie += 1
+        return out
+
+    # -- audit -------------------------------------------------------------
+    def check(self) -> None:
+        """Internal-consistency assert (debug/audit path): refcounts are
+        non-negative, node count matches the tree, block ids are unique."""
+        seen: set[int] = set()
+        count = 0
+        for node in self._nodes():
+            count += 1
+            if node.refs < 0:
+                raise AssertionError(f"negative refs on block {node.block}")
+            if node.block in seen:
+                raise AssertionError(f"block {node.block} appears twice "
+                                     f"in the trie")
+            seen.add(node.block)
+        if count != self._n_nodes:
+            raise AssertionError(f"node count drift: walked {count}, "
+                                 f"tracked {self._n_nodes}")
+
+    def stats(self) -> dict:
+        return {
+            "cached_pages": self._n_nodes,
+            "referenced_pages": self.referenced_blocks,
+            "hit_tokens": self.hit_tokens,
+            "lookup_tokens": self.lookup_tokens,
+            "lookups": self.lookups,
+            "inserted_pages": self.inserted_pages,
+            "deduped_pages": self.deduped_pages,
+            "evicted_pages": self.evicted_pages,
+        }

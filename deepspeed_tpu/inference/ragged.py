@@ -62,6 +62,12 @@ class SequenceDescriptor:
       step N+1 can be built and dispatched while step N still runs on
       device. Synchronous drivers that never touch the dispatch-time
       accessors see identical numbers (``max`` below).
+
+    Shared-prefix serving (prefix_cache.py): the FIRST ``n_shared_blocks``
+    entries of ``blocks`` are READ-ONLY pages owned by the prefix trie
+    (refcounted, released at :meth:`StateManager.release`); ``n_computed``
+    starts at the cached token boundary so the scheduler never recomputes
+    — or writes — a shared page (chunk starts are page-aligned there).
     """
     uid: int
     tokens: list[int]                 # full token history (prompt + generated)
@@ -74,6 +80,8 @@ class SequenceDescriptor:
     eos_id: int | None = None         # stop criterion besides max_new_tokens
     n_sched: int = 0                  # KV tokens scheduled (dispatch-time)
     n_inflight: int = 0               # sampled tokens not yet read back
+    n_shared_blocks: int = 0          # leading trie-owned (read-only) pages
+    prefix_hit_tokens: int = 0        # prompt tokens served from the trie
 
     @property
     def pending_tokens(self) -> int:
@@ -140,7 +148,17 @@ class SequenceDescriptor:
 
 class StateManager:
     """Tracks live sequences + owns the allocator (reference
-    ragged_manager.py:19 ``DSStateManager``)."""
+    ragged_manager.py:19 ``DSStateManager``).
+
+    THE refcounted alloc/free API: every block-list mutation in the
+    serving stack goes through :meth:`admit` / :meth:`release` here (the
+    AST lint ``bin/check_state_invariants.py`` enforces it). With a
+    :class:`~.prefix_cache.PrefixCache` attached, admit points new
+    sequences at cached read-only pages (refcount++), release publishes
+    computed full pages into the trie instead of freeing them, and
+    allocation under pressure reclaims LRU unreferenced cached pages —
+    never referenced or in-flight ones (the engine's flush drains
+    dispatched-but-uncommitted steps before release runs)."""
 
     def __init__(self, num_blocks: int, block_size: int, max_seqs: int,
                  max_blocks_per_seq: int):
@@ -158,19 +176,53 @@ class StateManager:
         self.max_blocks_per_seq = max_blocks_per_seq
         self.seqs: dict[int, SequenceDescriptor] = {}
         self._free_slots = list(range(max_seqs))
+        #: shared-prefix trie (attach_prefix_cache); None = no sharing
+        self.prefix_cache = None
+        # node chains live sequences hold refs on (uid → list[PageNode])
+        self._shared_nodes: dict[int, list] = {}
+
+    def attach_prefix_cache(self, cache) -> None:
+        """Enable shared-prefix serving (engine init, linear tables only —
+        rolling-ring tables reuse page slots in place and can never share)."""
+        if self.seqs:
+            raise RuntimeError("attach_prefix_cache before admitting")
+        self.prefix_cache = cache
 
     def _blocks_for(self, n_tokens: int) -> int:
         # a sequence can never OWN more slots than the table has — the
         # rolling buffer reuses them past that point
         return min(-(-n_tokens // self.block_size), self.max_blocks_per_seq)
 
+    def _alloc(self, n: int) -> list[int]:
+        """Refcounted-API allocation: top the free list up from the prefix
+        LRU under pressure (evicts only unreferenced cached pages — a
+        referenced page is pinned by a live sequence's refcount, and
+        in-flight steps only reference pages of live sequences)."""
+        short = n - self.allocator.free_blocks
+        if short > 0 and self.prefix_cache is not None:
+            reclaimed = self.prefix_cache.evict(short)
+            if reclaimed:
+                self.allocator.free(reclaimed)
+        return self.allocator.allocate(n)
+
     def can_admit(self, prompt_len: int, max_new_tokens: int = 0) -> bool:
         """Admission requires the WORST-CASE block budget (prompt + all
         generated tokens) to be free right now — blocks are reserved at
         admit time, so a scheduled step can never exhaust the pool mid-run
-        (the failure mode lazy allocation would have)."""
+        (the failure mode lazy allocation would have). Unreferenced cached
+        prefix pages count as free: allocation evicts them on demand.
+        With a prefix cache attached, sequences that could WRAP the block
+        table (worst case spans more slots than the table holds — the
+        rolling-reuse regime) are refused outright: a wrap would rewrite
+        blocks the trie may share with other readers."""
         need = self._blocks_for(prompt_len + max_new_tokens)
-        return bool(self._free_slots) and self.allocator.free_blocks >= need
+        avail = self.allocator.free_blocks
+        if self.prefix_cache is not None:
+            if -(-(prompt_len + max_new_tokens) // self.block_size) \
+                    > self.max_blocks_per_seq:
+                return False
+            avail += self.prefix_cache.evictable_blocks
+        return bool(self._free_slots) and avail >= need
 
     def admit(self, uid: int, tokens: list[int], max_new_tokens: int,
               eos_id: int | None = None) -> SequenceDescriptor:
@@ -180,26 +232,122 @@ class StateManager:
             raise ValueError("empty prompt")
         if not self._free_slots:
             raise RuntimeError("no free sequence slots")
+        if self.prefix_cache is not None and \
+                -(-(len(tokens) + max_new_tokens) // self.block_size) \
+                > self.max_blocks_per_seq:
+            # shared pages sit at the table FRONT; a wrapped write (the
+            # rolling (pos // bs) % width slot formula firing) would
+            # rewrite a trie-owned block under every other reader —
+            # refuse rather than corrupt (can_admit mirrors this)
+            raise ValueError(
+                f"prefix cache requires non-wrapping tables: "
+                f"{len(tokens)} + {max_new_tokens} tokens exceed "
+                f"{self.max_blocks_per_seq} x {self.block_size}")
         seq = SequenceDescriptor(uid=uid, tokens=list(tokens),
                                  max_new_tokens=max_new_tokens,
                                  eos_id=eos_id,
                                  slot=self._free_slots.pop(0))
+        bs = self.block_size
+        shared_nodes: list = []
+        if self.prefix_cache is not None:
+            # longest cached page-aligned prefix; the LAST prompt token is
+            # always recomputed (its forward produces the first sample's
+            # logits), so the hit is capped one token short of the prompt
+            # (and at the block-table width for direct small-table users)
+            shared_nodes = self.prefix_cache.match(
+                tokens, max_tokens=min(len(tokens) - 1,
+                                       self.max_blocks_per_seq * bs))
+            # pin BEFORE allocating: _alloc under pressure evicts refs==0
+            # LRU pages, and an unpinned matched chain is exactly that —
+            # acquire first so the eviction scan can never reclaim a page
+            # this admit is about to serve from
+            if shared_nodes:
+                self.prefix_cache.acquire(shared_nodes)
+        n_need = self._blocks_for(len(tokens) + max_new_tokens)
         try:
-            seq.blocks = self.allocator.allocate(
-                self._blocks_for(len(tokens) + max_new_tokens))
+            fresh = self._alloc(n_need - len(shared_nodes))
         except RuntimeError:
+            if shared_nodes:
+                self.prefix_cache.release(shared_nodes)
             self._free_slots.insert(0, seq.slot)
             raise
+        if shared_nodes:
+            # adopt the cached chain: read-only pages at the table front,
+            # prefill (and the scheduler's chunk chain) starts at the
+            # page-aligned cached boundary
+            self._shared_nodes[uid] = shared_nodes
+            seq.n_shared_blocks = len(shared_nodes)
+            seq.n_computed = len(shared_nodes) * bs
+            seq.prefix_hit_tokens = seq.n_computed
+        seq.blocks = [n.block for n in shared_nodes] + fresh
         self.seqs[uid] = seq
         return seq
 
     def release(self, uid: int) -> None:
+        """Free a sequence's slot + pages. With a prefix cache attached,
+        full pages whose KV is COMPUTED are published into the trie
+        (blocks donated, dedup'd against concurrent publishers) instead of
+        freed; shared pages drop their refcount. Callers (engine flush)
+        must have drained in-flight steps referencing this uid first."""
         seq = self.seqs.pop(uid)
-        if seq.blocks:
+        if self.prefix_cache is not None and seq.slot >= 0:
+            self._shared_nodes.pop(uid, None)
+            to_free = self.prefix_cache.publish(
+                seq.tokens, seq.blocks, seq.n_shared_blocks,
+                min(seq.n_computed, len(seq.tokens)))
+            if to_free:
+                self.allocator.free(to_free)
+        elif seq.blocks:
             self.allocator.free(seq.blocks)
         if seq.slot >= 0:
             self._free_slots.append(seq.slot)
             self._free_slots.sort()
+
+    def audit(self) -> None:
+        """Debug-mode FULL-POOL audit: every non-trash block is owned by
+        exactly one of {free list, prefix trie, one sequence's owned
+        tail}; shared table entries point at live trie nodes; per-node
+        refcounts equal the number of live sequences sharing the block.
+        Raises AssertionError on any leak, double-own, or refcount drift
+        (DS_TPU_STATE_AUDIT=1 runs this from the engine's flush path)."""
+        free = list(self.allocator._free)
+        if len(set(free)) != len(free):
+            raise AssertionError("free list holds duplicate blocks")
+        owners: dict[int, str] = {b: "free" for b in free}
+        trie_blocks: set[int] = set()
+        if self.prefix_cache is not None:
+            self.prefix_cache.check()
+            trie_blocks = self.prefix_cache.blocks()
+            for b in trie_blocks:
+                if b in owners:
+                    raise AssertionError(f"block {b} in free list AND trie")
+                owners[b] = "trie"
+        ref_counts: dict[int, int] = {}
+        for uid, seq in self.seqs.items():
+            for j, b in enumerate(seq.blocks):
+                if j < seq.n_shared_blocks:
+                    if b not in trie_blocks:
+                        raise AssertionError(
+                            f"uid {uid} shares block {b} not owned by the "
+                            f"trie (stale page)")
+                    ref_counts[b] = ref_counts.get(b, 0) + 1
+                elif b in owners:
+                    raise AssertionError(
+                        f"block {b} owned by uid {uid} AND {owners[b]}")
+                else:
+                    owners[b] = f"uid {uid}"
+        if self.prefix_cache is not None:
+            for node in self.prefix_cache._nodes():
+                expect = ref_counts.get(node.block, 0)
+                if node.refs != expect:
+                    raise AssertionError(
+                        f"refcount drift on block {node.block}: trie says "
+                        f"{node.refs}, {expect} live sequence(s) share it")
+        n_all = self.allocator.num_blocks - 1     # block 0 is the trash slot
+        if len(owners) != n_all:
+            missing = set(range(1, self.allocator.num_blocks)) - set(owners)
+            raise AssertionError(f"leaked blocks (owned by nobody): "
+                                 f"{sorted(missing)}")
 
 
 @dataclass

@@ -39,6 +39,15 @@ class SplitFuseScheduler:
         #: shapes: a bounded menu of (rows, chunk) programs instead of
         #: one padded rectangle.
         self.pack = pack
+        #: pad packed prefill plans' row count UP to a multiple of this
+        #: (engine_v2 sets it to the tensor-axis size under tp_overlap so
+        #: every prefill program rings — the ROADMAP odd-row item: an
+        #: exact-k plan with rows % tp != 0 used to fall back to the
+        #: blocking TP path). Padded rows are empty (masked: uid -1,
+        #: distinct unused slots, trash-block writes, do_sample 0) — the
+        #: same convention full-width plans already use for idle rows.
+        #: 1 = exact-k, no padding.
+        self.row_multiple = 1
 
     def _desc(self, kind: str, T: int, entries,
               use_last_slots=(), n_rows: int | None = None) -> StepPlan:
@@ -162,10 +171,21 @@ class SplitFuseScheduler:
         shapes = {(self.chunk, S_max)}
         if not self.pack:
             return sorted(shapes)
-        for n_rows in range(1, S_max):
+        for k in range(1, S_max):
+            n_rows = self._pad_rows(k)
             for T in self._chunk_chain(n_rows):
                 shapes.add((T, n_rows))
         return sorted(shapes)
+
+    def _pad_rows(self, k: int) -> int:
+        """Packed-plan row count for ``k`` pending sequences: ``k`` rounded
+        up to ``row_multiple`` (capped at the table width — when max_seqs
+        itself doesn't divide, the full-width plan keeps today's per-
+        program ring fallback)."""
+        m = self.row_multiple
+        if m <= 1:
+            return k
+        return min(-(-k // m) * m, self.state.max_seqs)
 
     def _chunk_chain(self, n_rows: int) -> list[int]:
         """The T values a packed ``n_rows``-row prefill plan may carry:
@@ -250,7 +270,7 @@ class SplitFuseScheduler:
             n_rows = st.max_seqs
             T = self.chunk
             if self.pack and k < st.max_seqs:
-                n_rows = max(1, k)
+                n_rows = self._pad_rows(max(1, k))
                 chain = self._chunk_chain(n_rows)
                 if len(chain) > 1:
                     # don't pad a row wider than the largest pending

@@ -46,8 +46,12 @@ class Telemetry:
 
     def __init__(self, enabled: bool = False, span_buffer: int = 4096,
                  mirror_jax: bool = True, flight_recorder: int = 256,
-                 flight_recorder_path: str | None = None):
+                 flight_recorder_path: str | None = None,
+                 peer_snapshot_glob: str | None = None):
         self.enabled = bool(enabled)
+        #: glob of peer hosts' snapshot JSON files (write_snapshot); when
+        #: set, /metrics?aggregate=1 serves the fleet-wide merge
+        self.peer_snapshot_glob = peer_snapshot_glob
         self.tracer = SpanTracer(capacity=span_buffer, enabled=enabled,
                                  mirror_jax=mirror_jax)
         self.registry = MetricsRegistry()
@@ -78,9 +82,14 @@ class Telemetry:
                     mirror_jax: bool | None = None,
                     flight_recorder: int | None = None,
                     flight_recorder_path: str | None = None,
-                    http_port: int | None = None) -> "Telemetry":
+                    http_port: int | None = None,
+                    peer_snapshot_glob: str | None = None) -> "Telemetry":
         """In-place update so cached references stay valid. The span ring
         is rebuilt only when its capacity changes (history is then lost)."""
+        if peer_snapshot_glob is not None:
+            self.peer_snapshot_glob = peer_snapshot_glob
+            if self.server is not None:
+                self.server.peer_glob = peer_snapshot_glob
         if enabled is not None:
             self.enabled = bool(enabled)
             self.tracer.enabled = bool(enabled)
@@ -113,7 +122,8 @@ class Telemetry:
         way."""
         if self.server is None:
             server = TelemetryHTTPServer(self.registry,
-                                         health_fn=self._health)
+                                         health_fn=self._health,
+                                         peer_glob=self.peer_snapshot_glob)
             server.start(port)      # raises on a busy port — don't keep a
             self.server = server    # dead server blocking later attempts
         elif port not in (0, self.server.port):
@@ -141,6 +151,20 @@ class Telemetry:
     # -- reading ---------------------------------------------------------
     def snapshot(self) -> dict:
         return self.registry.snapshot()
+
+    def write_snapshot(self, path: str) -> None:
+        """Dump this registry's snapshot as JSON for a host-0 aggregate
+        scrape to merge (``/metrics?aggregate=1`` on the host whose
+        ``peer_snapshot_glob`` matches ``path``). Atomic (tmp + replace):
+        a peer scraping mid-write sees the previous snapshot, never a
+        torn file."""
+        import json as _json
+        import os as _os
+
+        tmp = f"{path}.tmp.{_os.getpid()}"
+        with open(tmp, "w", encoding="utf-8") as f:
+            _json.dump(self.registry.snapshot(), f)
+        _os.replace(tmp, path)
 
     def flight_dump(self, reason: str, path: str | None = None,
                     detail: str | None = None) -> dict:
@@ -192,7 +216,9 @@ def get_telemetry() -> Telemetry:
             if _default is None:
                 env_on = os.environ.get("DS_TPU_TELEMETRY", "") \
                     not in ("", "0", "false")
-                t = Telemetry(enabled=env_on)
+                t = Telemetry(enabled=env_on,
+                              peer_snapshot_glob=os.environ.get(
+                                  "DS_TPU_TELEMETRY_PEERS") or None)
                 if env_on:
                     port = os.environ.get("DS_TPU_TELEMETRY_PORT")
                     if port is not None:
@@ -212,7 +238,8 @@ def configure(config=None, **overrides) -> Telemetry:
     kw: dict = {}
     if config is not None:
         for k in ("enabled", "span_buffer", "mirror_jax", "flight_recorder",
-                  "flight_recorder_path", "http_port"):
+                  "flight_recorder_path", "http_port",
+                  "peer_snapshot_glob"):
             v = getattr(config, k, None)
             if v is not None:
                 kw[k] = v

@@ -7,13 +7,25 @@ text, and ``ThreadingHTTPServer`` on a daemon thread is enough for a
 scraper hitting the job every 15s. The server binds localhost by default —
 exposing beyond the host is a deployment decision (port-forward / sidecar),
 not a framework default.
+
+Fleet aggregation (the host-0 scrape): ``/metrics?aggregate=1`` serves a
+``MetricsRegistry.merge()`` of this process's registry with every peer
+snapshot file matching ``peer_glob`` (JSON files written by
+``Telemetry.write_snapshot`` on the other hosts — shared filesystem or
+sidecar-rsync'd). Counters and histogram buckets add, gauges last-write-
+win, so a fleet-wide prefix-hit-rate or TTFT histogram is one scrape of
+host 0 instead of N scrapes plus recording-rule math. Unreadable or
+mid-write peer files are skipped with a warning — a scrape never 500s on
+a torn snapshot.
 """
 from __future__ import annotations
 
+import glob as _glob
 import json
 import threading
 import time
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from urllib.parse import parse_qs, urlsplit
 
 from ..utils.logging import logger
 
@@ -26,16 +38,56 @@ class TelemetryHTTPServer:
     ``health_fn`` (optional) returns a dict merged into the ``/healthz``
     body — wire job identity / step counters in there. ``port=0`` binds an
     ephemeral port (tests); read it back from ``self.port``.
+    ``peer_glob`` (optional) enables ``/metrics?aggregate=1``: peer
+    snapshot files matching the glob merge into the response.
     """
 
-    def __init__(self, registry, health_fn=None, host: str = "127.0.0.1"):
+    def __init__(self, registry, health_fn=None, host: str = "127.0.0.1",
+                 peer_glob: str | None = None):
         self.registry = registry
         self.health_fn = health_fn
         self.host = host
+        self.peer_glob = peer_glob
         self.port: int | None = None
         self._httpd: ThreadingHTTPServer | None = None
         self._thread: threading.Thread | None = None
         self._t0 = time.time()
+
+    def render_aggregate(self) -> str:
+        """This registry merged with every readable peer snapshot file
+        (counters/buckets add, gauges LWW — MetricsRegistry.merge), plus
+        a ``telemetry_aggregated_peers`` gauge recording how many peers
+        actually folded in (a scrape that silently covered 3 of 8 hosts
+        would read as fleet-wide truth otherwise)."""
+        from .metrics import MetricsRegistry
+
+        agg = MetricsRegistry()
+        agg.merge(self.registry.snapshot())
+        n_peers = 0
+        for path in sorted(_glob.glob(self.peer_glob or "")):
+            # each peer folds in ALL-OR-NOTHING: merge into a trial copy
+            # and swap on success — a snapshot that fails mid-merge (e.g.
+            # histogram bucket mismatch from a peer on an older build)
+            # must not leave its earlier families half-counted in a
+            # response that then reports the peer as skipped
+            try:
+                with open(path, encoding="utf-8") as f:
+                    snap = json.load(f)
+                trial = MetricsRegistry()
+                trial.merge(agg.snapshot())
+                trial.merge(snap)
+            except (OSError, ValueError, KeyError, TypeError) as e:
+                # torn mid-write / vanished / malformed / incompatible
+                # peer file: skip it loudly, never 500 the scrape
+                logger.warning(f"telemetry aggregate: skipping peer "
+                               f"snapshot {path}: {e!r}")
+                continue
+            agg = trial
+            n_peers += 1
+        agg.gauge("telemetry_aggregated_peers",
+                  help="peer snapshot files merged into this aggregate "
+                       "scrape (excludes this process)").set(n_peers)
+        return agg.render_prometheus()
 
     def start(self, port: int = 0) -> int:
         if self._httpd is not None:
@@ -45,10 +97,16 @@ class TelemetryHTTPServer:
         class Handler(BaseHTTPRequestHandler):
             def do_GET(self):
                 try:
-                    if self.path.split("?")[0] == "/metrics":
-                        body = server.registry.render_prometheus().encode()
+                    parts = urlsplit(self.path)
+                    if parts.path == "/metrics":
+                        q = parse_qs(parts.query)
+                        if q.get("aggregate", ["0"])[0] not in ("", "0"):
+                            body = server.render_aggregate().encode()
+                        else:
+                            body = server.registry.render_prometheus() \
+                                .encode()
                         ctype = PROMETHEUS_CONTENT_TYPE
-                    elif self.path.split("?")[0] == "/healthz":
+                    elif parts.path == "/healthz":
                         health = {"status": "ok",
                                   "uptime_s": round(time.time() - server._t0, 3)}
                         if server.health_fn is not None:

@@ -39,6 +39,9 @@ HEAVY = [
     "test_tensor_parallel.py",
     # crash-recovery matrix: tiny-gpt2 engines on two mesh shapes
     "test_resilience.py",
+    # shared-prefix KV cache: warm-path parity matrix (several tiny-gpt2
+    # engine compiles) + the 600-trace eviction property run
+    "test_prefix_cache.py",
 ]
 
 

@@ -586,7 +586,14 @@ def test_v2_fp8_kv_cache_serves_close_to_bf16():
     assert ef8.kv_pool.dtype == jnp.float8_e4m3fn
     assert ef8.kv_pool.nbytes == e16.kv_pool.nbytes // 2
 
-    prompt = [5, 9, 2, 7, 1, 3, 8, 4, 6, 11, 13, 2]
+    # longer than the single-row chunk chain's largest T (chunk *
+    # max_seqs = 16): the PR-1 chunk growth let a 12-token prompt prefill
+    # in ONE dispatch, which turned the comparison below into a DECODE
+    # step on each engine's own (non-greedy) first sample — two different
+    # inputs, mean |logit delta| 0.096, the "pre-existing" PR-3-HEAD
+    # failure on this container. With 20 tokens the second plan really is
+    # the prefill chunk the comment promises.
+    prompt = [5, 9, 2, 7, 1, 3, 8, 4, 6, 11, 13, 2, 9, 1, 14, 3, 2, 8, 7, 6]
     for eng in (e16, ef8):
         eng.put(1, list(prompt), max_new_tokens=4)
     # two prefill chunks: the second attends the first THROUGH the pool,
@@ -596,6 +603,8 @@ def test_v2_fp8_kv_cache_serves_close_to_bf16():
         eng._drain(drain_all=True)
     p16 = e16.scheduler.next_step()
     pf8 = ef8.scheduler.next_step()
+    assert p16.kind == pf8.kind == "prefill"     # same tokens, via the pool
+    assert (p16.token_ids == pf8.token_ids).all()
     args16 = (jnp.asarray(p16.token_ids), jnp.asarray(p16.positions),
               jnp.asarray(p16.slot_map), jnp.asarray(p16.block_tables),
               jnp.asarray(p16.seq_lens), jnp.asarray(p16.sample_idx))

@@ -6,7 +6,11 @@ probes freeze the platform before set_cpu_devices can run), no silent
 recovery paths must not eat the faults the resilience layer surfaces), and
 no emitted metric/span tag that can't sanitize to a valid Prometheus
 metric name (bin/check_metric_names.py — /metrics must never 500 on a
-scrape because a rare branch registered a bad tag)."""
+scrape because a rare branch registered a bad tag), and no KV block-list
+mutation outside StateManager's refcounted alloc/free API
+(bin/check_state_invariants.py — with the shared-prefix trie a stray
+allocator.free or .blocks assignment frees pages other sequences still
+serve from)."""
 import importlib.util
 import os
 
@@ -25,6 +29,7 @@ def _load(name):
 lint = _load("check_import_time_devices")
 swallows = _load("check_exception_swallows")
 metric_lint = _load("check_metric_names")
+state_lint = _load("check_state_invariants")
 
 
 def test_repo_has_no_import_time_device_probes():
@@ -116,6 +121,55 @@ def test_metric_tag_detector_matches_runtime_sanitizer():
     for tag in ("Resilience/rewinds", "Train/fwd_ms", "a b-c.d", "9x",
                 "serving_ttft_s", "x:y", "__", "é"):
         assert metric_lint.sanitize(tag) == sanitize_metric_name(tag), tag
+
+
+# --- refcounted block-list ownership ----------------------------------------
+
+def test_repo_block_lists_go_through_refcounted_api():
+    violations = state_lint.check_repo(ROOT)
+    assert violations == [], "\n".join(violations)
+
+
+def test_state_invariant_detector_flags_stray_mutations(tmp_path):
+    bad = tmp_path / "deepspeed_tpu" / "bad.py"
+    bad.parent.mkdir()
+    bad.write_text(
+        "def hijack(st, seq, pc):\n"
+        "    st.allocator.free(seq.blocks)\n"        # stray free: flagged
+        "    seq.blocks = []\n"                      # assignment: flagged
+        "    seq.blocks.append(3)\n"                 # mutation: flagged
+        "    pc.prefix_cache.evict(2)\n"             # cache mutator: flagged
+        "    pc._prefix_cache.acquire([])\n"         # engine alias: flagged
+        "    n = st.allocator.free_blocks\n"         # read: ok
+        "    blocks = []\n"
+        "    blocks.extend(seq.blocks)\n"            # local scratch: ok
+        "    return n, pc.prefix_cache.stats()\n")   # read: ok
+    out = state_lint.check_file(str(bad))
+    assert len(out) == 5
+    assert ":2:" in out[0] and "allocator.free()" in out[0]
+    assert ":3:" in out[1] and "assignment" in out[1]
+    assert ":4:" in out[2] and ".blocks.append()" in out[2]
+    assert ":5:" in out[3] and "prefix_cache.evict()" in out[3]
+    assert ":6:" in out[4] and "prefix_cache.acquire()" in out[4]
+
+
+def test_state_invariant_detector_allows_the_api_itself(tmp_path):
+    """The allowlisted StateManager methods in ragged.py keep their direct
+    allocator/trie access — the rule targets everyone else."""
+    f = tmp_path / "deepspeed_tpu" / "inference" / "ragged.py"
+    f.parent.mkdir(parents=True)
+    f.write_text(
+        "class StateManager:\n"
+        "    def _alloc(self, n):\n"
+        "        self.allocator.free(self.prefix_cache.evict(1))\n"
+        "        return self.allocator.allocate(n)\n"
+        "    def release(self, uid):\n"
+        "        self.allocator.free([1])\n"
+        "        self.prefix_cache.publish([], [], 0, 0)\n"
+        "    def elsewhere(self):\n"
+        "        self.allocator.free([1])\n")        # wrong method: flagged
+    out = state_lint.check_file(str(f))
+    assert len(out) == 1 and ":9:" in out[0]
 
 
 def test_swallow_detector_allows_narrow_logged_and_del(tmp_path):

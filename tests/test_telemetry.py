@@ -264,6 +264,40 @@ def test_live_metrics_and_healthz_scrape_over_localhost():
     assert t.server is None
 
 
+def test_aggregate_scrape_merges_peer_snapshots(tmp_path):
+    """The host-0 fleet scrape (ROADMAP item): /metrics?aggregate=1 merges
+    every readable peer snapshot file into this process's registry —
+    counters add, gauges last-write-win — and a torn/garbage peer file is
+    skipped (logged), never a 500. The plain /metrics stays local-only."""
+    peer = Telemetry(enabled=True)
+    peer.registry.counter("serving_prefix_hit_tokens_total").inc(30)
+    peer.registry.gauge("serving_queue_depth").set(4)
+    peer.write_snapshot(str(tmp_path / "peer1.json"))
+    (tmp_path / "peer2.json").write_text("{ torn mid-wri")   # skipped
+
+    t = Telemetry(enabled=True,
+                  peer_snapshot_glob=str(tmp_path / "peer*.json"))
+    t.registry.counter("serving_prefix_hit_tokens_total").inc(12)
+    port = t.start_http(0)
+    try:
+        with urllib.request.urlopen(
+                f"http://127.0.0.1:{port}/metrics?aggregate=1",
+                timeout=10) as resp:
+            assert resp.status == 200
+            body = resp.read().decode()
+        lines = _assert_prometheus_wellformed(body)
+        assert any(line == "serving_prefix_hit_tokens_total 42.0"
+                   for line in lines)                    # 12 + 30 summed
+        assert any(line == "telemetry_aggregated_peers 1.0"
+                   for line in lines)                    # torn peer skipped
+        with urllib.request.urlopen(
+                f"http://127.0.0.1:{port}/metrics", timeout=10) as resp:
+            local = resp.read().decode()
+        assert "serving_prefix_hit_tokens_total 12.0" in local.splitlines()
+    finally:
+        t.stop_http()
+
+
 def test_busy_port_degrades_to_render_only_and_recovers():
     """A metrics-port collision must not kill the job (reconfigure logs and
     stays render-only) nor leave a dead server blocking later binds."""

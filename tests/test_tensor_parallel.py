@@ -259,6 +259,53 @@ def test_engine_v2_identical_tokens_tp_overlap_on_off(quant):
 
 
 @pytest.mark.slow
+def test_engine_v2_odd_row_packed_prefill_rings_tp2():
+    """ROADMAP odd-row item: exact-k packed prefill plans whose row count
+    doesn't divide the tensor axis used to fall back to the blocking TP
+    path per program. The engine now sets ``scheduler.row_multiple`` to
+    the ring degree, padding packed plans up to the next tp multiple
+    (masked rows), so with 1 or 3 pending sequences at tp=2 EVERY program
+    rings (tp_fallbacks == 0) and tokens stay identical to tp_overlap
+    off."""
+    from deepspeed_tpu.inference.engine_v2 import (InferenceEngineV2,
+                                                   RaggedInferenceConfig)
+    from deepspeed_tpu.models.transformer import ModelConfig, TransformerLM
+    from deepspeed_tpu.parallel.topology import MeshConfig, MeshTopology
+
+    mcfg = ModelConfig(vocab_size=128, hidden_size=64, num_layers=2,
+                       num_heads=4, max_seq_len=256,
+                       position_embedding="rope", norm="rmsnorm",
+                       activation="silu_glu", dtype=jnp.float32)
+    odd3 = [[1, 7, 3, 9, 5, 11, 2, 8], [4, 6, 10, 12, 3],
+            [13, 2, 5, 9, 1, 1, 7]]                  # k=3 -> 4 rows
+    odd1 = [[9, 4, 2, 7, 7, 3]]                      # k=1 -> 2 rows
+
+    def run(overlap):
+        eng = InferenceEngineV2(
+            TransformerLM(mcfg), None, RaggedInferenceConfig(
+                tensor_parallel=2, max_seqs=4, num_blocks=32, block_size=16,
+                chunk=16, max_seq_len=128, decode_window=4, greedy=True,
+                dtype=jnp.float32, tp_overlap=overlap,
+                use_pallas_decode=False),
+            topology=MeshTopology(MeshConfig(tensor=2, data=1)),
+            rng=jax.random.PRNGKey(0))
+        assert eng.scheduler.row_multiple == (2 if overlap else 1)
+        if overlap:
+            # the compile menu itself only carries ring-divisible rows
+            assert all(rows % 2 == 0 for _, rows
+                       in eng.scheduler.program_shape_menu())
+        out = [eng.generate(odd3, max_new_tokens=6),
+               eng.generate(odd1, max_new_tokens=6)]
+        return out, dict(eng.stats)
+
+    on, stats_on = run(True)
+    off, stats_off = run(False)
+    assert on == off
+    assert stats_on["tp_ring_matmuls"] > 0
+    assert stats_on["tp_fallbacks"] == 0, stats_on   # every program rang
+
+
+@pytest.mark.slow
 def test_qgmm_grouped_ring_matches_psum():
     """The MoE expert-GEMM grouped ring (engine_v2._qgmm row kind under
     tp_overlap: per-destination token-tile chunks + tile→expert slices
