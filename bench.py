@@ -25,6 +25,9 @@ The same artifact carries (in ``detail``):
 ``BENCH_MODE=fastgen`` runs only the serving benchmark standalone.
 ``BENCH_MODE=prefix_cache`` runs the shared-system-prompt workload: cold
 vs warm TTFT and prefill-tokens-computed through the radix prefix cache.
+``BENCH_MODE=spec_decode`` sweeps speculative decoding (both proposer
+backends x draft depths) against baseline decode on a repetitive-text
+workload: accept rate, tokens-per-verify, TTFT/TBT.
 Opt-outs: BENCH_SKIP_FASTGEN / BENCH_SKIP_LARGE / BENCH_SKIP_STREAM /
 BENCH_SKIP_LONG_FASTGEN (each =1), for constrained hosts.
 """
@@ -1026,6 +1029,154 @@ def prefix_cache_main():
     }), flush=True)
 
 
+def spec_decode_main():
+    """``BENCH_MODE=spec_decode``: speculative decoding vs baseline decode
+    (inference/speculative.py — tree-verify over the paged pool).
+
+    Workload: ``BENCH_SPEC_REQUESTS`` requests whose prompts tile a
+    ``BENCH_SPEC_MOTIF``-token motif to ``BENCH_SPEC_PROMPT`` tokens (the
+    repetitive/copy-heavy regime prompt-lookup thrives on) plus a short
+    unique tail, each generating ``BENCH_SPEC_GEN`` tokens. Phase
+    ``baseline`` serves it with spec off; then one phase per
+    (backend, draft depth) from ``BENCH_SPEC_BACKENDS`` x
+    ``BENCH_SPEC_DEPTHS``. The ``draft`` backend runs a same-weights
+    draft (built from the same init key) — the self-draft upper bound on
+    acceptance; ``ngram`` needs no extra weights at all. The artifact
+    reports per-phase accept rate, tokens-per-verify, decode tok/s, p50
+    TTFT and amortized p50 TBT — vs_baseline is the best phase's decode
+    tok/s over baseline's."""
+    from deepspeed_tpu.inference import InferenceEngineV2
+    from deepspeed_tpu.models import build_model
+    from deepspeed_tpu.parallel.topology import MeshTopology
+
+    model_name = os.environ.get("BENCH_MODEL", "gpt2-350m")
+    n_req = int(os.environ.get("BENCH_SPEC_REQUESTS", "8"))
+    motif_len = int(os.environ.get("BENCH_SPEC_MOTIF", "16"))
+    prompt_len = int(os.environ.get("BENCH_SPEC_PROMPT", "128"))
+    gen_len = int(os.environ.get("BENCH_SPEC_GEN", "48"))
+    depths = [int(d) for d in
+              os.environ.get("BENCH_SPEC_DEPTHS", "2,4,6").split(",")]
+    backends = [b for b in
+                os.environ.get("BENCH_SPEC_BACKENDS", "ngram,draft")
+                .split(",") if b]
+    max_seqs = int(os.environ.get("BENCH_MAX_SEQS", "8"))
+    chunk = int(os.environ.get("BENCH_CHUNK", "128"))
+    block_size = int(os.environ.get("BENCH_BLOCK_SIZE", "64"))
+    max_len = prompt_len + gen_len + 2 * block_size
+
+    model = build_model(model_name, max_seq_len=max_len + 16)
+    r = np.random.default_rng(0)
+    vocab = model.config.vocab_size
+    motif = [int(t) for t in r.integers(0, vocab, motif_len)]
+    prompts = []
+    for _ in range(n_req):
+        p = (motif * (-(-prompt_len // motif_len)))[:prompt_len - 4]
+        p += [int(t) for t in r.integers(0, vocab, 4)]     # unique tail
+        prompts.append(p)
+    blocks_per_seq = -(-max_len // block_size)
+
+    def build(spec_cfg):
+        kw = {}
+        if spec_cfg.get("spec_decode") == "draft":
+            # same model + same init key = identical weights: the
+            # self-draft acceptance upper bound, no second checkpoint
+            kw = {"draft_model": model,
+                  "draft_rng": jax.random.PRNGKey(0)}
+        return InferenceEngineV2(
+            model, rng=jax.random.PRNGKey(0),
+            config={"block_size": block_size, "chunk": chunk,
+                    "max_seqs": max_seqs, "max_seq_len": max_len,
+                    "num_blocks": (max_seqs + 1) * blocks_per_seq + 1,
+                    "greedy": True, **spec_cfg},
+            topology=MeshTopology({"tensor": 1, "data": 1}), **kw)
+
+    def phase(eng, uid0):
+        for k in eng.stats:
+            if k != "d2h_latency_s":
+                eng.stats[k] = 0 if isinstance(eng.stats[k], int) else 0.0
+        pending = list(range(n_req))
+        live, admit_t, last_t = set(), {}, {}
+        ttft, tbt = {}, []
+        toks = {}
+        t0 = time.perf_counter()
+        while pending or live:
+            while pending and len(live) < max_seqs and \
+                    eng.can_schedule(len(prompts[pending[0]]), gen_len):
+                i = pending.pop(0)
+                eng.put(uid0 + i, list(prompts[i]), gen_len)
+                admit_t[uid0 + i] = time.perf_counter()
+                live.add(uid0 + i)
+            stepped = eng.step()
+            now = time.perf_counter()
+            for uid, new in stepped.items():
+                if not new:
+                    continue
+                toks[uid] = toks.get(uid, 0) + len(new)
+                if uid not in ttft:
+                    ttft[uid] = now - admit_t[uid]
+                else:
+                    # burst-amortized TBT: n tokens dt apart = n samples
+                    tbt.extend([(now - last_t[uid]) / len(new)] * len(new))
+                last_t[uid] = now
+            for uid in list(live):
+                seq = eng.state.seqs.get(uid)
+                if seq is not None and seq.done:
+                    eng.flush(uid)
+                    live.remove(uid)
+        wall = time.perf_counter() - t0
+        st = eng.stats
+        n_tok = sum(toks.values())
+        verifies = max(st["spec_verifies"], 1)
+        return {
+            "wall_s": round(wall, 3),
+            "gen_tokens": n_tok,
+            "gen_tok_per_s": round(n_tok / max(wall, 1e-9), 1),
+            "p50_ttft_s": round(float(np.percentile(
+                list(ttft.values()), 50)), 4),
+            "p50_tbt_s": round(float(np.percentile(tbt, 50)), 5) if tbt
+            else None,
+            "spec_rounds": st["spec_rounds"],
+            "spec_proposed": st["spec_proposed"],
+            "spec_accepted": st["spec_accepted"],
+            "spec_accept_rate": st["spec_accept_rate"],
+            "spec_steps_saved": st["spec_steps_saved"],
+            "tokens_per_verify": round(
+                (st["spec_accepted"] + st["spec_verifies"]) / verifies, 3)
+            if st["spec_verifies"] else None,
+        }
+
+    eng = build({})
+    results = {"baseline": phase(eng, 0)}
+    del eng
+    for backend in backends:
+        for depth in depths:
+            eng = build({"spec_decode": backend, "spec_depth": depth,
+                         "spec_max_nodes": max(8, depth + 2)})
+            results[f"{backend}_d{depth}"] = phase(eng, 0)
+            del eng
+    base_tps = results["baseline"]["gen_tok_per_s"]
+    spec_keys = [k for k in results if k != "baseline"]
+    best = max(spec_keys, key=lambda k: results[k]["gen_tok_per_s"])
+    print(json.dumps({
+        "metric": f"{model_name} speculative decoding, {n_req} reqs x "
+                  f"{prompt_len} motif-repeat prompt + {gen_len} gen "
+                  f"({_devices()[0].device_kind})",
+        "value": results[best]["tokens_per_verify"],
+        "unit": f"tokens/verify at best phase ({best}; accept rate "
+                f"{results[best]['spec_accept_rate']})",
+        "vs_baseline": round(results[best]["gen_tok_per_s"]
+                             / max(base_tps, 1e-9), 2),
+        "detail": {
+            **results,
+            "baseline_note": "same engine config, spec_decode=None: "
+                             "vs_baseline = best spec phase decode tok/s "
+                             "over baseline's (serial-steps saved only "
+                             "pay off when the verify forward costs less "
+                             "than the steps it replaces)",
+        },
+    }), flush=True)
+
+
 def main():
     # the FIRST device touch, under a bounded watchdog: a downed PJRT
     # tunnel must produce a structured JSON error line, never a hang
@@ -1035,6 +1186,8 @@ def main():
         return tp_matmul_main()
     if os.environ.get("BENCH_MODE") == "prefix_cache":
         return prefix_cache_main()
+    if os.environ.get("BENCH_MODE") == "spec_decode":
+        return spec_decode_main()
     if os.environ.get("BENCH_MODE") == "fastgen":
         return fastgen_main(with_sequential=True, sla=True)
     if os.environ.get("BENCH_MODE") == "fastgen_sweep":

@@ -17,7 +17,13 @@ in ``deepspeed_tpu/`` outside the allowlisted ``StateManager`` methods:
   ``match`` included because a matched chain must be acquired in the same
   host operation, before any other admit/evict can run);
 - assignments to a ``.blocks`` attribute, and mutating method calls on
-  one (``.blocks.append(...)`` etc.).
+  one (``.blocks.append(...)`` etc.);
+- assignments to a ``.n_provisional`` attribute (speculative decoding's
+  provisional-slot marker): legal ONLY inside the rollback-aware
+  ``StateManager`` methods (``provision`` / ``commit_speculative`` /
+  ``rollback_provisional`` / ``rewind``) — a stray mutation elsewhere
+  would let a verify round's rejected candidates skip the rollback
+  bookkeeping and desync the full-pool ``audit()``.
 
 Reads (``allocator.free_blocks``, ``prefix_cache.stats()``, iterating
 ``seq.blocks``) are fine anywhere.
@@ -40,6 +46,8 @@ ALLOWED = {
     "allocator": {"_alloc", "release"},
     "prefix_cache": {"admit", "release", "_alloc"},
     "blocks": {"admit"},
+    "n_provisional": {"provision", "commit_speculative",
+                      "rollback_provisional", "rewind"},
 }
 
 #: mutating list-method names (on a ``.blocks`` attribute)
@@ -116,6 +124,9 @@ class _Visitor(ast.NodeVisitor):
             if isinstance(t, ast.Attribute) and t.attr == "blocks":
                 self._flag(node, "blocks",
                            "assignment to a .blocks attribute")
+            elif isinstance(t, ast.Attribute) and t.attr == "n_provisional":
+                self._flag(node, "n_provisional",
+                           "assignment to a .n_provisional attribute")
             elif isinstance(t, (ast.Tuple, ast.List)):
                 self._check_targets(node, t.elts)
 

@@ -83,6 +83,18 @@ def feature_report() -> list[tuple[str, bool, str]]:
     cxx = shutil.which("g++") or shutil.which("clang++")
     feats.append(("C++ toolchain", cxx is not None, cxx or "no g++/clang++"))
 
+    # speculative decoding (inference/speculative.py): both proposer
+    # backends are pure in-process logic — availability is an import
+    # check, not a hardware one (the verify forward runs wherever the
+    # engine does)
+    try:
+        from .inference import speculative as _spec  # noqa: F401
+        feats.append(("inference: speculative decoding", True,
+                      "engine_v2 spec_decode={'ngram','draft'} "
+                      "(tree-verify over the paged pool)"))
+    except Exception as e:  # pragma: no cover — import breakage only
+        feats.append(("inference: speculative decoding", False, str(e)))
+
     # telemetry / monitor backends (telemetry/ + monitor/): which push
     # backends can actually activate, and where the pull endpoint +
     # flight recorder would land for this process

@@ -60,8 +60,8 @@ from ..utils.logging import logger
 from ..ops.pallas.paged_attention import (paged_attention_usable,
                                           paged_ragged_attention)
 from .ragged import StateManager, StepPlan
-from .sampling import sample_logits
-from .scheduler import SplitFuseScheduler
+from .sampling import sample_logits, sample_tree_logits
+from .scheduler import SpecAcceptTracker, SplitFuseScheduler
 from .weights import load_tp_params
 
 Pytree = Any
@@ -218,6 +218,39 @@ class RaggedInferenceConfig:
     #: True/False forces the choice for every quantized dense matmul
     #: (profiling escape hatch; int4 always keeps the Pallas kernel).
     quant_small_m_xla: bool | None = None
+    #: speculative decoding (inference/speculative.py): None = off;
+    #: "ngram" = self-speculative prompt-lookup proposer (no extra
+    #: weights — candidates come from the sequence's own history);
+    #: "draft" = a small draft model running in-process against its own
+    #: paged KV pool (pass ``draft_model``/``draft_params`` to the engine
+    #: constructor). Decode dispatches become verify rounds: one batched
+    #: forward checks a k-token candidate tree per sequence against the
+    #: paged pool under a tree-attention mask, exact accept/reject
+    #: sampling commits every accepted token in one step (greedy mode is
+    #: bit-identical to baseline decode), and rejected provisional tokens
+    #: roll back through StateManager so audits stay clean. Refused in
+    #: rolling-window ring mode (provisional slots would alias live ring
+    #: pages) and under forced-ring tp_overlap (the verify forward runs
+    #: all-position logits, which the token-sharded stream doesn't carry).
+    spec_decode: str | None = None
+    #: max candidate chain depth per proposal round (adapted per tenant —
+    #: see spec_adapt); also bounds the draft mirror's decode budget
+    spec_depth: int = 4
+    #: candidate-tree node budget per sequence (root included); branchy
+    #: n-gram proposals are truncated here so the verify width is bounded
+    spec_max_nodes: int = 8
+    #: n-gram proposer: distinct candidate branches per tree
+    spec_branches: int = 2
+    #: n-gram proposer: longest/shortest history n-gram matched
+    spec_ngram_max: int = 3
+    spec_ngram_min: int = 1
+    #: cap on draft depth while prefill chunks are PENDING (the
+    #: decode_window_mixed_cap idea: a waiting first chunk must not sit
+    #: behind a max-depth verify round). 0 disables the cap.
+    spec_depth_mixed_cap: int = 2
+    #: adapt per-tenant draft depth from the acceptance-rate EMA
+    #: (scheduler.SpecAcceptTracker); False pins spec_depth for everyone
+    spec_adapt: bool = True
     #: serving-SLO telemetry (telemetry/): TTFT / time-between-tokens /
     #: queue-wait histograms, per-step occupancy, KV-page utilization,
     #: host spans around dispatch/drain. True enables the PROCESS-WIDE
@@ -238,7 +271,10 @@ class InferenceEngineV2:
     def __init__(self, model: TransformerLM, params: Pytree | None = None,
                  config: RaggedInferenceConfig | dict | None = None,
                  topology: MeshTopology | None = None,
-                 rng: jax.Array | None = None):
+                 rng: jax.Array | None = None,
+                 draft_model: TransformerLM | None = None,
+                 draft_params: Pytree | None = None,
+                 draft_rng: jax.Array | None = None):
         if isinstance(config, dict):
             config = RaggedInferenceConfig(**config)
         self.config = config or RaggedInferenceConfig()
@@ -274,8 +310,13 @@ class InferenceEngineV2:
         # --- shared-prefix KV cache (radix reuse over the pool) ----------
         use_pc = cfg.prefix_cache
         if use_pc is None:
-            use_pc = (self.scheduler.pack and not self._ring_tokens
-                      and cfg.kv_cache_dtype != "fp8")
+            # auto: ON for pack-mode linear serving, fp8-KV pages
+            # included — published pages are served bit-for-bit (zero
+            # copy, no requantization), and the cross-request
+            # suffix-divergence parity test (tests/test_inference_v2.py::
+            # test_v2_fp8_kv_prefix_cache_cross_request_parity) pins warm
+            # == cold greedy streams at e4m3 granularity
+            use_pc = self.scheduler.pack and not self._ring_tokens
         if use_pc and self._ring_tokens:
             raise ValueError(
                 "prefix_cache=True cannot combine with a sliding-window "
@@ -478,7 +519,16 @@ class InferenceEngineV2:
                       # ring collective-matmul overlap (trace-time deltas
                       # from parallel/tensor.py — see _refresh_tp_stats)
                       "tp_ring_matmuls": 0, "tp_ring_steps": 0,
-                      "tp_bytes_permuted": 0, "tp_fallbacks": 0}
+                      "tp_bytes_permuted": 0, "tp_fallbacks": 0,
+                      # speculative decoding (inference/speculative.py):
+                      # rounds = batched verify dispatches, verifies =
+                      # per-sequence verify commits, proposed/accepted =
+                      # candidate (non-root) tree tokens, steps_saved =
+                      # committed tokens beyond the one a baseline decode
+                      # step would have produced
+                      "spec_rounds": 0, "spec_verifies": 0,
+                      "spec_proposed": 0, "spec_accepted": 0,
+                      "spec_steps_saved": 0, "spec_accept_rate": 0.0}
         # measure the host<->device readback latency ONCE instead of
         # guessing it (VERDICT r04 weak #4: a fixed 0.15s age gate meant
         # the opportunistic commit path never fired — every drain
@@ -501,10 +551,90 @@ class InferenceEngineV2:
         self._d2h_latency = float(np.median(lat))
         self._drain_age = min(2.0 * self._d2h_latency, 0.5)
         self.stats["d2h_latency_s"] = round(self._d2h_latency, 4)
+
+        # --- speculative decoding (inference/speculative.py) -------------
+        self._spec = None
+        self._spec_tracker = None
+        self._draft_engine = None
+        # tokens committed by spec rounds inside _dispatch_next, folded
+        # into step()'s emitted dict before it returns
+        self._spec_emit: dict[int, list[int]] = {}
+        if cfg.spec_decode:
+            self._init_speculative(draft_model, draft_params, draft_rng)
         logger.info(
             f"engine_v2 up: blocks={cfg.num_blocks}x{cfg.block_size} "
             f"pool={self.kv_pool.nbytes / 1e6:.0f}MB max_seqs={cfg.max_seqs} "
             f"chunk={cfg.chunk} tp={topology.size('tensor')}")
+
+    def _init_speculative(self, draft_model, draft_params, draft_rng) -> None:
+        """Bring up the configured proposer backend + the per-tenant
+        accept-rate tracker (see ``RaggedInferenceConfig.spec_decode``).
+        ``spec_decode="draft"`` builds a SECOND engine for the draft model
+        in the same process — its own paged pool, allocator, and
+        scheduler, stepping synchronously (no async pipeline, no windows:
+        the proposer decodes exactly ``depth`` tokens per round and a
+        window would run past them into the mirror's budget)."""
+        cfg = self.config
+        from .speculative import DraftModelProposer, NGramProposer
+
+        if cfg.spec_decode not in ("ngram", "draft"):
+            raise ValueError(f"spec_decode must be None, 'ngram' or "
+                             f"'draft', got {cfg.spec_decode!r}")
+        if self._ring_tokens:
+            raise ValueError(
+                "spec_decode cannot combine with a sliding-window rolling "
+                "KV ring: provisional verify slots past the committed tail "
+                "would alias live ring pages (serve linear or disable "
+                "spec_decode)")
+        if self._tp_ring_force:
+            raise ValueError(
+                "spec_decode cannot combine with tp_overlap=True: the "
+                "verify forward samples all-position logits, which the "
+                "forced token-sharded ring stream does not carry (auto "
+                "mode is fine — verify programs fall back per-program)")
+        if cfg.spec_depth < 1:
+            raise ValueError(f"spec_depth must be >= 1, got {cfg.spec_depth}")
+        if cfg.spec_max_nodes < 2:
+            raise ValueError(f"spec_max_nodes must be >= 2 (root + one "
+                             f"candidate), got {cfg.spec_max_nodes}")
+        # depth may never exceed the tree width budget (a chain of depth d
+        # is d+1 nodes) — clamp here so every later depth request is valid
+        base_depth = min(cfg.spec_depth, cfg.spec_max_nodes - 1)
+        self._spec_tracker = SpecAcceptTracker(base_depth)
+        if cfg.spec_decode == "ngram":
+            self._spec = NGramProposer(
+                base_depth, ngram_max=cfg.spec_ngram_max,
+                ngram_min=cfg.spec_ngram_min, branches=cfg.spec_branches,
+                max_nodes=cfg.spec_max_nodes)
+            return
+        if draft_model is None:
+            raise ValueError("spec_decode='draft' needs a draft_model= "
+                             "(and usually draft_params=) at engine "
+                             "construction")
+        self._draft_engine = InferenceEngineV2(
+            draft_model, params=draft_params,
+            config={
+                "block_size": cfg.block_size,
+                "num_blocks": cfg.num_blocks,
+                "max_seqs": cfg.max_seqs,
+                "chunk": cfg.chunk,
+                # mirrors may overrun their own depth while a slower
+                # mirror catches up (the proposal loop runs the WHOLE
+                # draft engine up to 2*depth+4 steps per round); the
+                # rewind next round discards the surplus, and rewind
+                # caps the restarted budget to the admit-time block
+                # reservation so the overrun KV always fits the pages
+                "max_seq_len": cfg.max_seq_len + 2 * base_depth + 4,
+                "dtype": cfg.dtype,
+                "greedy": True,          # proposals are the draft argmax
+                "decode_window": 1,
+                "max_inflight": 0,       # synchronous mirror stepping
+                "prefix_cache": False,
+                "telemetry": False,
+                "use_pallas_decode": cfg.use_pallas_decode,
+            },
+            rng=draft_rng)
+        self._spec = DraftModelProposer(self._draft_engine)
 
     # ------------------------------------------------------------------
     @staticmethod
@@ -746,7 +876,8 @@ class InferenceEngineV2:
     # ------------------------------------------------------------------
     def _ragged_forward(self, params, kv_pool, token_ids, positions, slot_map,
                         block_tables, seq_lens, sample_idx,
-                        kv_stage=None, stage_fill=None, stage_starts=None):
+                        kv_stage=None, stage_fill=None, stage_starts=None,
+                        tree_mask=None):
         """One ragged forward over a READ-ONLY pool.
 
         The pool holds only ALREADY-MERGED tokens (positions
@@ -763,6 +894,18 @@ class InferenceEngineV2:
         ``stage_fill`` = this iteration's row): writes row ``stage_fill``,
         attends over rows < this iteration's length, returns
         ((k_buf, v_buf), logits) and the CALLER merges after the loop.
+        Tree mode (``tree_mask`` [S, T, T] uint8): the speculative VERIFY
+        forward — row t of a sequence is a candidate-tree node whose
+        position is root + depth and whose visibility over the staged
+        fresh KV is ancestors-only (siblings share a POSITION, which
+        positional-causal masking cannot tell apart, hence the explicit
+        mask; the paged pool below the root stays position-causal).
+        Returns ((k_ys, v_ys), logits[S, T, V]) — ALL-node logits, no
+        pool merge: the caller merges only the ACCEPTED path's staged
+        rows, so rejected candidates never reach the pool. Always runs
+        the XLA gather formulation — the Pallas kernel's online softmax
+        is positional (tree-mask kernel support is a ROADMAP item) — and
+        never rings (all-position logits need the full residual stream).
         """
         m = self.mcfg
         cfg = self.config
@@ -771,6 +914,7 @@ class InferenceEngineV2:
         ctx = self.state.max_blocks_per_seq * bs
         H, KV, D = m.num_heads, m.kv_heads, m.head_dim
         window_mode = kv_stage is not None
+        tree_mode = tree_mask is not None
         if stage_starts is None:
             stage_starts = positions[:, 0]
         if window_mode:
@@ -791,7 +935,7 @@ class InferenceEngineV2:
         # weight re-reads for a tiny hidden collective; tp_overlap=True
         # overrides for measurement)
         rn = self._tp_ring_n
-        if rn and (S % rn or not (
+        if rn and (tree_mode or S % rn or not (
                 self._tp_ring_force
                 or (S * T) // rn >= self.config.tp_overlap_min_rows)):
             overlap_counters.fallback()
@@ -1064,7 +1208,7 @@ class InferenceEngineV2:
             ring = self._ring_tokens
             li_dev = jnp.asarray(li, jnp.int32)
             q_starts = positions[:, 0]
-            if self._pallas_decode:
+            if self._pallas_decode and not tree_mode:
                 mesh = self.topology.mesh
                 if mesh.size > 1:
                     # per-shard over the tensor axis: q on query heads, the
@@ -1131,7 +1275,15 @@ class InferenceEngineV2:
                     cpos_pool = jnp.broadcast_to(jnp.arange(ctx)[None, :],
                                                  (S, ctx))
                     valid_pool = cpos_pool < sstart
-                cpos_st = sstart + jnp.arange(Ts)[None, :]       # [S,Ts]
+                if tree_mode:
+                    # stage entries are tree nodes: their ABSOLUTE
+                    # positions come from the positions array (root +
+                    # depth; siblings share one), not a contiguous ramp —
+                    # alibi's relative bias below reads these; validity/
+                    # causality over the stage is the ancestors-only mask
+                    cpos_st = jnp.pad(positions, ((0, 0), (0, Ts - T)))
+                else:
+                    cpos_st = sstart + jnp.arange(Ts)[None, :]   # [S,Ts]
                 cpos = jnp.concatenate([cpos_pool, cpos_st], axis=1)
                 valid = jnp.concatenate(
                     [valid_pool, cpos_st < seq_lens[:, None]], axis=1)
@@ -1147,6 +1299,16 @@ class InferenceEngineV2:
                 if win:
                     causal &= cpos[:, None, :] > positions[:, :, None] - win
                 mask = valid & causal[:, None, :, :]
+                if tree_mode:
+                    # stage columns: ancestors-only visibility replaces
+                    # the positional mask entirely (padding nodes carry
+                    # all-zero mask rows except their self-bit, set by
+                    # the caller); pool columns keep the causal mask —
+                    # every node descends from the committed context
+                    tm = jnp.pad(tree_mask.astype(bool),
+                                 ((0, 0), (0, 0), (0, Ts - T)))
+                    mask = jnp.concatenate(
+                        [mask[..., :ctx], tm[:, None, :, :]], axis=-1)
                 scores = jnp.where(mask, scores, jnp.finfo(jnp.float32).min)
                 w = jax.nn.softmax(scores, axis=-1).astype(V.dtype)
                 o = jnp.einsum("shtc,schd->sthd", w, V)
@@ -1250,8 +1412,14 @@ class InferenceEngineV2:
                 v_list.append(stage_l[1])
             k_ys, v_ys = jnp.stack(k_list), jnp.stack(v_list)
         x = Norm(m).apply({"params": params["ln_final"]}, x)
-        last = jnp.take_along_axis(
-            x, sample_idx[:, None, None].astype(jnp.int32), axis=1)[:, 0]  # [S,E]
+        if tree_mode:
+            # the verify step samples at EVERY tree node: all-position
+            # logits ([S*T, E] rows through the same projection paths)
+            last = x.reshape(S * T, -1)
+        else:
+            last = jnp.take_along_axis(
+                x, sample_idx[:, None, None].astype(jnp.int32),
+                axis=1)[:, 0]                                      # [S,E]
         if rn:
             # leave the token-sharded stream: the logits projection reads
             # S rows total — replicating them is noise next to the weight
@@ -1280,6 +1448,11 @@ class InferenceEngineV2:
             logits = jnp.einsum("se,ev->sv", last, params["unembed"].astype(cfg.dtype))
         if m.unembed_bias:
             logits = logits + params["unembed_b"].astype(cfg.dtype)
+        if tree_mode:
+            # verify mode: NO pool write here — the caller merges only
+            # the accepted path's staged rows (_spec_merge_program), so
+            # rejected candidates never touch the pool
+            return (k_ys, v_ys), logits.reshape(S, T, -1)
         if window_mode:
             # the window loop keeps accumulating into the staged buffers;
             # the caller merges them into the pool once, after the loop
@@ -1700,13 +1873,265 @@ class InferenceEngineV2:
                                             self.state.max_seqs, ())
         return True
 
+    def _spec_program(self, T: int):
+        """The speculative VERIFY forward: one batched tree-masked step
+        over the read-only pool ([S, T] candidate-tree nodes per row,
+        ancestors-only stage visibility) sampling the TARGET distribution
+        at EVERY node. Returns the staged fresh KV (k_ys, v_ys) and the
+        per-node samples — the pool is NOT written here; the caller
+        merges only the accepted path (:meth:`_spec_merge_program`), so
+        rejected candidates never reach the pool."""
+        key = ("spec", T)
+        if key not in self._programs:
+            cfg = self.config
+
+            def run(params, kv_pool, token_ids, positions, slot_map,
+                    block_tables, seq_lens, tree_mask, rng):
+                with nn.logical_axis_rules(self._rules):
+                    (k_ys, v_ys), logits = self._ragged_forward(
+                        params, kv_pool, token_ids, positions, slot_map,
+                        block_tables, seq_lens,
+                        jnp.zeros(token_ids.shape[0], jnp.int32),
+                        tree_mask=tree_mask)
+                toks = sample_tree_logits(logits.astype(jnp.float32), rng,
+                                          temperature=cfg.temperature,
+                                          top_k=cfg.top_k, top_p=cfg.top_p,
+                                          greedy=cfg.greedy)
+                return k_ys, v_ys, toks
+
+            run.__name__ = "step_spec_verify"
+            repl = NamedSharding(self.topology.mesh, P())
+            # pool NOT donated: it stays live (unchanged) for the merge
+            # program that runs after the host-side acceptance walk
+            self._programs[key] = jax.jit(
+                run, in_shardings=(None, self._pool_format) + (None,) * 7,
+                out_shardings=(repl, repl, repl))
+        return self._programs[key]
+
+    def _spec_merge_program(self, T: int):
+        """THE pool write of a spec round: fold the verify step's staged
+        KV rows into the paged pool, row n ↔ ``flat_slots[n]`` (host-built
+        AFTER the acceptance walk — accepted-path nodes get their
+        sequence's tail-page slots, every rejected/padding node points at
+        the trash block, so unaccepted KV never lands in a real page)."""
+        key = ("spec_merge", T)
+        if key not in self._programs:
+            m = self.mcfg
+
+            def run(kv_pool, k_ys, v_ys, flat_slots):
+                L, S = k_ys.shape[0], k_ys.shape[1]
+                ks = (k_ys[:, :, :, :T, :].transpose(0, 1, 3, 2, 4)
+                      .reshape(L, S * T, m.kv_heads, m.head_dim))
+                vs = (v_ys[:, :, :, :T, :].transpose(0, 1, 3, 2, 4)
+                      .reshape(L, S * T, m.kv_heads, m.head_dim))
+                return self._merge_rows(kv_pool, flat_slots, ks, vs)
+
+            run.__name__ = "spec_merge"
+            self._programs[key] = jax.jit(
+                run, donate_argnums=(0,),
+                in_shardings=(self._pool_format, None, None, None),
+                out_shardings=self._pool_format)
+        return self._programs[key]
+
+    def _try_dispatch_spec(self, prefill_pending: bool = False) -> bool:
+        """One speculative round over every decode-ready sequence: propose
+        candidate trees (n-gram lookup or draft-model mirrors), run ONE
+        batched tree-masked verify forward, walk exact acceptance on the
+        host, merge only the accepted path's KV, and commit — several
+        tokens per target forward when candidates hit, a plain decode's
+        worth when they don't. Returns False (nothing dispatched) when no
+        sequence is decode-ready or no proposer produced a candidate —
+        the window/plain decode path then serves as before.
+
+        Spec rounds are SYNCHRONOUS: the async pipeline is drained first
+        (``provision`` verifies from committed state) and the round's
+        verify → accept → merge → commit runs to completion inside this
+        call, so no provisional state ever outlives it. The drain is paid
+        only when the proposer's ``probe`` says candidates plausibly
+        exist — a lookup miss on non-repetitive text stays a plain
+        pipelined decode step."""
+        cfg = self.config
+        if not any(not s.sched_done and s.slot >= 0 and s.pending_sched == 1
+                   for s in self.state.seqs.values()):
+            return False
+        if self._inflight:
+            # probe on the committed token view BEFORE the blocking
+            # drain, over the sequences a round could actually use:
+            # decode-ready in the SCHEDULED view (mid-prefill rows would
+            # make a repetitive prompt drain the pipeline for nothing)
+            # and with the same depth caps the request loop applies (a
+            # budget-exhausted row proposes depth 0). Advisory only:
+            # in-flight tokens may shift the history tail, so a false
+            # negative is just a plain decode step and a false positive
+            # costs one drain — same as before
+            probe: dict[int, tuple[list[int], int]] = {}
+            for s in self.state.seqs.values():
+                if s.sched_done or s.slot < 0 or s.pending_sched != 1:
+                    continue
+                d = self._spec_tracker.depth(
+                    s.uid, prefill_pending=prefill_pending,
+                    mixed_cap=cfg.spec_depth_mixed_cap)
+                d = min(d, s.gen_remaining_sched - 1)
+                if d >= 1:
+                    probe[s.uid] = (s.tokens, d)
+            if not probe or not self._spec.probe(probe):
+                return False
+            for uid, new in self._drain(drain_all=True).items():
+                self._spec_emit.setdefault(uid, []).extend(new)
+        live = [s for s in self.state.seqs.values()
+                if not s.done and s.slot >= 0 and s.pending_tokens == 1
+                and s.n_generated < s.max_new_tokens]
+        if not live:
+            return False
+
+        t0 = time.perf_counter()
+        T = cfg.spec_max_nodes
+        requests: dict[int, tuple[list[int], int]] = {}
+        for s in live:
+            d = self._spec_tracker.depth(
+                s.uid, prefill_pending=prefill_pending,
+                mixed_cap=cfg.spec_depth_mixed_cap)
+            # the commit may emit depth+1 tokens (accepted chain + bonus):
+            # cap one short of the remaining budget so provision() and the
+            # block reservation are honoured by construction
+            d = min(d, s.max_new_tokens - s.n_generated - 1)
+            requests[s.uid] = (list(s.tokens), max(d, 0))
+        trees = self._spec.propose(requests)
+        if all(t.n_candidates == 0 for t in trees.values()):
+            self.stats["plan_s"] += time.perf_counter() - t0
+            return False     # nothing to verify — plain decode is cheaper
+
+        from .speculative import accept_walk
+
+        S = self.state.max_seqs
+        mb = self.state.max_blocks_per_seq
+        bs = cfg.block_size
+        tok = np.zeros((S, T), np.int32)
+        pos = np.zeros((S, T), np.int32)
+        tables = np.zeros((S, mb), np.int32)
+        lens = np.zeros(S, np.int32)
+        mask = np.zeros((S, T, T), np.uint8)
+        # every row starts as self-bits only: empty slots and padding
+        # nodes must never see an all-masked softmax row (NaN)
+        mask[:, np.arange(T), np.arange(T)] = 1
+        meta: dict[int, tuple[int, Any]] = {}    # uid -> (slot, tree)
+        try:
+            for s in live:
+                tree = trees[s.uid]
+                depths = tree.depths()
+                self.state.provision(s.uid, max(depths))
+                sl = s.slot
+                n = tree.n_nodes
+                tok[sl, :n] = tree.tokens
+                root = len(s.tokens) - 1
+                pos[sl, :n] = [root + d for d in depths]
+                tables[sl, :len(s.blocks)] = s.blocks
+                lens[sl] = root + 1 + max(depths)
+                mask[sl] = tree.ancestor_mask(T)
+                mask[sl, np.arange(n, T), np.arange(n, T)] = 1
+                meta[s.uid] = (sl, tree)
+            self.stats["plan_s"] += time.perf_counter() - t0
+
+            t0 = time.perf_counter()
+            with self._telem.span("dispatch", kind="spec_verify", T=T):
+                fn = self._spec_program(T)
+                self._rng, sub = jax.random.split(self._rng)
+                k_ys, v_ys, toks = fn(self.params, self.kv_pool, tok, pos,
+                                      np.zeros((S, T), np.int32), tables,
+                                      lens, mask, sub)
+                toks_h = np.asarray(toks)
+
+            # exact acceptance on the host, then ONE merge of exactly the
+            # accepted path's staged rows (everything else → trash block)
+            flat = np.zeros(S * T, np.int32)
+            accepts: dict[int, list[int]] = {}
+            for uid, (sl, tree) in meta.items():
+                seq = self.state.seqs[uid]
+                accepted, visited = accept_walk(tree,
+                                                toks_h[sl, :tree.n_nodes])
+                root = len(seq.tokens) - 1
+                for i, node in enumerate(visited):
+                    p = root + i
+                    flat[sl * T + node] = \
+                        seq.blocks[(p // bs) % mb] * bs + p % bs
+                accepts[uid] = accepted
+            self.kv_pool = self._spec_merge_program(T)(
+                self.kv_pool, k_ys, v_ys, flat)
+        except Exception:
+            # failed dispatch: no provisional marker may outlive the round
+            for uid in meta:
+                self.state.rollback_provisional(uid)
+            raise
+
+        st = self.stats
+        emitted: dict[int, list[int]] = {}
+        for uid, accepted in accepts.items():
+            tree = meta[uid][1]
+            out = self.state.commit_speculative(uid, accepted)
+            n_acc = len(accepted) - 1        # matched candidates
+            st["spec_verifies"] += 1
+            st["spec_proposed"] += tree.n_candidates
+            st["spec_accepted"] += n_acc
+            st["spec_steps_saved"] += max(len(out) - 1, 0)
+            if out:
+                self._results[uid].extend(out)
+                self._spec_emit.setdefault(uid, []).extend(out)
+                emitted[uid] = out
+            if cfg.spec_adapt and tree.n_candidates:
+                ev = self._spec_tracker.observe(uid, tree.n_candidates,
+                                                n_acc)
+                if ev is not None:
+                    # draft-depth adaptation is a postmortem-grade event:
+                    # the flight recorder notes it even when metrics are
+                    # off (note() is cheap and only read on dumps)
+                    self._telem.note(
+                        "spec_depth_adapt", uid=uid, old=ev[0], new=ev[1],
+                        rate=round(self._spec_tracker.rate(uid), 4))
+        st["spec_rounds"] += 1
+        st["spec_accept_rate"] = round(
+            st["spec_accepted"] / max(st["spec_proposed"], 1), 4)
+        st["dispatches"] += 1
+        st["decode_steps"] += 1
+        st["decode_tokens"] += sum(len(v) for v in emitted.values())
+        st["dispatch_s"] += time.perf_counter() - t0
+        if self._telem.enabled:
+            reg = self._telem.registry
+            reg.counter("serving_spec_proposed_total",
+                        help="candidate tree tokens proposed for "
+                             "verification").inc(
+                sum(meta[u][1].n_candidates for u in meta))
+            reg.counter("serving_spec_accepted_total",
+                        help="proposed candidates accepted by the exact "
+                             "verify walk").inc(
+                sum(len(a) - 1 for a in accepts.values()))
+            for accepted in accepts.values():
+                reg.histogram(
+                    "serving_spec_tokens_per_verify",
+                    buckets=tuple(float(b) for b in range(1, T + 2)),
+                    help="tokens committed per sequence per verify "
+                         "forward (1 = no candidate survived)"
+                ).observe(float(len(accepted)))
+            self._record_dispatch_telemetry("spec_verify", len(live),
+                                            self.state.max_seqs, ())
+            if emitted:
+                self._record_commit_telemetry(emitted)
+        return True
+
     def _dispatch_next(self) -> bool:
         """Dispatch the next scheduled step without blocking. Returns True
         if something was dispatched. Mixed prefill/decode load alternates
         pure prefill steps with decode windows (or [S,1] decode plans when
-        windowing is off) — each kind runs at full useful occupancy."""
+        windowing is off) — each kind runs at full useful occupancy.
+        With ``spec_decode`` configured, the decode side of the
+        alternation first offers the step to the speculative path — a
+        verify round replaces up to depth+1 serial decode steps; when no
+        proposer finds candidates the window/plain path runs as before."""
         has_prefill, has_decode = self.scheduler.pending_kinds()
         want_decode = has_decode and (not has_prefill or self._serve_toggle)
+        if self._spec is not None and want_decode and \
+                self._try_dispatch_spec(prefill_pending=has_prefill):
+            self._serve_toggle = False
+            return True
         if want_decode and self._try_dispatch_window(
                 prefill_pending=has_prefill):
             self._serve_toggle = False
@@ -1852,6 +2277,14 @@ class InferenceEngineV2:
             seq = self.state.admit(uid, toks, max_new_tokens,
                                    eos_id=eos_token_id)
         self._results[uid] = []
+        if self._spec is not None:
+            # draft mirrors reserve once, at admit, for the target's FULL
+            # budget plus the deepest proposal overhang (rewind never
+            # reallocates); a refused mirror admit just means root-only
+            # trees for this uid — plain decode, never an error
+            self._spec.admit(uid, toks,
+                             max_new_tokens + self._spec_tracker.base_depth
+                             + 1)
         if self._prefix_cache is not None:
             st = self.stats
             st["prefix_hit_tokens"] += seq.prefix_hit_tokens
@@ -1901,6 +2334,14 @@ class InferenceEngineV2:
         without stalling the pipeline at all."""
         while self._inflight and self._uid_inflight(uid):
             self._drain(force=True)         # pops (at least) the oldest
+        if self._spec is not None:
+            # spec rounds are atomic within a step() call, but a failed
+            # verify dispatch may have been caught by a driver that then
+            # flushes — clear any provisional marker before the audit
+            self.state.rollback_provisional(uid)
+            self._spec.release(uid)
+            self._spec_tracker.forget(uid)
+            self._spec_emit.pop(uid, None)
         if uid in self.state.seqs:
             self.state.release(uid)
             if self._audit_state:
@@ -2047,6 +2488,12 @@ class InferenceEngineV2:
             # progress by blocking on the oldest readback
             for uid, new in self._drain(force=True).items():
                 emitted.setdefault(uid, []).extend(new)
+        if self._spec_emit:
+            # tokens committed synchronously inside a spec round (plus
+            # any pipeline drain the round forced) surface with the rest
+            for uid, new in self._spec_emit.items():
+                emitted.setdefault(uid, []).extend(new)
+            self._spec_emit = {}
         return emitted
 
     def generate(self, prompts: list[list[int]], max_new_tokens: int = 32,

@@ -82,6 +82,12 @@ class SequenceDescriptor:
     n_inflight: int = 0               # sampled tokens not yet read back
     n_shared_blocks: int = 0          # leading trie-owned (read-only) pages
     prefix_hit_tokens: int = 0        # prompt tokens served from the trie
+    #: speculative decoding (speculative.py): candidate tokens whose KV may
+    #: land in this sequence's OWNED tail pages ahead of acceptance. Only
+    #: the rollback-aware StateManager methods (``provision`` /
+    #: ``commit_speculative`` / ``rollback_provisional`` / ``rewind``) may
+    #: mutate this — bin/check_state_invariants.py enforces it.
+    n_provisional: int = 0
 
     @property
     def pending_tokens(self) -> int:
@@ -303,6 +309,125 @@ class StateManager:
             self._free_slots.append(seq.slot)
             self._free_slots.sort()
 
+    # --- speculative decoding: the rollback-aware provisional API --------
+    # A verify step runs candidate tokens through the model ahead of
+    # acceptance. Candidate KV only ever lands in the sequence's OWNED
+    # tail pages (positions >= len(tokens) - 1 >= the shared-page
+    # boundary) and inside the block budget RESERVED at admit, so
+    # provisioning never allocates, never touches refcounts, and a
+    # rejected candidate is erased by bookkeeping alone — the stale KV
+    # beyond ``n_computed`` is overwritten by the next accepted token and
+    # ``release``/``publish`` never reads past ``n_computed``. These four
+    # methods are the ONLY legal mutators of ``n_provisional``
+    # (bin/check_state_invariants.py rejects any other site).
+
+    def provision(self, uid: int, n: int) -> None:
+        """Mark ``n`` candidate tokens as provisionally scheduled for a
+        decode-ready sequence. Bounds: candidates beyond the generation
+        budget would write past the block reservation — refused."""
+        seq = self.seqs[uid]
+        if n < 0:
+            raise ValueError(f"negative provisional count {n}")
+        if seq.pending_tokens != 1:
+            raise RuntimeError(
+                f"uid {uid} is not decode-ready (pending "
+                f"{seq.pending_tokens}); speculative steps verify from "
+                f"the committed last token")
+        rem = seq.max_new_tokens - seq.n_generated
+        if n > max(rem - 1, 0):
+            # a verify step emits up to n+1 tokens (matched candidates +
+            # the bonus sample) — cap one short of the remaining budget so
+            # the commit can never overshoot max_new_tokens or the block
+            # reservation
+            raise RuntimeError(
+                f"uid {uid}: {n} provisional tokens + bonus exceed the "
+                f"remaining generation budget {rem}")
+        seq.n_provisional = n
+
+    def commit_speculative(self, uid: int, accepted: list[int]) -> list[int]:
+        """Fold a verify step's ACCEPTED tokens into the committed view
+        and clear the provisional marker (the rejected remainder rolls
+        back here — bookkeeping only, see the class note above). KV is in
+        the pool for the verified root + each accepted-but-last token, so
+        ``n_computed`` advances by ``len(accepted)`` exactly like a chain
+        of plain decode commits. Returns the tokens surviving the stop
+        criteria (eos/max_new truncation, like ``commit_generated``)."""
+        seq = self.seqs[uid]
+        n = len(accepted)
+        if n < 1:
+            raise ValueError("a verify step always accepts >= 1 token "
+                             "(the target sample at the deepest node)")
+        if n > seq.n_provisional + 1:
+            raise RuntimeError(
+                f"uid {uid}: accepting {n} tokens but only "
+                f"{seq.n_provisional} were provisioned (+1 bonus)")
+        seq.n_provisional = 0
+        out = seq.commit_generated(list(accepted), n)
+        # spec steps run on a drained pipeline: reconcile the scheduled
+        # view so the next plan (spec or plain) sees committed state
+        seq.n_sched = seq.n_computed
+        seq.n_inflight = 0
+        return out
+
+    def rollback_provisional(self, uid: int) -> None:
+        """Discard a provisioned-but-unverified tree (flush mid-spec,
+        failed dispatch): clear the marker; owned-tail KV beyond
+        ``n_computed`` is dead by construction."""
+        seq = self.seqs.get(uid)
+        if seq is not None:
+            seq.n_provisional = 0
+
+    def rewind(self, uid: int, tokens: list[int]) -> None:
+        """Reset a sequence's token history to ``tokens`` (the draft-model
+        proposer's mirror sync: the target's accept/reject decision is
+        ground truth, the draft rewinds to it every proposal round).
+        Computed KV for the surviving prefix stays valid — same tokens,
+        same positions, same pages; KV past the cut is overwritten as the
+        draft re-decodes. Blocks never change hands (the admit-time
+        reservation must cover the new history — callers size
+        ``max_new_tokens`` for the full target budget)."""
+        seq = self.seqs[uid]
+        if not tokens:
+            raise ValueError("cannot rewind to an empty history")
+        if seq.n_shared_blocks:
+            shared = seq.n_shared_blocks * self.block_size
+            if (len(tokens) <= shared
+                    or tokens[:shared] != seq.tokens[:shared]):
+                raise RuntimeError(
+                    f"uid {uid}: rewind would rewrite shared prefix pages")
+        if self._blocks_for(len(tokens)) > len(seq.blocks):
+            raise RuntimeError(
+                f"uid {uid}: rewind target of {len(tokens)} tokens "
+                f"exceeds the {len(seq.blocks)}-block reservation")
+        # longest common prefix: KV is only valid where histories agree
+        keep = 0
+        for a, b in zip(seq.tokens, tokens):
+            if a != b:
+                break
+            keep += 1
+        seq.tokens = list(tokens)
+        # the last token is always re-run (its forward produces the next
+        # logits), so computed KV is capped one short of the history —
+        # and FLOORED to a page boundary: the resume prefill chunk starts
+        # at kv_next, and the engine's page-merge program whole-page-
+        # writes multi-token chunks only from page-aligned starts (the
+        # partial page is recomputed; its KV is identical by construction)
+        keep = min(seq.n_computed, keep, len(tokens) - 1)
+        seq.n_computed = keep - keep % self.block_size
+        seq.n_sched = seq.n_computed
+        seq.n_inflight = 0
+        seq.n_provisional = 0
+        # the generation budget restarts from the rewound history, CAPPED
+        # so it can never outrun the admit-time block reservation: a
+        # mirror rewound to a LONGER history (the target committed G
+        # tokens since admit) granted the full budget again could decode
+        # G tokens past its pages (e.g. an un-rewound mirror whose target
+        # finished but whose flush is delayed) and index off the block
+        # list
+        cap = len(seq.blocks) * self.block_size
+        seq.n_generated = max(0, seq.max_new_tokens - (cap - len(tokens)))
+        seq.done = False
+
     def audit(self) -> None:
         """Debug-mode FULL-POOL audit: every non-trash block is owned by
         exactly one of {free list, prefix trie, one sequence's owned
@@ -324,6 +449,24 @@ class StateManager:
                 owners[b] = "trie"
         ref_counts: dict[int, int] = {}
         for uid, seq in self.seqs.items():
+            if seq.n_provisional < 0:
+                raise AssertionError(
+                    f"uid {uid}: negative provisional count "
+                    f"{seq.n_provisional}")
+            if seq.n_provisional:
+                # provisional KV spans positions [len-1, len-1+n]: it must
+                # start past the shared-page boundary (never pollutes a
+                # published/trie page) and end inside the reservation
+                first = len(seq.tokens) - 1
+                if first < seq.n_shared_blocks * self.block_size:
+                    raise AssertionError(
+                        f"uid {uid}: provisional slot {first} falls inside "
+                        f"a shared prefix page")
+                last = first + seq.n_provisional
+                if last >= len(seq.blocks) * self.block_size:
+                    raise AssertionError(
+                        f"uid {uid}: provisional tokens reach slot {last} "
+                        f"past the {len(seq.blocks)}-block reservation")
             for j, b in enumerate(seq.blocks):
                 if j < seq.n_shared_blocks:
                     if b not in trie_blocks:

@@ -44,3 +44,21 @@ def sample_logits(logits: jax.Array, rng: jax.Array, *, temperature: float = 1.0
         cutoff = jnp.take_along_axis(sorted_logits, cutoff_idx, axis=-1)
         logits = jnp.where(logits < cutoff, -jnp.inf, logits)
     return jax.random.categorical(rng, logits, axis=-1)
+
+
+def sample_tree_logits(logits: jax.Array, rng: jax.Array, *,
+                       temperature: float = 1.0, top_k: int = 0,
+                       top_p: float = 1.0, greedy: bool = False) -> jax.Array:
+    """Verify-step sampling: ``[S, T, V]`` per-tree-node logits →
+    ``[S, T]`` target samples, every node drawn independently with the
+    SAME filters as :func:`sample_logits` (one categorical over the
+    flattened batch — rows are independent under a single key). The
+    speculative acceptance walk keeps a node's sample only when its
+    parent's sample matched, so each kept token is conditioned exactly as
+    the serial chain would be — exact for any proposer; greedy reduces to
+    per-node argmax and is bit-identical to baseline decode."""
+    S, T, V = logits.shape
+    flat = sample_logits(logits.reshape(S * T, V), rng,
+                         temperature=temperature, top_k=top_k, top_p=top_p,
+                         greedy=greedy)
+    return flat.reshape(S, T)

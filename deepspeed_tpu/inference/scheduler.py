@@ -334,3 +334,69 @@ class SplitFuseScheduler:
                 [sampled[uid]] if plan.do_sample[s] and uid in sampled
                 else [], n)
         return accepted
+
+
+class SpecAcceptTracker:
+    """Per-tenant accept-rate tracking that adapts speculative draft
+    depth (the scheduler-side half of speculative decoding; the verify
+    machinery lives in engine_v2 + speculative.py).
+
+    Each uid keeps an EMA of its draft-token acceptance rate. Depth
+    shrinks one step when the EMA falls below ``shrink_below`` (a
+    low-acceptance tenant pays verify-width compute for tokens that
+    mostly reject — at the floor of 1 a verify step degenerates to an
+    ordinary decode) and grows back toward ``base_depth`` above
+    ``grow_above``. While prefill chunks are PENDING the returned depth
+    is additionally capped at ``mixed_cap`` — the decode_window_mixed_cap
+    idea: a waiting first chunk (TTFT) must never sit behind a max-depth
+    verify round."""
+
+    def __init__(self, base_depth: int, min_depth: int = 1,
+                 alpha: float = 0.5, shrink_below: float = 0.35,
+                 grow_above: float = 0.75):
+        self.base_depth = max(1, base_depth)
+        self.min_depth = max(1, min_depth)
+        self.alpha = alpha
+        self.shrink_below = shrink_below
+        self.grow_above = grow_above
+        self._rate: dict[int, float] = {}
+        self._depth: dict[int, int] = {}
+
+    def rate(self, uid: int) -> float:
+        return self._rate.get(uid, 1.0)
+
+    def depth(self, uid: int, prefill_pending: bool = False,
+              mixed_cap: int = 0) -> int:
+        d = self._depth.get(uid, self.base_depth)
+        if prefill_pending and mixed_cap:
+            d = min(d, mixed_cap)
+        return max(self.min_depth, d)
+
+    def observe(self, uid: int, proposed: int,
+                accepted: int) -> tuple[int, int] | None:
+        """Record one verify round (``proposed`` candidate tokens,
+        ``accepted`` of them matched). Returns ``(old, new)`` when the
+        uid's depth adapted, else None (callers note adaptation events to
+        the flight recorder). Rounds with nothing proposed (root-only
+        trees) carry no acceptance signal and are skipped."""
+        if proposed <= 0:
+            return None
+        r = accepted / proposed
+        ema = self._rate.get(uid)
+        ema = r if ema is None else self.alpha * r + (1 - self.alpha) * ema
+        self._rate[uid] = ema
+        old = self._depth.get(uid, self.base_depth)
+        new = old
+        if ema < self.shrink_below:
+            new = max(self.min_depth, old - 1)
+        elif ema > self.grow_above:
+            new = min(self.base_depth, old + 1)
+        if new != old:
+            self._depth[uid] = new
+            return (old, new)
+        self._depth.setdefault(uid, old)
+        return None
+
+    def forget(self, uid: int) -> None:
+        self._rate.pop(uid, None)
+        self._depth.pop(uid, None)

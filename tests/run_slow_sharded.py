@@ -42,6 +42,10 @@ HEAVY = [
     # shared-prefix KV cache: warm-path parity matrix (several tiny-gpt2
     # engine compiles) + the 600-trace eviction property run
     "test_prefix_cache.py",
+    # speculative decoding: greedy-parity matrix across proposer backends
+    # and depths — each case compiles verify + merge programs on top of a
+    # full engine (the draft backend builds a SECOND engine)
+    "test_speculative.py",
 ]
 
 

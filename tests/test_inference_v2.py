@@ -828,6 +828,48 @@ def test_v2_fp8_kv_long_context_logits_parity():
     assert len(ef8.flush(1)) == 4
 
 
+def test_v2_fp8_kv_prefix_cache_cross_request_parity():
+    """The carried-over fp8 × prefix-cache gate: the auto rule now keeps
+    the shared-prefix cache ON under ``kv_cache_dtype="fp8"``. Published
+    pages hold the SAME e4m3 values a cold run would have written (pages
+    are donated, never requantized), so the only divergence channel is
+    which positions a warm request reads through the quantized pool
+    instead of the fresh bf16 stage — cross-request suffix-divergent
+    greedy streams must survive that round-trip noise unchanged. If this
+    regresses, flip the auto rule in ``InferenceEngineV2.__init__`` back
+    to excluding fp8 and document the measured delta in the README."""
+    from deepspeed_tpu.parallel.topology import MeshTopology
+
+    model = build_model("tiny-gpt2", hidden_size=256, num_heads=4)
+    rng = jax.random.PRNGKey(5)
+    topo = MeshTopology({"tensor": 1, "data": 1})
+    cfg = {"block_size": 8, "num_blocks": 64, "max_seqs": 2, "chunk": 8,
+           "max_seq_len": 160, "kv_cache_dtype": "fp8"}
+    warm = InferenceEngineV2(model, config=cfg, rng=rng, topology=topo)
+    assert warm._prefix_cache is not None      # the flipped auto gate
+    assert warm.kv_pool.dtype == jnp.float8_e4m3fn
+    # same model + same init rng = identical weights (a built engine's
+    # params are layer-stacked in place and cannot be handed over)
+    cold = InferenceEngineV2(model, config={**cfg, "prefix_cache": False},
+                             rng=rng, topology=topo)
+
+    r = np.random.default_rng(21)
+    shared = [int(t) for t in r.integers(0, 256, 40)]  # 5 full fp8 pages
+    tails = [[int(t) for t in r.integers(0, 256, 6)] for _ in range(2)]
+
+    # request A populates + publishes the shared pages (released inside
+    # generate); suffix-divergent request B then warm-matches them
+    a_warm = warm.generate([shared + tails[0]], max_new_tokens=8)[0]
+    hit0 = warm.stats["prefix_hit_tokens"]
+    b_warm = warm.generate([shared + tails[1]], max_new_tokens=8)[0]
+    assert warm.stats["prefix_hit_tokens"] - hit0 >= 40  # pages really hit
+
+    a_cold = cold.generate([shared + tails[0]], max_new_tokens=8)[0]
+    b_cold = cold.generate([shared + tails[1]], max_new_tokens=8)[0]
+    np.testing.assert_array_equal(np.asarray(a_warm), np.asarray(a_cold))
+    np.testing.assert_array_equal(np.asarray(b_warm), np.asarray(b_cold))
+
+
 def test_v2_decode_window_scan_matches_early_exit():
     """The round-6 fused decode window (fixed-trip lax.scan, XLA can
     software-pipeline across iterations) must generate token-for-token

@@ -1,7 +1,9 @@
 """Shared-prefix KV cache (inference/prefix_cache.py): radix index unit
 tests, StateManager ownership/refcount integration, a seeded property test
-over randomized admit/dispatch/commit/flush/evict interleavings (shrinks
-to a minimal trace on failure), and slow-tier engine_v2 warm-path parity
+over randomized admit/dispatch/commit/flush/evict/spec interleavings (the
+spec op drives speculative provision → accept-or-rollback rounds through
+the rollback-aware StateManager API; shrinks to a minimal trace on
+failure), and slow-tier engine_v2 warm-path parity
 (same prompt twice == cold run, prefill tokens computed drop, eviction
 under pressure stays correct)."""
 import numpy as np
@@ -225,10 +227,17 @@ def _gen_ops(rng, n_ops):
         elif r < 0.55:
             ops.append(("dispatch",
                         "decode" if rng.random() < 0.4 else None))
-        elif r < 0.75:
+        elif r < 0.72:
             ops.append(("commit", int(rng.integers(0, 50))))
-        elif r < 0.93:
+        elif r < 0.86:
             ops.append(("flush", int(rng.integers(0, 8))))
+        elif r < 0.94:
+            # speculative verify round (rejection-rollback interleavings):
+            # provision n candidates on some decode-ready uid, then either
+            # accept j of them (j <= n → a mid-tree rejection rolled back
+            # by the commit) or roll the whole tree back
+            ops.append(("spec", int(rng.integers(0, 4)),
+                        int(rng.integers(1, 4)), int(rng.integers(0, 5))))
         else:
             ops.append(("evict", int(rng.integers(1, 5))))
     return ops
@@ -291,6 +300,29 @@ def _run_trace(ops):
                 while any(uid in p.uids for p in inflight):
                     commit_oldest(0)
                 st.release(uid)
+        elif kind == "spec":
+            # mirrors the engine contract: spec rounds run on a drained
+            # pipeline (no in-flight plan references the uid) and are
+            # atomic — provision, audit mid-round, then commit or roll
+            # back before anything else runs
+            _, pick, n, accept = op
+            cands = [u for u, s in sorted(st.seqs.items())
+                     if not s.done and s.pending_tokens == 1
+                     and s.max_new_tokens - s.n_generated > 1
+                     and not any(u in p.uids for p in inflight)]
+            if cands:
+                uid = cands[pick % len(cands)]
+                seq = st.seqs[uid]
+                k = min(n, seq.max_new_tokens - seq.n_generated - 1)
+                if k >= 1:
+                    st.provision(uid, k)
+                    st.audit()          # the marker itself is audit-clean
+                    if accept == 0:
+                        st.rollback_provisional(uid)
+                    else:
+                        j = 1 + (accept - 1) % (k + 1)
+                        st.commit_speculative(
+                            uid, [700 + i for i in range(j)])
         elif kind == "evict":
             # allocation pressure without a sequence: take blocks through
             # the refcounted API (evicts LRU pages), hand them straight
@@ -360,10 +392,11 @@ def test_interleaving_property_fast():
 @pytest.mark.slow
 def test_interleaving_property_500_plus():
     """The acceptance-criteria run: 600 seeded interleavings x 90 ops of
-    admit/dispatch/commit/flush/evict; every op is followed by a full-pool
-    ownership audit and a stale-page walk, dispatched-but-uncommitted
-    plans pin their pages (flush drains FIFO first), and each trace must
-    reconcile the pool exactly at the end."""
+    admit/dispatch/commit/flush/evict/spec (speculative provision →
+    accept-or-rollback rounds, mid-tree rejections included); every op is
+    followed by a full-pool ownership audit and a stale-page walk,
+    dispatched-but-uncommitted plans pin their pages (flush drains FIFO
+    first), and each trace must reconcile the pool exactly at the end."""
     _property(600, ops_per_trace=90, seed0=10_000)
 
 
@@ -536,8 +569,10 @@ def test_v2_flush_mid_prefill_keeps_trie_consistent():
 
 @pytest.mark.slow
 def test_v2_prefix_cache_config_gates():
-    """None = auto: on for pack-mode linear serving, off under fp8-KV
-    pages and in rolling-window ring mode; True refuses ring mode."""
+    """None = auto: on for pack-mode linear serving (fp8-KV pages
+    included — published pages serve bit-for-bit, parity pinned by
+    test_v2_fp8_kv_prefix_cache_cross_request_parity), off in
+    rolling-window ring mode; True refuses ring mode."""
     import jax
 
     from deepspeed_tpu.inference import InferenceEngineV2
@@ -555,7 +590,7 @@ def test_v2_prefix_cache_config_gates():
 
     fp8 = InferenceEngineV2(model, config={**base, "kv_cache_dtype": "fp8"},
                             rng=rng, topology=topo)
-    assert fp8._prefix_cache is None             # auto-off until parity
+    assert fp8._prefix_cache is not None         # parity proven: auto-on
 
     nopack = InferenceEngineV2(model, config={**base, "prefill_pack": False},
                                rng=rng, topology=topo)
