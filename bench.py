@@ -387,6 +387,10 @@ def fastgen_main(emit: bool = True, *, n_req=None, prompt_mu=None,
                     # SLO histograms ride along for free in the artifact
                     # (host-side dict ops; BENCH_TELEMETRY=0 disables)
                     "telemetry": os.environ.get("BENCH_TELEMETRY") != "0",
+                    # per-request tracing: the artifact's per-tenant
+                    # breakdown block (the router PR's baseline format) —
+                    # same gate as the rest of telemetry
+                    "reqtrace": os.environ.get("BENCH_TELEMETRY") != "0",
                     **({"decode_window": decode_window}
                        if decode_window else {}),
                     **({"max_inflight": max_inflight}
@@ -418,6 +422,8 @@ def fastgen_main(emit: bool = True, *, n_req=None, prompt_mu=None,
         # run's histograms stand alone in the artifact
         if eng._telem.enabled:
             eng._telem.registry.reset()
+        if eng._rt.enabled:
+            eng._rt.clear()
         if trace_dir:
             import contextlib
             import shutil
@@ -445,7 +451,11 @@ def fastgen_main(emit: bool = True, *, n_req=None, prompt_mu=None,
                                                    gens[pending[0]]) \
                         and len(live) < cap:
                     uid = pending.pop(0)
-                    eng.put(uid, prompts[uid], gens[uid])
+                    # synthetic round-robin tenants: the per-tenant block
+                    # in the artifact carries real numbers (ignored when
+                    # reqtrace is off)
+                    eng.put(uid, prompts[uid], gens[uid],
+                            tenant=f"tenant{uid % 4}")
                     admit[uid] = time.perf_counter()
                     live.add(uid)
                 stepped = eng.step()
@@ -535,6 +545,16 @@ def fastgen_main(emit: bool = True, *, n_req=None, prompt_mu=None,
             # measured run, for free next to the SLA scalars above
             "telemetry": eng._telem.slo_summary() if eng._telem.enabled
             else None,
+            # per-tenant attribution + breach counts (reqtrace): the
+            # multi-replica router PR consumes this block as its baseline
+            # artifact format
+            "tenants": eng._telem.tenant_summary() if eng._rt.enabled
+            else None,
+            "reqtrace": {
+                "traces": eng._rt.traces_started,
+                "breaches": eng._rt.breaches,
+                "breach_dumps": eng._rt.breach_dumps,
+            } if eng._rt.enabled else None,
         }
 
     eng_main, probe_main = build_engine(max_seqs)
@@ -603,7 +623,12 @@ def fastgen_main(emit: bool = True, *, n_req=None, prompt_mu=None,
            **(quant or {}),
            "time_split": res["time_split"],
            "counters": res["counters"],
-           "device_probe": res["device_probe"]}
+           "device_probe": res["device_probe"],
+           # SLO percentile summaries + per-tenant breakdown + breach
+           # counts from the SLA-scored run (None when BENCH_TELEMETRY=0)
+           "telemetry": res["telemetry"],
+           "tenants": res["tenants"],
+           "reqtrace": res["reqtrace"]}
     # prefill-PHASE MFU, useful-token definition: real prompt tokens
     # (~2N flops each) over MEASURED prefill device time from the traced
     # replay's jit_step busy seconds. Occupancy = useful tokens over the

@@ -15,6 +15,15 @@ of a metric/span emit call (``counter``/``gauge``/``histogram``/``span``/
 Dynamic (non-literal) names can't be checked statically — the runtime
 sanitizer remains the backstop for those.
 
+Label checks (the per-tenant attribution path, telemetry/reqtrace.py):
+literal ``labels={...}`` dicts on metric emits must carry valid label
+NAMES (``[a-zA-Z_][a-zA-Z0-9_]*``) and literal label VALUES that survive
+``sanitize_label_value`` unchanged (a literal that the runtime would
+mangle is a latent dashboard-query mismatch). The lint also pins the
+runtime cardinality bound: ``TENANT_CARDINALITY_CAP`` must exist in
+telemetry/reqtrace.py as an integer literal in [1, 64] — the constant
+that keeps an untrusted tenant population from exploding the scrape.
+
 Usage: ``python bin/check_metric_names.py [root]`` — prints violations as
 ``path:line: message``, exits nonzero if any. Enforced from
 tests/test_repo_lint.py.
@@ -29,13 +38,26 @@ import sys
 #: method names whose first string-literal argument is a metric/span tag
 EMIT_METHODS = ("counter", "gauge", "histogram", "span", "step_span", "note")
 
+#: methods whose ``labels=`` kwarg (when a literal dict) is validated
+LABELED_METHODS = ("counter", "gauge", "histogram")
+
 #: methods whose ``prefix`` kwarg (or the given positional index) prepends
 #: to metric tags — write_counters(counters, step, prefix) and the
 #: engine's _emit_counters(counters, prefix) that forwards to it
 PREFIX_METHODS = {"write_counters": 2, "_emit_counters": 1}
 
+#: where the runtime cardinality cap lives + its legal range (an upper
+#: bound too: 64 tenants x a handful of series is the most a scrape
+#: should ever carry per family)
+CAP_FILE = "deepspeed_tpu/telemetry/reqtrace.py"
+CAP_NAME = "TENANT_CARDINALITY_CAP"
+CAP_RANGE = (1, 64)
+
 _VALID_NAME = re.compile(r"[a-zA-Z_:][a-zA-Z0-9_:]*\Z")
 _INVALID_CHARS = re.compile(r"[^a-zA-Z0-9_:]")
+_VALID_LABEL_NAME = re.compile(r"[a-zA-Z_][a-zA-Z0-9_]*\Z")
+_LABEL_VALUE_BAD = re.compile(r"[^A-Za-z0-9_\-./:]")
+LABEL_VALUE_MAX_LEN = 64
 
 
 def sanitize(name: str) -> str:
@@ -46,6 +68,13 @@ def sanitize(name: str) -> str:
     if out and out[0].isdigit():
         out = "_" + out
     return out
+
+
+def sanitize_label_value(value) -> str:
+    """Mirror of telemetry/metrics.py ``sanitize_label_value`` (same
+    dependency-free rule; tests/test_reqtrace.py pins the two together)."""
+    out = _LABEL_VALUE_BAD.sub("_", str(value))[:LABEL_VALUE_MAX_LEN]
+    return out or "unknown"
 
 
 def tag_problem(tag: str) -> str | None:
@@ -79,6 +108,34 @@ def _literal_tags(node: ast.Call) -> list[tuple[str, str]]:
     return out
 
 
+def _label_problems(node: ast.Call) -> list[str]:
+    """Violations in a literal ``labels={...}`` kwarg: bad label names,
+    or literal values the runtime sanitizer would mangle (exposition would
+    then show a DIFFERENT value than the code wrote — dashboard queries
+    against the literal silently match nothing)."""
+    f = node.func
+    if not (isinstance(f, ast.Attribute) and f.attr in LABELED_METHODS):
+        return []
+    out: list[str] = []
+    for kw in node.keywords:
+        if kw.arg != "labels" or not isinstance(kw.value, ast.Dict):
+            continue
+        for k, v in zip(kw.value.keys, kw.value.values):
+            if isinstance(k, ast.Constant) and isinstance(k.value, str) \
+                    and not _VALID_LABEL_NAME.fullmatch(k.value):
+                out.append(f"label name {k.value!r} is not a valid "
+                           f"Prometheus label name "
+                           f"([a-zA-Z_][a-zA-Z0-9_]*)")
+            if isinstance(v, ast.Constant) \
+                    and isinstance(v.value, (str, int, float)):
+                lit = str(v.value)
+                if sanitize_label_value(lit) != lit:
+                    out.append(f"literal label value {lit!r} would be "
+                               f"rewritten by sanitize_label_value() — "
+                               f"emit the sanitized form")
+    return out
+
+
 def check_file(path: str) -> list[str]:
     with open(path, encoding="utf-8") as f:
         src = f.read()
@@ -94,7 +151,45 @@ def check_file(path: str) -> list[str]:
             problem = tag_problem(tag)
             if problem:
                 out.append(f"{path}:{node.lineno}: {role}() {problem}")
+        for problem in _label_problems(node):
+            out.append(f"{path}:{node.lineno}: {node.func.attr}() "
+                       f"{problem}")
     return out
+
+
+def check_cardinality_cap(root: str) -> list[str]:
+    """The per-tenant path must carry an enforced cardinality bound:
+    ``TENANT_CARDINALITY_CAP`` in telemetry/reqtrace.py, an int literal in
+    CAP_RANGE. A refactor that removes or de-literalizes it would drop the
+    scrape's only defense against tenant-label explosion."""
+    path = os.path.join(root, *CAP_FILE.split("/"))
+    if not os.path.exists(path):
+        return [f"{path}:0: {CAP_NAME} host file missing"]
+    with open(path, encoding="utf-8") as f:
+        try:
+            tree = ast.parse(f.read(), filename=path)
+        except SyntaxError as e:
+            return [f"{path}:{e.lineno}: unparseable ({e.msg})"]
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Assign):
+            for t in node.targets:
+                if isinstance(t, ast.Name) and t.id == CAP_NAME:
+                    v = node.value
+                    if not (isinstance(v, ast.Constant)
+                            and isinstance(v.value, int)
+                            and not isinstance(v.value, bool)):
+                        return [f"{path}:{node.lineno}: {CAP_NAME} must be "
+                                f"an integer LITERAL (statically "
+                                f"checkable), found "
+                                f"{ast.dump(v)[:60]}"]
+                    lo, hi = CAP_RANGE
+                    if not lo <= v.value <= hi:
+                        return [f"{path}:{node.lineno}: {CAP_NAME} = "
+                                f"{v.value} outside the sane range "
+                                f"[{lo}, {hi}]"]
+                    return []
+    return [f"{path}:0: {CAP_NAME} not found — the per-tenant series "
+            f"cardinality bound is gone"]
 
 
 def check_repo(root: str) -> list[str]:
@@ -109,6 +204,7 @@ def check_repo(root: str) -> list[str]:
             targets.append(p)
     for path in sorted(targets):
         out += check_file(path)
+    out += check_cardinality_cap(root)
     return out
 
 

@@ -1,0 +1,137 @@
+#!/usr/bin/env python
+"""Repo lint: every request-lifecycle transition is traced, and every
+trace emission uses a declared transition kind.
+
+telemetry/reqtrace.py declares the canonical lifecycle-transition set
+(``LIFECYCLE_EVENTS``) — enqueue/admit/evict/prefill_chunk/decode_step/
+decode_window/spec_round/spec_depth_adapt/rollback/rewind/commit/release.
+The value of a request timeline is COMPLETENESS: a postmortem that shows
+admit and commit but silently lacks the rollback in between reads as a
+healthy request. Transitions are emitted from five modules (engine_v2,
+scheduler, ragged, prefix_cache, speculative), so nothing structural stops
+a refactor from dropping one emission — this AST check (the
+check_state_invariants.py shape) does:
+
+- every ``<obj>.event(uid, "<kind>", ...)`` call in ``deepspeed_tpu/``
+  whose kind is a string literal must use a kind declared in
+  ``LIFECYCLE_EVENTS`` (an undeclared kind is a typo'd timeline entry no
+  dashboard or dump reader will group correctly);
+- every declared kind must be emitted by at least one call site (a kind
+  with zero emitters means a lifecycle transition went dark).
+
+Dynamic (non-literal) kinds can't be checked statically; there are none
+today and new ones should stay literals — timelines are grep'd by kind.
+
+Usage: ``python bin/check_reqtrace_events.py [root]`` — prints violations
+as ``path:line: message`` and exits nonzero if any. Enforced from
+tests/test_repo_lint.py.
+"""
+from __future__ import annotations
+
+import ast
+import os
+import sys
+
+#: where the canonical transition tuple lives
+EVENTS_FILE = "deepspeed_tpu/telemetry/reqtrace.py"
+EVENTS_NAME = "LIFECYCLE_EVENTS"
+
+#: the emitting method name: ``<tracer>.event(uid, kind, **fields)``
+EMIT_ATTR = "event"
+
+
+def load_lifecycle_events(root: str) -> tuple[list[str], list[str]]:
+    """(declared kinds, violations) from the canonical tuple — it must be
+    a literal tuple/list of strings so the check stays static."""
+    path = os.path.join(root, *EVENTS_FILE.split("/"))
+    if not os.path.exists(path):
+        return [], [f"{path}:0: {EVENTS_NAME} host file missing"]
+    with open(path, encoding="utf-8") as f:
+        try:
+            tree = ast.parse(f.read(), filename=path)
+        except SyntaxError as e:
+            return [], [f"{path}:{e.lineno}: unparseable ({e.msg})"]
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Assign):
+            continue
+        for t in node.targets:
+            if isinstance(t, ast.Name) and t.id == EVENTS_NAME:
+                v = node.value
+                if not isinstance(v, (ast.Tuple, ast.List)):
+                    return [], [f"{path}:{node.lineno}: {EVENTS_NAME} must "
+                                f"be a literal tuple of strings"]
+                kinds: list[str] = []
+                for el in v.elts:
+                    if not (isinstance(el, ast.Constant)
+                            and isinstance(el.value, str)):
+                        return [], [f"{path}:{node.lineno}: {EVENTS_NAME} "
+                                    f"entries must be string literals"]
+                    kinds.append(el.value)
+                if len(set(kinds)) != len(kinds):
+                    return kinds, [f"{path}:{node.lineno}: {EVENTS_NAME} "
+                                   f"holds duplicate kinds"]
+                return kinds, []
+    return [], [f"{path}:0: {EVENTS_NAME} not found"]
+
+
+def emissions_in_file(path: str) -> tuple[list[tuple[str, int]], list[str]]:
+    """Every ``.event(<uid>, "<literal>")`` call: [(kind, lineno)]."""
+    with open(path, encoding="utf-8") as f:
+        try:
+            tree = ast.parse(f.read(), filename=path)
+        except SyntaxError as e:
+            return [], [f"{path}:{e.lineno}: unparseable ({e.msg})"]
+    out: list[tuple[str, int]] = []
+    for node in ast.walk(tree):
+        if not (isinstance(node, ast.Call)
+                and isinstance(node.func, ast.Attribute)
+                and node.func.attr == EMIT_ATTR
+                and len(node.args) >= 2
+                and isinstance(node.args[1], ast.Constant)
+                and isinstance(node.args[1].value, str)):
+            continue
+        out.append((node.args[1].value, node.lineno))
+    return out, []
+
+
+def check_repo(root: str) -> list[str]:
+    declared, violations = load_lifecycle_events(root)
+    targets = []
+    for dirpath, _, files in os.walk(os.path.join(root, "deepspeed_tpu")):
+        targets += [os.path.join(dirpath, f) for f in files
+                    if f.endswith(".py")]
+    emitted: dict[str, str] = {}        # kind -> first site
+    for path in sorted(targets):
+        found, errs = emissions_in_file(path)
+        violations += errs
+        for kind, lineno in found:
+            if declared and kind not in declared:
+                violations.append(
+                    f"{path}:{lineno}: reqtrace event kind {kind!r} is not "
+                    f"declared in {EVENTS_NAME} "
+                    f"(telemetry/reqtrace.py) — declare it or fix the typo")
+            emitted.setdefault(kind, f"{path}:{lineno}")
+    for kind in declared:
+        if kind not in emitted:
+            violations.append(
+                f"{os.path.join(root, *EVENTS_FILE.split('/'))}:0: "
+                f"lifecycle transition {kind!r} is declared but never "
+                f"emitted anywhere in deepspeed_tpu/ — the timeline went "
+                f"dark for this transition")
+    return violations
+
+
+def main(argv: list[str]) -> int:
+    root = argv[1] if len(argv) > 1 else \
+        os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    violations = check_repo(root)
+    for v in violations:
+        print(v)
+    if violations:
+        print(f"{len(violations)} reqtrace lifecycle-coverage violation(s) "
+              f"found")
+    return 1 if violations else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main(sys.argv))

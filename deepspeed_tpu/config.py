@@ -250,12 +250,45 @@ class TelemetryConfig:
     #: MFU denominator override (per-chip dense bf16 peak); None = probe
     #: the device kind (telemetry/mfu.py table; unknown/CPU → no MFU gauge)
     peak_tflops: float | None = None
+    #: per-request lifecycle tracing (telemetry/reqtrace.py): trace IDs,
+    #: sampled timelines, per-tenant attribution, SLO-breach auto-capture
+    #: (serving-side; the training engine only forwards the knobs).
+    #: EVERY reqtrace knob here is tri-state: None = leave the
+    #: process-wide tracer alone — configure() only applies non-None
+    #: values, so a training config initializing telemetry later in the
+    #: process cannot stomp a serving engine's (or DS_TPU_REQTRACE's)
+    #: live tracing state. False pins tracing off explicitly.
+    reqtrace: bool | None = None
+    #: fraction of requests whose full timeline is retained (deterministic
+    #: in the trace ID); counters/exemplars need a sampled timeline
+    reqtrace_sample: float | None = None
+    #: memory bounds: completed timelines kept (ring, newest), and events
+    #: retained per timeline (head — admit/prefill context survives)
+    reqtrace_timeline_ring: int | None = None
+    reqtrace_max_events: int | None = None
+    #: SLO-breach thresholds: a TTFT/TBT observation past these dumps the
+    #: offending request's timeline + engine state to the flight recorder
+    slo_ttft_s: float | None = None
+    slo_tbt_s: float | None = None
+    #: min seconds between breach DUMPS (the counter always increments;
+    #: tracer default 60)
+    breach_interval_s: float | None = None
+    #: when set, a breach also captures a bounded jax.profiler trace here
+    breach_profile_dir: str | None = None
+    breach_profile_s: float | None = None
+    #: aggregate scrape (/metrics?aggregate=1): peer snapshot files older
+    #: than this are skipped (counted + logged) instead of merged
+    #: (server default 300)
+    peer_staleness_s: float | None = None
 
     def __post_init__(self):
         if self.span_buffer < 1:
             raise ValueError("telemetry.span_buffer must be >= 1")
         if self.flight_recorder < 1:
             raise ValueError("telemetry.flight_recorder must be >= 1")
+        if self.reqtrace_sample is not None \
+                and not 0.0 <= self.reqtrace_sample <= 1.0:
+            raise ValueError("telemetry.reqtrace_sample must be in [0, 1]")
 
 
 @dataclass

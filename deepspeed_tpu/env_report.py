@@ -114,6 +114,13 @@ def feature_report() -> list[tuple[str, bool, str]]:
         ("enabled via DS_TPU_TELEMETRY" if telem_on
          else "disabled (config telemetry.enabled / DS_TPU_TELEMETRY=1)")
         + (f", /metrics port {port}" if port else ", no HTTP port")))
+    rt_on = os.environ.get("DS_TPU_REQTRACE", "") not in ("", "0", "false")
+    feats.append((
+        "reqtrace (per-request lifecycle tracing)", True,
+        "enabled via DS_TPU_REQTRACE (trace IDs, per-tenant series, "
+        "SLO-breach auto-capture)" if rt_on
+        else "disabled (engine_v2 reqtrace=True / telemetry.reqtrace / "
+             "DS_TPU_REQTRACE=1)"))
     fr = os.environ.get("DS_TPU_FLIGHT_RECORDER")
     feats.append(("flight recorder", True,
                   f"dumps to {fr}" if fr

@@ -259,6 +259,28 @@ class RaggedInferenceConfig:
     #: a training engine's config section); False pins this engine to a
     #: private disabled instance regardless.
     telemetry: bool | None = None
+    #: per-request lifecycle tracing (telemetry/reqtrace.py): every
+    #: admitted sequence gets a trace ID and a sampled event timeline
+    #: (enqueue/admit with prefix-hit extent/prefill chunks/decode
+    #: windows/spec rounds/rollbacks/commits/release), per-tenant
+    #: attribution series (``put(..., tenant=)``), SLO histogram
+    #: exemplars, and TTFT/TBT breach auto-capture. True implies
+    #: telemetry; None follows the process-wide reqtrace state; False
+    #: pins this engine's emissions off.
+    reqtrace: bool | None = None
+    #: fraction of requests whose full timeline is retained (sampling is
+    #: deterministic in the trace ID; unsampled requests still count in
+    #: the per-tenant series but carry no timeline/exemplar). None keeps
+    #: the process tracer's current rate (default 1.0) — only an explicit
+    #: value is forwarded, so one engine cannot stomp a lower rate
+    #: another engine or the telemetry config already set.
+    reqtrace_sample: float | None = None
+    #: SLO-breach thresholds: a TTFT / per-token TBT observation past
+    #: these dumps the offending request's full timeline plus an
+    #: engine/pool state snapshot to the flight recorder (rate-limited —
+    #: telemetry breach_interval_s). None = no auto-capture.
+    slo_ttft_s: float | None = None
+    slo_tbt_s: float | None = None
 
 
 class InferenceEngineV2:
@@ -489,11 +511,48 @@ class InferenceEngineV2:
         self._inflight: deque = deque()
         # serving SLO instruments (telemetry/) — all no-ops when disabled
         from .. import telemetry as _telemetry
-        if cfg.telemetry:
-            _telemetry.configure(enabled=True)
+        if cfg.reqtrace and cfg.telemetry is False:
+            raise ValueError(
+                "reqtrace=True cannot combine with telemetry=False: "
+                "request timelines ride the telemetry bundle (drop the "
+                "telemetry=False pin or disable reqtrace)")
+        if cfg.telemetry or cfg.reqtrace:
+            rt_kw: dict[str, Any] = {}
+            if cfg.reqtrace:
+                # reqtrace implies the base substrate: timelines without
+                # the registry/recorder would answer nothing
+                rt_kw = {"reqtrace": True}
+                if cfg.reqtrace_sample is not None:
+                    rt_kw["reqtrace_sample"] = cfg.reqtrace_sample
+                if cfg.slo_ttft_s is not None:
+                    rt_kw["slo_ttft_s"] = cfg.slo_ttft_s
+                if cfg.slo_tbt_s is not None:
+                    rt_kw["slo_tbt_s"] = cfg.slo_tbt_s
+            _telemetry.configure(enabled=True, **rt_kw)
         self._telem = _telemetry.get_telemetry() if cfg.telemetry is not False \
             else _telemetry.Telemetry(enabled=False)
         self.scheduler._telem = self._telem   # cfg.telemetry=False pins both
+        # per-request lifecycle tracing: cfg.reqtrace=False pins THIS
+        # engine's emissions to a private disabled tracer even when the
+        # process-wide one is on (mirrors the telemetry=False pin); the
+        # StateManager / scheduler / prefix cache emit through the same
+        # handle, so one pin silences the whole serving stack
+        self._rt = self._telem.reqtrace if cfg.reqtrace is not False \
+            else _telemetry.ReqTracer(enabled=False)
+        self.scheduler._reqtrace = self._rt
+        self.state.reqtrace = self._rt
+        if self._prefix_cache is not None:
+            self._prefix_cache.reqtrace = self._rt
+        if self._rt.enabled:
+            # breach dumps attach an engine/pool state snapshot; weakref
+            # so the process-wide tracer never keeps a dead engine (and
+            # its device pool) alive. Two engines in one process: last
+            # one wins, like the shared registry.
+            import weakref
+            ref = weakref.ref(self)
+            self._rt.state_probe = lambda: (
+                lambda e: None if e is None
+                else e._reqtrace_state_snapshot())(ref())
         self._admit_t: dict[int, float] = {}      # uid → put() time
         self._first_sched: set[int] = set()       # uids past their 1st chunk
         self._last_commit_t: dict[int, float] = {}
@@ -561,6 +620,9 @@ class InferenceEngineV2:
         self._spec_emit: dict[int, list[int]] = {}
         if cfg.spec_decode:
             self._init_speculative(draft_model, draft_params, draft_rng)
+            # draft-mirror rewinds show up on the TARGET request's
+            # timeline (the mirror engine runs with telemetry off)
+            self._spec.reqtrace = self._rt
         logger.info(
             f"engine_v2 up: blocks={cfg.num_blocks}x{cfg.block_size} "
             f"pool={self.kv_pool.nbytes / 1e6:.0f}MB max_seqs={cfg.max_seqs} "
@@ -1867,6 +1929,10 @@ class InferenceEngineV2:
         self.stats["dispatch_s"] += time.perf_counter() - t0
         self.stats["dispatches"] += 1
         self.stats["windows"] += 1
+        if self._rt.enabled:
+            for s in live:
+                self._rt.event(s.uid, "decode_window", W=W,
+                               tokens=sched[s.uid][1])
         if self._telem.enabled:
             # window occupancy is row-based: live decoders / max slots
             self._record_dispatch_telemetry("decode_window", len(live),
@@ -2069,6 +2135,10 @@ class InferenceEngineV2:
             tree = meta[uid][1]
             out = self.state.commit_speculative(uid, accepted)
             n_acc = len(accepted) - 1        # matched candidates
+            if self._rt.enabled:
+                self._rt.event(uid, "spec_round",
+                               proposed=tree.n_candidates, accepted=n_acc,
+                               committed=len(out))
             st["spec_verifies"] += 1
             st["spec_proposed"] += tree.n_candidates
             st["spec_accepted"] += n_acc
@@ -2087,6 +2157,9 @@ class InferenceEngineV2:
                     self._telem.note(
                         "spec_depth_adapt", uid=uid, old=ev[0], new=ev[1],
                         rate=round(self._spec_tracker.rate(uid), 4))
+                    if self._rt.enabled:
+                        self._rt.event(uid, "spec_depth_adapt",
+                                       old=ev[0], new=ev[1])
         st["spec_rounds"] += 1
         st["spec_accept_rate"] = round(
             st["spec_accepted"] / max(st["spec_proposed"], 1), 4)
@@ -2243,6 +2316,9 @@ class InferenceEngineV2:
                 if new:
                     self._results[uid].extend(new)
                     emitted.setdefault(uid, []).extend(new)
+                    if self._rt.enabled:
+                        self._rt.event(uid, "commit", tokens=len(new),
+                                       window=True)
             return
         plan = entry["plan"]
         sampled = {uid: int(toks_h[s]) for s, uid in enumerate(plan.uids)
@@ -2262,10 +2338,13 @@ class InferenceEngineV2:
         return self.state.can_admit(prompt_len, max_new_tokens)
 
     def put(self, uid: int, prompt_tokens, max_new_tokens: int = 32,
-            eos_token_id: int | None = None) -> None:
+            eos_token_id: int | None = None, tenant: str | None = None) -> None:
         """Admit a request (reference ``put`` :107). Raises if the pool or
         slot budget is exhausted — callers gate on ``can_schedule``.
-        ``eos_token_id`` stops the sequence early (truncated at the eos)."""
+        ``eos_token_id`` stops the sequence early (truncated at the eos).
+        ``tenant`` attributes the request's tokens / KV residency / SLO
+        observations to a bounded-cardinality tenant label (reqtrace;
+        ignored when tracing is off)."""
         toks = [int(t) for t in prompt_tokens]
         if not toks:
             raise ValueError("empty prompt")
@@ -2273,9 +2352,18 @@ class InferenceEngineV2:
             raise ValueError("prompt + max_new_tokens exceeds max_seq_len")
         if not self.state.can_admit(len(toks), max_new_tokens):
             raise RuntimeError("cannot schedule: pool/slots exhausted")
-        with self._telem.span("admit", prompt=len(toks)):
-            seq = self.state.admit(uid, toks, max_new_tokens,
-                                   eos_id=eos_token_id)
+        if self._rt.enabled:
+            # trace opens BEFORE admit so the admit event (prefix-hit
+            # extent, pages pinned — emitted inside StateManager.admit)
+            # lands on an existing timeline
+            self._rt.begin(uid, tenant=tenant, prompt=len(toks))
+        try:
+            with self._telem.span("admit", prompt=len(toks)):
+                seq = self.state.admit(uid, toks, max_new_tokens,
+                                       eos_id=eos_token_id)
+        except Exception:
+            self._rt.drop(uid)     # the request never existed
+            raise
         self._results[uid] = []
         if self._spec is not None:
             # draft mirrors reserve once, at admit, for the target's FULL
@@ -2353,6 +2441,9 @@ class InferenceEngineV2:
         self._admit_t.pop(uid, None)
         self._first_sched.discard(uid)
         self._last_commit_t.pop(uid, None)
+        # release normally finalized the timeline (StateManager.release
+        # emits it); this is the safety net for uids that never admitted
+        self._rt.forget(uid)
         return self._results.pop(uid, [])
 
     def prefix_cache_stats(self) -> dict | None:
@@ -2374,6 +2465,7 @@ class InferenceEngineV2:
 
         now = time.perf_counter()
         reg = self._telem.registry
+        rt = self._rt
         for uid in uids:
             if uid >= 0 and uid not in self._first_sched:
                 self._first_sched.add(uid)
@@ -2382,7 +2474,10 @@ class InferenceEngineV2:
                     reg.histogram(
                         "serving_queue_wait_s",
                         help="admission (put) → first scheduled prefill "
-                             "chunk").observe(now - t_admit)
+                             "chunk").observe(now - t_admit,
+                                              exemplar=rt.exemplar(uid))
+                    if rt.enabled:
+                        rt.observe_queue_wait(uid, now - t_admit)
         if budget > 0:
             reg.histogram(
                 f"serving_{kind}_occupancy", buckets=RATIO_BUCKETS,
@@ -2421,6 +2516,7 @@ class InferenceEngineV2:
         (the bench's amortized-burst convention, live)."""
         now = time.perf_counter()
         reg = self._telem.registry
+        rt = self._rt
         total = 0
         for uid, toks in emitted.items():
             n = len(toks)
@@ -2434,17 +2530,50 @@ class InferenceEngineV2:
                     reg.histogram(
                         "serving_ttft_s",
                         help="admission (put) → first committed token"
-                    ).observe(now - t_admit)
+                    ).observe(now - t_admit, exemplar=rt.exemplar(uid))
+                    if rt.enabled:
+                        # per-tenant TTFT + the SLO-breach auto-capture
+                        # threshold check live behind this call
+                        rt.observe_ttft(uid, now - t_admit)
             else:
                 reg.histogram(
                     "serving_tbt_s",
                     help="observed per-token time between committed tokens"
-                ).observe((now - last) / n, n=n)
+                ).observe((now - last) / n, n=n, exemplar=rt.exemplar(uid))
+                if rt.enabled:
+                    rt.observe_tbt(uid, (now - last) / n, n)
             self._last_commit_t[uid] = now
         if total:
             reg.counter("serving_tokens_total",
                         help="committed (accepted) generated tokens"
                         ).inc(total)
+
+    def _reqtrace_state_snapshot(self) -> dict:
+        """Engine/pool state attached to SLO-breach flight dumps: the
+        scheduler backlog, pool occupancy, async pipeline depth, and a
+        per-sequence summary — "what else was the engine juggling when
+        this request blew its SLO"."""
+        alloc = self.state.allocator
+        has_prefill, has_decode = self.scheduler.pending_kinds()
+        out = {
+            "queue_depth": self.scheduler.queue_depth(),
+            "pending_prefill": has_prefill,
+            "pending_decode": has_decode,
+            "inflight_steps": len(self._inflight),
+            "free_blocks": alloc.free_blocks,
+            "num_blocks": alloc.num_blocks,
+            "seqs": {
+                uid: {"slot": s.slot, "len": len(s.tokens),
+                      "n_computed": s.n_computed,
+                      "pending_sched": s.pending_sched,
+                      "blocks": len(s.blocks),
+                      "shared_blocks": s.n_shared_blocks,
+                      "done": s.done}
+                for uid, s in self.state.seqs.items()},
+        }
+        if self._prefix_cache is not None:
+            out["prefix_cache"] = self._prefix_cache.stats()
+        return out
 
     def _refresh_tp_stats(self) -> None:
         """Accumulate the ring collective-matmul counters (trace-time,

@@ -71,6 +71,12 @@ class PrefixCache:
         self.inserted_pages = 0
         self.deduped_pages = 0
         self.evicted_pages = 0
+        #: per-request lifecycle tracer (telemetry/reqtrace.py, duck-typed)
+        #: — engine_v2 attaches it; evictions are pool-level events (the
+        #: reclaimed pages had no live owner), so they land in the
+        #: tracer's unattributed ring; the admitting request's own
+        #: timeline carries the count via its admit event
+        self.reqtrace = None
 
     # -- introspection ----------------------------------------------------
     def __len__(self) -> int:
@@ -249,6 +255,9 @@ class PrefixCache:
             if parent is not self.root and parent.evictable:
                 heapq.heappush(heap, (parent.last_used, tie, parent))
                 tie += 1
+        rt = self.reqtrace
+        if rt is not None and rt.enabled and out:
+            rt.event(-1, "evict", pages=len(out), cached=self._n_nodes)
         return out
 
     # -- audit -------------------------------------------------------------

@@ -186,6 +186,13 @@ class StateManager:
         self.prefix_cache = None
         # node chains live sequences hold refs on (uid → list[PageNode])
         self._shared_nodes: dict[int, list] = {}
+        #: per-request lifecycle tracer (telemetry/reqtrace.py, duck-typed:
+        #: ``.enabled`` + ``.event(uid, kind, **fields)``) — engine_v2
+        #: attaches it; None = no tracing (bare StateManager users)
+        self.reqtrace = None
+        # pages the last _alloc call reclaimed from the prefix LRU (admit
+        # folds this into its lifecycle event for attribution)
+        self._last_evicted = 0
 
     def attach_prefix_cache(self, cache) -> None:
         """Enable shared-prefix serving (engine init, linear tables only —
@@ -204,11 +211,13 @@ class StateManager:
         LRU under pressure (evicts only unreferenced cached pages — a
         referenced page is pinned by a live sequence's refcount, and
         in-flight steps only reference pages of live sequences)."""
+        self._last_evicted = 0
         short = n - self.allocator.free_blocks
         if short > 0 and self.prefix_cache is not None:
             reclaimed = self.prefix_cache.evict(short)
             if reclaimed:
                 self.allocator.free(reclaimed)
+                self._last_evicted = len(reclaimed)
         return self.allocator.allocate(n)
 
     def can_admit(self, prompt_len: int, max_new_tokens: int = 0) -> bool:
@@ -287,6 +296,16 @@ class StateManager:
             seq.prefix_hit_tokens = seq.n_computed
         seq.blocks = [n.block for n in shared_nodes] + fresh
         self.seqs[uid] = seq
+        rt = self.reqtrace
+        if rt is not None and rt.enabled:
+            # the admit transition carries the prefix-cache hit extent and
+            # the reservation — the timeline's "where did this request
+            # start from" ground truth
+            rt.event(uid, "admit", prompt=len(tokens),
+                     max_new=max_new_tokens, blocks=len(seq.blocks),
+                     prefix_hit=seq.prefix_hit_tokens,
+                     shared_blocks=seq.n_shared_blocks,
+                     evicted=self._last_evicted, slot=seq.slot)
         return seq
 
     def release(self, uid: int) -> None:
@@ -296,11 +315,13 @@ class StateManager:
         freed; shared pages drop their refcount. Callers (engine flush)
         must have drained in-flight steps referencing this uid first."""
         seq = self.seqs.pop(uid)
+        published = 0
         if self.prefix_cache is not None and seq.slot >= 0:
             self._shared_nodes.pop(uid, None)
             to_free = self.prefix_cache.publish(
                 seq.tokens, seq.blocks, seq.n_shared_blocks,
                 min(seq.n_computed, len(seq.tokens)))
+            published = len(seq.blocks) - len(to_free)
             if to_free:
                 self.allocator.free(to_free)
         elif seq.blocks:
@@ -308,6 +329,12 @@ class StateManager:
         if seq.slot >= 0:
             self._free_slots.append(seq.slot)
             self._free_slots.sort()
+        rt = self.reqtrace
+        if rt is not None and rt.enabled:
+            # release closes the timeline (and settles the tenant's
+            # KV page-seconds integral inside the tracer)
+            rt.event(uid, "release", pages=len(seq.blocks),
+                     published=published, generated=seq.n_generated)
 
     # --- speculative decoding: the rollback-aware provisional API --------
     # A verify step runs candidate tokens through the model ahead of
@@ -367,6 +394,9 @@ class StateManager:
         # view so the next plan (spec or plain) sees committed state
         seq.n_sched = seq.n_computed
         seq.n_inflight = 0
+        rt = self.reqtrace
+        if rt is not None and rt.enabled and out:
+            rt.event(uid, "commit", tokens=len(out), spec=True)
         return out
 
     def rollback_provisional(self, uid: int) -> None:
@@ -375,7 +405,11 @@ class StateManager:
         ``n_computed`` is dead by construction."""
         seq = self.seqs.get(uid)
         if seq is not None:
+            had = seq.n_provisional
             seq.n_provisional = 0
+            rt = self.reqtrace
+            if rt is not None and rt.enabled and had:
+                rt.event(uid, "rollback", provisional=had)
 
     def rewind(self, uid: int, tokens: list[int]) -> None:
         """Reset a sequence's token history to ``tokens`` (the draft-model
@@ -427,6 +461,10 @@ class StateManager:
         cap = len(seq.blocks) * self.block_size
         seq.n_generated = max(0, seq.max_new_tokens - (cap - len(tokens)))
         seq.done = False
+        rt = self.reqtrace
+        if rt is not None and rt.enabled:
+            rt.event(uid, "rewind", to_len=len(tokens),
+                     kept_kv=seq.n_computed)
 
     def audit(self) -> None:
         """Debug-mode FULL-POOL audit: every non-trash block is owned by

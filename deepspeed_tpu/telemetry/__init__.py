@@ -24,17 +24,22 @@ import threading
 
 from ..utils.logging import logger
 from .metrics import (LATENCY_BUCKETS_S, RATIO_BUCKETS, Counter, Gauge,
-                      Histogram, MetricsRegistry, sanitize_metric_name)
+                      Histogram, MetricsRegistry, sanitize_label_value,
+                      sanitize_metric_name)
 from .mfu import MFUTracker, device_peak_flops, goodput, mfu
 from .recorder import FlightRecorder
+from .reqtrace import (LIFECYCLE_EVENTS, TENANT_CARDINALITY_CAP,
+                       TENANT_OVERFLOW_LABEL, ReqTracer)
 from .spans import NULL_SPAN, SpanTracer
 from .exposition import TelemetryHTTPServer
 
 __all__ = [
     "Telemetry", "get_telemetry", "configure",
     "SpanTracer", "MetricsRegistry", "Counter", "Gauge", "Histogram",
-    "FlightRecorder", "TelemetryHTTPServer", "MFUTracker",
+    "FlightRecorder", "TelemetryHTTPServer", "MFUTracker", "ReqTracer",
     "mfu", "goodput", "device_peak_flops", "sanitize_metric_name",
+    "sanitize_label_value", "LIFECYCLE_EVENTS", "TENANT_CARDINALITY_CAP",
+    "TENANT_OVERFLOW_LABEL",
     "LATENCY_BUCKETS_S", "RATIO_BUCKETS", "NULL_SPAN",
 ]
 
@@ -59,6 +64,11 @@ class Telemetry:
                                        registry=self.registry,
                                        capacity=flight_recorder,
                                        path=flight_recorder_path)
+        #: per-request lifecycle tracing (reqtrace.py) — separately gated
+        #: (``reqtrace.enabled``): timelines + per-tenant attribution +
+        #: SLO-breach auto-capture are opt-in on top of base telemetry
+        self.reqtrace = ReqTracer(registry=self.registry,
+                                  recorder=self.recorder)
         self.server: TelemetryHTTPServer | None = None
         self._health_extra: dict = {}
 
@@ -83,13 +93,28 @@ class Telemetry:
                     flight_recorder: int | None = None,
                     flight_recorder_path: str | None = None,
                     http_port: int | None = None,
-                    peer_snapshot_glob: str | None = None) -> "Telemetry":
+                    peer_snapshot_glob: str | None = None,
+                    peer_staleness_s: float | None = None,
+                    reqtrace: bool | None = None,
+                    reqtrace_sample: float | None = None,
+                    reqtrace_timeline_ring: int | None = None,
+                    reqtrace_max_events: int | None = None,
+                    slo_ttft_s: float | None = None,
+                    slo_tbt_s: float | None = None,
+                    breach_interval_s: float | None = None,
+                    breach_profile_dir: str | None = None,
+                    breach_profile_s: float | None = None) -> "Telemetry":
         """In-place update so cached references stay valid. The span ring
         is rebuilt only when its capacity changes (history is then lost)."""
         if peer_snapshot_glob is not None:
             self.peer_snapshot_glob = peer_snapshot_glob
             if self.server is not None:
                 self.server.peer_glob = peer_snapshot_glob
+        if peer_staleness_s is not None and self.server is not None:
+            self.server.peer_staleness_s = peer_staleness_s
+        self._peer_staleness = peer_staleness_s \
+            if peer_staleness_s is not None \
+            else getattr(self, "_peer_staleness", None)
         if enabled is not None:
             self.enabled = bool(enabled)
             self.tracer.enabled = bool(enabled)
@@ -105,8 +130,31 @@ class Telemetry:
             self.recorder = FlightRecorder(
                 tracer=self.tracer, registry=self.registry,
                 capacity=flight_recorder, path=self.recorder.path)
+            self.reqtrace.recorder = self.recorder
         if flight_recorder_path is not None:
             self.recorder.path = flight_recorder_path
+        rt = self.reqtrace
+        if reqtrace is not None:
+            rt.enabled = bool(reqtrace)
+        if reqtrace_sample is not None:
+            if not 0.0 <= reqtrace_sample <= 1.0:
+                raise ValueError(f"reqtrace_sample must be in [0, 1], got "
+                                 f"{reqtrace_sample}")
+            rt.sample = float(reqtrace_sample)
+        if reqtrace_timeline_ring is not None:
+            rt.timeline_ring = reqtrace_timeline_ring
+        if reqtrace_max_events is not None:
+            rt.max_events = int(reqtrace_max_events)
+        if slo_ttft_s is not None:
+            rt.slo_ttft_s = slo_ttft_s
+        if slo_tbt_s is not None:
+            rt.slo_tbt_s = slo_tbt_s
+        if breach_interval_s is not None:
+            rt.breach_interval_s = float(breach_interval_s)
+        if breach_profile_dir is not None:
+            rt.breach_profile_dir = breach_profile_dir
+        if breach_profile_s is not None:
+            rt.breach_profile_s = float(breach_profile_s)
         if http_port is not None:
             try:
                 self.start_http(http_port)
@@ -124,6 +172,8 @@ class Telemetry:
             server = TelemetryHTTPServer(self.registry,
                                          health_fn=self._health,
                                          peer_glob=self.peer_snapshot_glob)
+            if getattr(self, "_peer_staleness", None) is not None:
+                server.peer_staleness_s = self._peer_staleness
             server.start(port)      # raises on a busy port — don't keep a
             self.server = server    # dead server blocking later attempts
         elif port not in (0, self.server.port):
@@ -146,6 +196,9 @@ class Telemetry:
         h = dict(self._health_extra)
         h["telemetry_enabled"] = self.enabled
         h["spans_recorded"] = self.tracer.total_recorded
+        if self.reqtrace.enabled:
+            h["reqtrace_traces"] = self.reqtrace.traces_started
+            h["reqtrace_breaches"] = self.reqtrace.breaches
         return h
 
     # -- reading ---------------------------------------------------------
@@ -169,6 +222,46 @@ class Telemetry:
     def flight_dump(self, reason: str, path: str | None = None,
                     detail: str | None = None) -> dict:
         return self.recorder.dump(reason, path=path, detail=detail)
+
+    def export_chrome_trace(self, path: str, last: int | None = None) -> str:
+        """One Chrome/Perfetto trace carrying BOTH the host span timeline
+        (pid 0, per-thread tracks) and the per-request lifecycle timelines
+        (pid 1, one track per trace ID — reqtrace) on the same clock, so
+        "which requests were in flight while dispatch stalled" is one
+        view."""
+        import json as _json
+
+        data = self.tracer.chrome_trace(last=last)
+        data["traceEvents"].extend(
+            self.reqtrace.chrome_events(self.tracer._epoch))
+        with open(path, "w") as f:
+            _json.dump(data, f)
+        return path
+
+    def tenant_summary(self) -> dict:
+        """Per-tenant attribution rolled up from the ``serving_tenant_*``
+        series (bench artifacts, log lines): {tenant: {metric: value |
+        {p50, p95, count}}}. Empty when reqtrace never ran."""
+        prefix = "serving_tenant_"
+        out: dict = {}
+        for name, fam in self.registry.snapshot().items():
+            if not name.startswith(prefix):
+                continue
+            key = name[len(prefix):]
+            for s in fam["series"]:
+                tenant = s["labels"].get("tenant", "")
+                d = out.setdefault(tenant, {})
+                if fam["type"] == "histogram":
+                    h = Histogram(buckets=s["bounds"])
+                    h.counts = list(s["counts"])
+                    h.sum, h.count = s["sum"], s["count"]
+                    if h.count:
+                        d[key] = {"p50": round(h.percentile(50), 6),
+                                  "p95": round(h.percentile(95), 6),
+                                  "count": h.count}
+                else:
+                    d[key] = s["value"]
+        return out
 
     def slo_summary(self) -> dict:
         """Compact percentile view of every histogram (bench artifacts,
@@ -214,11 +307,18 @@ def get_telemetry() -> Telemetry:
     if _default is None:
         with _default_lock:
             if _default is None:
-                env_on = os.environ.get("DS_TPU_TELEMETRY", "") \
+                env_rt = os.environ.get("DS_TPU_REQTRACE", "") \
+                    not in ("", "0", "false")
+                env_on = env_rt or os.environ.get("DS_TPU_TELEMETRY", "") \
                     not in ("", "0", "false")
                 t = Telemetry(enabled=env_on,
                               peer_snapshot_glob=os.environ.get(
                                   "DS_TPU_TELEMETRY_PEERS") or None)
+                if env_rt:
+                    # DS_TPU_REQTRACE=1: per-request lifecycle tracing
+                    # implies the base substrate (timelines without
+                    # metrics would answer nothing)
+                    t.reqtrace.enabled = True
                 if env_on:
                     port = os.environ.get("DS_TPU_TELEMETRY_PORT")
                     if port is not None:
@@ -239,7 +339,11 @@ def configure(config=None, **overrides) -> Telemetry:
     if config is not None:
         for k in ("enabled", "span_buffer", "mirror_jax", "flight_recorder",
                   "flight_recorder_path", "http_port",
-                  "peer_snapshot_glob"):
+                  "peer_snapshot_glob", "peer_staleness_s",
+                  "reqtrace", "reqtrace_sample", "reqtrace_timeline_ring",
+                  "reqtrace_max_events", "slo_ttft_s", "slo_tbt_s",
+                  "breach_interval_s", "breach_profile_dir",
+                  "breach_profile_s"):
             v = getattr(config, k, None)
             if v is not None:
                 kw[k] = v

@@ -22,14 +22,20 @@ from __future__ import annotations
 
 import glob as _glob
 import json
+import os as _os
 import threading
 import time
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from urllib.parse import parse_qs, urlsplit
 
 from ..utils.logging import logger
+from .metrics import LABEL_VALUE_MAX_LEN, sanitize_label_value
 
 PROMETHEUS_CONTENT_TYPE = "text/plain; version=0.0.4; charset=utf-8"
+#: content type for ``/metrics?exemplars=1`` — exemplar suffixes are
+#: OpenMetrics syntax, which plain 0.0.4 parsers reject
+OPENMETRICS_CONTENT_TYPE = \
+    "application/openmetrics-text; version=1.0.0; charset=utf-8"
 
 
 class TelemetryHTTPServer:
@@ -40,14 +46,23 @@ class TelemetryHTTPServer:
     ephemeral port (tests); read it back from ``self.port``.
     ``peer_glob`` (optional) enables ``/metrics?aggregate=1``: peer
     snapshot files matching the glob merge into the response.
+    ``peer_staleness_s`` bounds how old (by mtime) a peer snapshot may be
+    before the aggregate SKIPS it instead of silently merging dead data —
+    a host that stopped writing snapshots an hour ago would otherwise
+    freeze its last numbers into every fleet scrape. Skips are counted
+    (``telemetry_stale_peers_skipped``) and every peer's snapshot age is
+    exposed (``telemetry_peer_snapshot_age_s{peer=...}``) so the scrape
+    itself says which host went quiet. 0/None disables the cutoff.
     """
 
     def __init__(self, registry, health_fn=None, host: str = "127.0.0.1",
-                 peer_glob: str | None = None):
+                 peer_glob: str | None = None,
+                 peer_staleness_s: float | None = 300.0):
         self.registry = registry
         self.health_fn = health_fn
         self.host = host
         self.peer_glob = peer_glob
+        self.peer_staleness_s = peer_staleness_s
         self.port: int | None = None
         self._httpd: ThreadingHTTPServer | None = None
         self._thread: threading.Thread | None = None
@@ -64,7 +79,32 @@ class TelemetryHTTPServer:
         agg = MetricsRegistry()
         agg.merge(self.registry.snapshot())
         n_peers = 0
+        n_stale = 0
+        ages: list[tuple[str, float]] = []
+        now = time.time()
+        cutoff = self.peer_staleness_s
         for path in sorted(_glob.glob(self.peer_glob or "")):
+            try:
+                age = now - _os.path.getmtime(path)
+            except OSError as e:            # vanished between glob and stat
+                logger.warning(f"telemetry aggregate: cannot stat peer "
+                               f"snapshot {path}: {e!r}")
+                continue
+            # label = the path's TAIL (sanitize keeps '/'): per-host
+            # snapshot trees like peers/<host>/snap.json share a
+            # basename, and colliding labels would overwrite each
+            # other's age — hiding exactly the stale host this gauge
+            # exists to expose
+            ages.append((sanitize_label_value(path[-LABEL_VALUE_MAX_LEN:]),
+                         age))
+            if cutoff and age > cutoff:
+                # a peer that stopped writing snapshots must not freeze
+                # its last numbers into the fleet view — skip, count, log
+                n_stale += 1
+                logger.warning(f"telemetry aggregate: skipping STALE peer "
+                               f"snapshot {path} (age {age:.0f}s > "
+                               f"{cutoff:.0f}s)")
+                continue
             # each peer folds in ALL-OR-NOTHING: merge into a trial copy
             # and swap on success — a snapshot that fails mid-merge (e.g.
             # histogram bucket mismatch from a peer on an older build)
@@ -84,9 +124,19 @@ class TelemetryHTTPServer:
                 continue
             agg = trial
             n_peers += 1
+        for peer, age in ages:
+            agg.gauge("telemetry_peer_snapshot_age_s",
+                      labels={"peer": peer},
+                      help="seconds since each peer snapshot file was "
+                           "written (stale peers are skipped, not merged)"
+                      ).set(round(age, 3))
         agg.gauge("telemetry_aggregated_peers",
                   help="peer snapshot files merged into this aggregate "
                        "scrape (excludes this process)").set(n_peers)
+        agg.gauge("telemetry_stale_peers_skipped",
+                  help="peer snapshot files skipped by this scrape because "
+                       "their age exceeded the staleness cutoff").set(
+            n_stale)
         return agg.render_prometheus()
 
     def start(self, port: int = 0) -> int:
@@ -102,10 +152,17 @@ class TelemetryHTTPServer:
                         q = parse_qs(parts.query)
                         if q.get("aggregate", ["0"])[0] not in ("", "0"):
                             body = server.render_aggregate().encode()
+                            ctype = PROMETHEUS_CONTENT_TYPE
+                        elif q.get("exemplars", ["0"])[0] not in ("", "0"):
+                            # exemplar-bearing buckets use OpenMetrics
+                            # syntax -> OpenMetrics content type
+                            body = server.registry.render_prometheus(
+                                exemplars=True).encode()
+                            ctype = OPENMETRICS_CONTENT_TYPE
                         else:
                             body = server.registry.render_prometheus() \
                                 .encode()
-                        ctype = PROMETHEUS_CONTENT_TYPE
+                            ctype = PROMETHEUS_CONTENT_TYPE
                     elif parts.path == "/healthz":
                         health = {"status": "ok",
                                   "uptime_s": round(time.time() - server._t0, 3)}

@@ -22,12 +22,19 @@ from __future__ import annotations
 
 import re
 import threading
+import time as _time
 from bisect import bisect_left
 from typing import Iterable
 
 _VALID_NAME = re.compile(r"[a-zA-Z_:][a-zA-Z0-9_:]*\Z")
 _INVALID_CHARS = re.compile(r"[^a-zA-Z0-9_:]")
 _VALID_LABEL = re.compile(r"[a-zA-Z_][a-zA-Z0-9_]*\Z")
+_LABEL_VALUE_BAD = re.compile(r"[^A-Za-z0-9_\-./:]")
+
+#: longest label VALUE the sanitizer emits — tenant names, peer file
+#: names etc. are untrusted input; unbounded values would bloat every
+#: scrape line they ride
+LABEL_VALUE_MAX_LEN = 64
 
 #: default latency buckets (seconds): ~geometric 100µs → 60s, densified
 #: around serving SLO territory (tens of ms .. few s)
@@ -55,6 +62,19 @@ def sanitize_metric_name(name: str) -> str:
         raise ValueError(f"metric tag {name!r} sanitizes to {out!r}, not a "
                          f"valid Prometheus metric name")
     return out
+
+
+def sanitize_label_value(value) -> str:
+    """Map an arbitrary (possibly user-supplied) value to a safe, bounded
+    Prometheus label VALUE: characters outside ``[A-Za-z0-9_\\-./:]`` →
+    ``_``, truncated to :data:`LABEL_VALUE_MAX_LEN`, never empty. Used by
+    the per-tenant attribution path (telemetry/reqtrace.py) and the
+    aggregate scrape's per-peer labels.
+
+    Keep in sync with bin/check_metric_names.py ``sanitize_label_value``
+    (the repo lint's drift-pinned mirror)."""
+    out = _LABEL_VALUE_BAD.sub("_", str(value))[:LABEL_VALUE_MAX_LEN]
+    return out or "unknown"
 
 
 def _label_key(labels: dict[str, str] | None) -> tuple:
@@ -113,9 +133,16 @@ class Histogram:
     inside the hit bucket (the standard Prometheus ``histogram_quantile``
     estimate), so accuracy is bounded by bucket width — size buckets to the
     question being asked.
+
+    **Exemplars** (reqtrace): an observation may carry a trace ID; each
+    bucket remembers its most recent exemplar ``(trace_id, value,
+    unix_time)``, so a tail bucket links to the concrete request timeline
+    that landed there (``/metrics?exemplars=1`` renders them OpenMetrics-
+    style). Storage is lazy — a histogram that never sees an exemplar
+    allocates nothing, and memory is bounded at one exemplar per bucket.
     """
 
-    __slots__ = ("bounds", "counts", "sum", "count")
+    __slots__ = ("bounds", "counts", "sum", "count", "exemplars")
 
     def __init__(self, buckets: Iterable[float] = LATENCY_BUCKETS_S):
         bounds = tuple(float(b) for b in buckets)
@@ -126,14 +153,22 @@ class Histogram:
         self.counts = [0] * (len(bounds) + 1)   # +1: the +Inf bucket
         self.sum = 0.0
         self.count = 0
+        self.exemplars: dict[int, tuple] | None = None   # bucket -> exemplar
 
-    def observe(self, v: float, n: int = 1) -> None:
+    def observe(self, v: float, n: int = 1,
+                exemplar: str | None = None) -> None:
         """Record ``n`` observations of value ``v`` (n>1 is the amortized
         form: a decode window committing k tokens dt apart contributes k
-        samples of dt/k)."""
-        self.counts[bisect_left(self.bounds, v)] += n
+        samples of dt/k). ``exemplar`` (a trace ID) tags the hit bucket's
+        most recent exemplar."""
+        i = bisect_left(self.bounds, v)
+        self.counts[i] += n
         self.sum += v * n
         self.count += n
+        if exemplar is not None:
+            if self.exemplars is None:
+                self.exemplars = {}
+            self.exemplars[i] = (exemplar, v, _time.time())
 
     def percentile(self, q: float) -> float | None:
         """Estimate the q-th percentile (q in [0, 100]); None when empty."""
@@ -216,6 +251,15 @@ class MetricsRegistry:
                 if typ == "histogram":
                     s.update(bounds=list(m.bounds), counts=list(m.counts),
                              sum=m.sum, count=m.count)
+                    if m.exemplars:
+                        # str keys: the snapshot is JSON round-trippable
+                        # (flight dumps, peer files); merge() ignores
+                        # this. list(items()) first: observe() inserts
+                        # lock-free from the serving thread, and one C
+                        # call is atomic under the GIL where iterating
+                        # the live dict is not — a scrape must never 500
+                        s["exemplars"] = {str(i): list(e) for i, e
+                                          in list(m.exemplars.items())}
                 else:
                     s["value"] = m.value
                 fam["series"].append(s)
@@ -250,29 +294,57 @@ class MetricsRegistry:
             self._metrics.clear()
 
     # -- exposition ------------------------------------------------------
-    def render_prometheus(self) -> str:
-        """Prometheus text exposition format 0.0.4."""
+    def render_prometheus(self, exemplars: bool = False) -> str:
+        """Prometheus text exposition format 0.0.4. With ``exemplars``,
+        bucket lines additionally carry their most recent exemplar in
+        OpenMetrics syntax (``... # {trace_id="..."} value timestamp``)
+        and the body ends with ``# EOF`` — serve this variant under the
+        OpenMetrics content type only (plain 0.0.4 parsers reject the
+        suffix)."""
         lines: list[str] = []
         for name, fam in sorted(self.snapshot().items()):
-            if fam["help"]:
-                lines.append(f"# HELP {name} {fam['help']}")
-            lines.append(f"# TYPE {name} {fam['type']}")
+            sample_name = name
+            if exemplars and fam["type"] == "counter":
+                # OpenMetrics reserves the ``_total`` suffix for counter
+                # SAMPLES: the family is declared under the base name and
+                # strict OM parsers reject a TYPE line that carries the
+                # suffix ("clashing name") — which would drop the whole
+                # scrape for exactly the consumers this mode exists for
+                base = name[:-6] if name.endswith("_total") else name
+                sample_name = base + "_total"
+                if fam["help"]:
+                    lines.append(f"# HELP {base} {fam['help']}")
+                lines.append(f"# TYPE {base} {fam['type']}")
+            else:
+                if fam["help"]:
+                    lines.append(f"# HELP {name} {fam['help']}")
+                lines.append(f"# TYPE {name} {fam['type']}")
             for s in fam["series"]:
                 items = tuple(sorted(s["labels"].items()))
                 if fam["type"] == "histogram":
+                    ex = s.get("exemplars") if exemplars else None
                     acc = 0
-                    for bound, c in zip(s["bounds"] + [float("inf")],
-                                        s["counts"]):
+                    for i, (bound, c) in enumerate(
+                            zip(s["bounds"] + [float("inf")], s["counts"])):
                         acc += c
                         le = "+Inf" if bound == float("inf") else repr(bound)
-                        lines.append(
-                            f"{name}_bucket"
-                            f"{_render_labels(items, (('le', le),))} {acc}")
+                        line = (f"{name}_bucket"
+                                f"{_render_labels(items, (('le', le),))} "
+                                f"{acc}")
+                        e = ex.get(str(i)) if ex else None
+                        if e is not None:
+                            tid, v, ts = e
+                            line += (f' # {{trace_id="{tid}"}} {v} '
+                                     f"{round(ts, 3)}")
+                        lines.append(line)
                     lines.append(
                         f"{name}_sum{_render_labels(items)} {s['sum']}")
                     lines.append(
                         f"{name}_count{_render_labels(items)} {s['count']}")
                 else:
                     lines.append(
-                        f"{name}{_render_labels(items)} {s['value']}")
+                        f"{sample_name}{_render_labels(items)} "
+                        f"{s['value']}")
+        if exemplars:
+            lines.append("# EOF")
         return "\n".join(lines) + "\n"

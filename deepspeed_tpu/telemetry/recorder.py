@@ -47,8 +47,11 @@ class FlightRecorder:
         return list(self._events)
 
     def record(self, reason: str, detail: str | None = None,
-               max_spans: int = 128) -> dict:
-        """Assemble the postmortem record (no I/O)."""
+               max_spans: int = 128, extra: dict | None = None) -> dict:
+        """Assemble the postmortem record (no I/O). ``extra`` attaches
+        caller payloads — e.g. the SLO-breach auto-capture's offending
+        request timeline + engine state snapshot (telemetry/reqtrace.py)
+        — under their own keys, without clobbering the standard ones."""
         rec = {
             "reason": reason,
             "time": time.time(),
@@ -61,15 +64,18 @@ class FlightRecorder:
         }
         if detail:
             rec["detail"] = detail
+        if extra:
+            for k, v in extra.items():
+                rec.setdefault(k, v)
         return rec
 
     def dump(self, reason: str, path: str | None = None,
-             detail: str | None = None) -> dict:
+             detail: str | None = None, extra: dict | None = None) -> dict:
         """Write the postmortem record as one JSON file (append-numbered so
         repeated dumps of a flapping job don't clobber each other); always
         returns the record even when the write fails — the caller is
         usually mid-crash and must not die in its own error handler."""
-        rec = self.record(reason, detail=detail)
+        rec = self.record(reason, detail=detail, extra=extra)
         target = path or self.path
         self.dumps += 1
         if target:

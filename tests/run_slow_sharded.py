@@ -46,6 +46,10 @@ HEAVY = [
     # and depths — each case compiles verify + merge programs on top of a
     # full engine (the draft backend builds a SECOND engine)
     "test_speculative.py",
+    # per-request lifecycle tracing: breach-capture / tenant-attribution
+    # integrations each compile a tiny engine (the breach case with the
+    # spec verify + merge programs on top)
+    "test_reqtrace.py",
 ]
 
 

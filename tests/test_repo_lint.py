@@ -30,6 +30,7 @@ lint = _load("check_import_time_devices")
 swallows = _load("check_exception_swallows")
 metric_lint = _load("check_metric_names")
 state_lint = _load("check_state_invariants")
+reqtrace_lint = _load("check_reqtrace_events")
 
 
 def test_repo_has_no_import_time_device_probes():
@@ -121,6 +122,80 @@ def test_metric_tag_detector_matches_runtime_sanitizer():
     for tag in ("Resilience/rewinds", "Train/fwd_ms", "a b-c.d", "9x",
                 "serving_ttft_s", "x:y", "__", "é"):
         assert metric_lint.sanitize(tag) == sanitize_metric_name(tag), tag
+
+
+def test_metric_label_detector_flags_bad_names_and_dirty_values(tmp_path):
+    """The per-tenant path's label rules: literal label names must be
+    valid Prometheus label names; literal values that the runtime
+    sanitizer would rewrite are latent dashboard-query mismatches."""
+    bad = tmp_path / "bad.py"
+    bad.write_text(
+        "def f(reg):\n"
+        "    reg.counter('x_total', labels={'tenant': 'acme'})\n"   # ok
+        "    reg.gauge('y', labels={'le bad': 'v'})\n"        # name: flagged
+        "    reg.counter('z_total', labels={'k': 'a b'})\n"   # value: flagged
+        "    reg.histogram('h_s', labels={'kind': kind_var})\n"  # dyn: ok
+        "    reg.counter('w_total', labels=lbls)\n")          # dyn dict: ok
+    out = metric_lint.check_file(str(bad))
+    assert len(out) == 2
+    assert ":3:" in out[0] and "label name" in out[0]
+    assert ":4:" in out[1] and "label value" in out[1]
+
+
+def test_metric_lint_pins_the_tenant_cardinality_cap():
+    """TENANT_CARDINALITY_CAP must exist in telemetry/reqtrace.py as an
+    int literal in the lint's sane range — the scrape's only defense
+    against tenant-label explosion — and the lint's label-value sanitizer
+    mirror must agree with the runtime one."""
+    assert metric_lint.check_cardinality_cap(ROOT) == []
+    from deepspeed_tpu.telemetry import (TENANT_CARDINALITY_CAP,
+                                         sanitize_label_value)
+
+    lo, hi = metric_lint.CAP_RANGE
+    assert lo <= TENANT_CARDINALITY_CAP <= hi
+    for v in ("acme", "a b", "tenant/7", "x" * 200, "", "Ωmega", "a:b-c.d",
+              42, None):
+        assert metric_lint.sanitize_label_value(v) == \
+            sanitize_label_value(v), v
+    # a missing/dynamic cap is a violation, not a crash
+    assert metric_lint.check_cardinality_cap("/nonexistent") != []
+
+
+# --- reqtrace lifecycle coverage --------------------------------------------
+
+def test_repo_reqtrace_lifecycle_events_all_emitted():
+    violations = reqtrace_lint.check_repo(ROOT)
+    assert violations == [], "\n".join(violations)
+
+
+def test_reqtrace_detector_flags_undeclared_and_dark_kinds(tmp_path):
+    """An emission under an undeclared kind AND a declared kind with zero
+    emitters are both violations."""
+    pkg = tmp_path / "deepspeed_tpu"
+    (pkg / "telemetry").mkdir(parents=True)
+    (pkg / "telemetry" / "reqtrace.py").write_text(
+        "LIFECYCLE_EVENTS = ('admit', 'commit', 'release')\n"
+        "class ReqTracer:\n"
+        "    def demo(self, uid):\n"
+        "        self.event(uid, 'admit')\n")
+    (pkg / "engine.py").write_text(
+        "def serve(rt, uid):\n"
+        "    rt.event(uid, 'commit', tokens=1)\n"
+        "    rt.event(uid, 'comit', tokens=1)\n"     # typo: flagged
+        "    rt.event(uid, kind_var)\n")             # dynamic: not checked
+    out = reqtrace_lint.check_repo(str(tmp_path))
+    assert len(out) == 2, "\n".join(out)
+    assert "comit" in out[0] and "not declared" in out[0]
+    assert "'release'" in out[1] and "never emitted" in out[1]
+
+
+def test_reqtrace_detector_rejects_non_literal_event_table(tmp_path):
+    pkg = tmp_path / "deepspeed_tpu" / "telemetry"
+    pkg.mkdir(parents=True)
+    (pkg / "reqtrace.py").write_text(
+        "LIFECYCLE_EVENTS = tuple(x for x in ('a',))\n")
+    out = reqtrace_lint.check_repo(str(tmp_path))
+    assert len(out) == 1 and "literal tuple" in out[0]
 
 
 # --- refcounted block-list ownership ----------------------------------------
