@@ -419,9 +419,15 @@ def fastgen_main(emit: bool = True, *, n_req=None, prompt_mu=None,
                 continue
             eng.stats[k] = 0 if isinstance(eng.stats[k], int) else 0.0
         # zero the telemetry registry like the stats dict: each measured
-        # run's histograms stand alone in the artifact
+        # run's histograms stand alone in the artifact. Scoped via the
+        # shared helper: a co-resident router's serving_router_* series
+        # survive (an inline registry.reset() here once clobbered them).
+        # serving_tenant_* is NOT kept — the engine emits those itself
+        # per run (reqtrace) and the artifact's tenants block must not
+        # accumulate across measured runs
         if eng._telem.enabled:
-            eng._telem.registry.reset()
+            from deepspeed_tpu.telemetry import SERVING_ROUTER_PREFIX
+            eng._telem.reset_metrics(keep=(SERVING_ROUTER_PREFIX,))
         if eng._rt.enabled:
             eng._rt.clear()
         if trace_dir:
@@ -1202,7 +1208,171 @@ def spec_decode_main():
     }), flush=True)
 
 
+def router_main():
+    """``BENCH_MODE=router``: goodput/TTFT/TBT + prefix-hit sweep over the
+    multi-replica serving tier (deepspeed_tpu/serving/) — baseline vs
+    one-replica-killed-mid-run vs shed-storm, SAME seeded trace each.
+
+    The harness is the multi-process CPU rig from the chaos suite: N
+    replica workers (toy backend by default — BENCH_ROUTER_BACKEND=engine
+    runs real engine_v2 replicas) behind the prefix-cache-aware router.
+    The artifact's ``value`` is baseline goodput (tokens of requests that
+    met the TTFT SLO per second) and ``vs_baseline`` is how much of it
+    survives one replica being SIGKILLed mid-run; each scenario carries
+    the per-tenant block (the PR-7 format) so placement/shed quality is
+    attributable per tenant, plus the router's placement prefix-hit
+    estimate, retries, restarts, and shed taxonomy."""
+    from deepspeed_tpu.serving import (AdmissionError, FleetConfig, Router,
+                                       RouterConfig, TraceConfig,
+                                       synth_trace)
+    from deepspeed_tpu.telemetry import ROUTER_RUN_PREFIXES, get_telemetry
+
+    n_rep = int(os.environ.get("BENCH_ROUTER_REPLICAS", "2"))
+    n_req = int(os.environ.get("BENCH_ROUTER_REQUESTS", "48"))
+    n_ten = int(os.environ.get("BENCH_ROUTER_TENANTS", "4"))
+    prefix = int(os.environ.get("BENCH_ROUTER_PREFIX", "128"))
+    gen = int(os.environ.get("BENCH_ROUTER_GEN", "32"))
+    slo_ttft = float(os.environ.get("BENCH_ROUTER_SLO_TTFT", "2.0"))
+    backend = os.environ.get("BENCH_ROUTER_BACKEND", "toy")
+    delay = float(os.environ.get("BENCH_ROUTER_DELAY", "0.002"))
+    block_size = 16
+
+    if backend == "engine":
+        replica = {"backend": "engine",
+                   "model": os.environ.get("BENCH_ROUTER_MODEL",
+                                           "tiny-gpt2"),
+                   "seed": 7,
+                   "engine": {"block_size": 4, "num_blocks": 256,
+                              "max_seqs": 4, "chunk": 32,
+                              "max_seq_len": prefix + gen + 64},
+                   "hb_interval_s": 0.05}
+        block_size = 4
+    else:
+        replica = {"backend": "toy", "block_size": block_size,
+                   "max_live": 4, "vocab": 1024,
+                   "tokens_per_step": 4, "decode_delay_s": delay,
+                   "hb_interval_s": 0.03}
+    trace = synth_trace(TraceConfig(
+        n_requests=n_req, n_tenants=n_ten, prefix_len=prefix,
+        max_new_tokens=gen, vocab=1024, seed=11))
+    telem = get_telemetry()
+
+    def scenario(name, kill_at=None, max_queue=4096, slo_shed=False):
+        # per-scenario zero of the ROUTER's registry scope — the shared
+        # helper both bench.serve() and this harness use
+        telem.reset_metrics(prefix=ROUTER_RUN_PREFIXES)
+        cfg = RouterConfig(
+            fleet=FleetConfig(
+                n_replicas=n_rep, replica=dict(replica),
+                hb_timeout_s=2.0, backoff_base_s=0.1,
+                ready_timeout_s=300.0,
+                log_dir=f"/tmp/ds_bench_router/{name}"),
+            max_queue=max_queue,
+            slo_ttft_s=slo_ttft if slo_shed else None,
+            request_timeout_s=60.0, max_retries=3, telemetry=True)
+        sheds: dict[str, int] = {}
+        t0 = time.perf_counter()
+        router = Router(cfg)
+        try:
+            router.start(min_ready=n_rep)
+            t_ready = time.perf_counter() - t0
+            t1 = time.perf_counter()
+            submitted = []
+            for i, rec in enumerate(trace):
+                try:
+                    submitted.append(router.submit(
+                        rec.prompt, tenant=rec.tenant,
+                        max_new_tokens=rec.max_new_tokens,
+                        priority=rec.priority, trace_id=rec.trace_id))
+                except AdmissionError as e:
+                    sheds[e.reason] = sheds.get(e.reason, 0) + 1
+                if kill_at is not None and i == kill_at:
+                    for _ in range(3):
+                        router.poll()
+                    router.fleet.kill_replica(0)
+                router.poll()
+            res = router.run(deadline_s=600.0)
+            wall = time.perf_counter() - t1
+            done = {t: v for t, v in res.items() if v["status"] == "done"}
+            met = [v for v in done.values()
+                   if v["ttft_s"] is not None and v["ttft_s"] <= slo_ttft]
+            ttfts = sorted(v["ttft_s"] for v in done.values()
+                           if v["ttft_s"] is not None)
+            snap = telem.snapshot()
+
+            def _ctr(metric, default=0.0):
+                fam = snap.get(metric)
+                return sum(s["value"] for s in fam["series"]) \
+                    if fam else default
+
+            hit = _ctr("serving_router_placement_prefix_tokens_total")
+            look = _ctr("serving_router_placement_lookup_tokens_total")
+            out = {
+                "wall_s": round(wall, 3),
+                "fleet_ready_s": round(t_ready, 3),
+                "requests": len(res), "completed": len(done),
+                "shed_at_submit": sheds,
+                "shed_queued": sum(1 for v in res.values()
+                                   if v["status"] == "shed"),
+                "failed": sum(1 for v in res.values()
+                              if v["status"] == "failed"),
+                "goodput_tok_s": round(
+                    sum(len(v["tokens"]) for v in met) / wall, 1),
+                "tok_s": round(
+                    sum(len(v["tokens"]) for v in done.values()) / wall,
+                    1),
+                "sla_met": len(met),
+                "p50_ttft_s": round(ttfts[len(ttfts) // 2], 4)
+                if ttfts else None,
+                "p95_ttft_s": round(ttfts[int(len(ttfts) * 0.95)], 4)
+                if ttfts else None,
+                "placement_prefix_hit_rate": round(hit / look, 4)
+                if look else None,
+                "retries": int(_ctr("serving_router_retries_total")),
+                "stale_dropped": router.stale_msgs,
+                "double_commits": router.double_commits,
+                "replay_mismatches": router.replay_mismatches,
+                "replica_restarts": router.fleet.restarts_total,
+                "breaker_opens": router.fleet.breaker_opens_total,
+                # per-tenant attribution block (the PR-7 format): router-
+                # observed TTFT + request/shed counts per tenant
+                "tenants": telem.tenant_summary(),
+            }
+            return out
+        finally:
+            router.close()
+
+    base = scenario("baseline")
+    killed = scenario("replica_killed", kill_at=max(n_req * 2 // 5, 1))
+    storm = scenario("shed_storm", max_queue=max(n_req // 6, 2),
+                     slo_shed=True)
+    print(json.dumps({
+        "metric": f"{backend}-backend router fleet, {n_rep} replicas x "
+                  f"{n_req} reqs / {n_ten} tenants "
+                  f"({prefix} shared-prefix tokens)",
+        "value": base["goodput_tok_s"],
+        "unit": f"goodput tok/s (TTFT SLO {slo_ttft}s)",
+        "vs_baseline": round(killed["goodput_tok_s"]
+                             / max(base["goodput_tok_s"], 1e-9), 3),
+        "detail": {
+            "baseline": base,
+            "replica_killed_mid_run": killed,
+            "shed_storm": storm,
+            "baseline_note": "same seeded trace each scenario; "
+                             "vs_baseline = goodput retained with one of "
+                             f"{n_rep} replicas SIGKILLed mid-run "
+                             "(failover replay + restart; exactly-once "
+                             "asserted by double_commits=0)",
+        },
+    }), flush=True)
+
+
 def main():
+    if os.environ.get("BENCH_MODE") == "router":
+        # multi-process CPU harness (toy replicas by default): no local
+        # device bring-up needed — and a downed TPU tunnel must not cost
+        # us the router artifact
+        return router_main()
     # the FIRST device touch, under a bounded watchdog: a downed PJRT
     # tunnel must produce a structured JSON error line, never a hang
     # (round 5 lost both driver artifacts to exactly that)

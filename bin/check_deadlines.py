@@ -1,0 +1,172 @@
+#!/usr/bin/env python
+"""Repo lint: every wait under ``deepspeed_tpu/serving/`` carries an
+explicit timeout.
+
+The serving tier's core robustness claim is "no unbounded waits
+anywhere": a wedged replica must never hang the router, a dead router
+must never hang a replica, and the chaos suite can only prove
+exactly-once semantics if every code path is guaranteed to come back.
+That property dies one innocent ``q.get()`` at a time, so it is enforced
+structurally (the check_import_time_devices.py shape):
+
+- ``select.select(r, w, x)`` must pass its 4th (timeout) argument, and
+  ``select.poll()`` / ``select.epoll()`` objects may not be constructed
+  at all (their ``.poll()`` is indistinguishable by AST from the
+  non-blocking ``Popen.poll()`` — use ``select.select``, whose timeout
+  this lint CAN see);
+- ``.wait()`` / ``.join()`` / ``.get()`` / ``.acquire()`` /
+  ``.communicate()`` with no positional arguments must carry a
+  ``timeout=`` keyword (``d.get(key)``, ``path.join(a, b)`` and other
+  argful calls are a different method entirely and stay legal);
+- ``.recv()`` / ``.recv_into()`` / ``.recvfrom()`` must carry a
+  ``timeout=`` keyword — ``socket.recv`` cannot accept one, so raw
+  socket reads are structurally banned and bounded reads go through
+  ``select``-guarded non-blocking fds (protocol.LineChannel.recv, whose
+  signature requires the timeout);
+- ``.readline()`` / ``.accept()`` / ``.connect()`` are banned outright
+  — no timeout parameter exists;
+- ``time.sleep(x)`` with a literal ``x > MAX_SLEEP_S`` is flagged (a
+  sleep IS a wait; fault-injected hangs live in replica.py, which is
+  allowlisted for exactly that call).
+
+Usage: ``python bin/check_deadlines.py [root]`` — prints violations as
+``path:line: message`` and exits nonzero if any. Enforced from
+tests/test_repo_lint.py.
+"""
+from __future__ import annotations
+
+import ast
+import os
+import sys
+
+#: the directory this lint governs (relative to the repo root)
+SERVING_DIR = os.path.join("deepspeed_tpu", "serving")
+
+#: zero-arg calls that block forever without a timeout kwarg
+NEED_TIMEOUT_KW = {"wait", "join", "get", "acquire", "communicate"}
+
+#: calls with no bounded form at all — use select-guarded fds instead
+BANNED = {"readline", "accept", "connect"}
+
+#: calls that must carry a timeout KEYWORD no matter the positionals
+#: (socket.recv(bufsize) can't accept one -> structurally banned; a
+#: LineChannel.recv(timeout=...) satisfies the rule by construction)
+NEED_TIMEOUT_KW_ALWAYS = {"recv", "recv_into", "recvfrom"}
+
+#: select-family calls that need their timeout positional/keyword
+SELECT_MIN_ARGS = {"select": 4}
+
+#: poll-object constructors banned outright (their .poll() is not
+#: AST-distinguishable from the non-blocking Popen.poll())
+BANNED_CONSTRUCTORS = {("select", "poll"), ("select", "epoll"),
+                       ("select", "devpoll"), ("select", "kqueue")}
+
+#: longest literal sleep allowed (pacing); anything longer is a wait
+MAX_SLEEP_S = 60.0
+
+#: (file, function) pairs allowed to break a rule, with the rule name —
+#: replica.py's injected hang IS the unbounded sleep under test
+ALLOWED = {
+    ("replica.py", "serve", "sleep"),
+}
+
+
+def _attr_name(func) -> str | None:
+    return func.attr if isinstance(func, ast.Attribute) else None
+
+
+class _Visitor(ast.NodeVisitor):
+    def __init__(self, path: str):
+        self.path = path
+        self.fname = os.path.basename(path)
+        self.violations: list[str] = []
+        self._func_stack: list[str] = []
+
+    def _visit_fn(self, node):
+        self._func_stack.append(node.name)
+        self.generic_visit(node)
+        self._func_stack.pop()
+
+    visit_FunctionDef = _visit_fn
+    visit_AsyncFunctionDef = _visit_fn
+
+    def _allowed(self, rule: str) -> bool:
+        return any((self.fname, f, rule) in ALLOWED
+                   for f in self._func_stack)
+
+    def _flag(self, node, msg: str) -> None:
+        self.violations.append(f"{self.path}:{node.lineno}: {msg}")
+
+    def visit_Call(self, node: ast.Call):
+        name = _attr_name(node.func)
+        has_timeout_kw = any(kw.arg == "timeout" for kw in node.keywords)
+        f = node.func
+        if isinstance(f, ast.Attribute) and isinstance(f.value, ast.Name) \
+                and (f.value.id, f.attr) in BANNED_CONSTRUCTORS:
+            self._flag(node, f"{f.value.id}.{f.attr}() objects are "
+                             f"banned — their wait calls hide the "
+                             f"timeout from this lint; use select.select")
+        elif name in BANNED:
+            self._flag(node, f"unbounded .{name}() — no timeout form "
+                             f"exists; use a select-guarded non-blocking "
+                             f"fd (protocol.LineChannel)")
+        elif name in NEED_TIMEOUT_KW_ALWAYS and not has_timeout_kw:
+            self._flag(node, f".{name}() without an explicit timeout= "
+                             f"keyword — raw socket reads are banned; "
+                             f"bounded reads pass the deadline "
+                             f"explicitly")
+        elif name in NEED_TIMEOUT_KW and not node.args \
+                and not has_timeout_kw:
+            self._flag(node, f"bare .{name}() blocks forever — pass an "
+                             f"explicit timeout=")
+        elif name in SELECT_MIN_ARGS and not has_timeout_kw \
+                and len(node.args) < SELECT_MIN_ARGS[name]:
+            self._flag(node, f"{name}() without a timeout argument "
+                             f"blocks forever")
+        elif name == "sleep" and not self._allowed("sleep"):
+            v = node.args[0] if node.args else None
+            if isinstance(v, ast.Constant) \
+                    and isinstance(v.value, (int, float)) \
+                    and v.value > MAX_SLEEP_S:
+                self._flag(node, f"sleep({v.value}) is an unbounded wait "
+                                 f"in disguise (max {MAX_SLEEP_S}s)")
+        self.generic_visit(node)
+
+
+def check_file(path: str) -> list[str]:
+    with open(path, encoding="utf-8") as f:
+        src = f.read()
+    try:
+        tree = ast.parse(src, filename=path)
+    except SyntaxError as e:
+        return [f"{path}:{e.lineno}: unparseable ({e.msg})"]
+    v = _Visitor(path)
+    v.visit(tree)
+    return v.violations
+
+
+def check_repo(root: str) -> list[str]:
+    out: list[str] = []
+    serving = os.path.join(root, SERVING_DIR)
+    if not os.path.isdir(serving):
+        return [f"{serving}: serving package missing"]
+    for dirpath, _, files in os.walk(serving):
+        for f in sorted(files):
+            if f.endswith(".py"):
+                out += check_file(os.path.join(dirpath, f))
+    return out
+
+
+def main(argv: list[str]) -> int:
+    root = argv[1] if len(argv) > 1 else \
+        os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    violations = check_repo(root)
+    for v in violations:
+        print(v)
+    if violations:
+        print(f"{len(violations)} unbounded wait(s) found")
+    return 1 if violations else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main(sys.argv))

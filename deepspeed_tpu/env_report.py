@@ -95,6 +95,18 @@ def feature_report() -> list[tuple[str, bool, str]]:
     except Exception as e:  # pragma: no cover — import breakage only
         feats.append(("inference: speculative decoding", False, str(e)))
 
+    # serving tier (serving/): router + replica fleet are pure stdlib
+    # multiprocessing over the engine — availability is an import check
+    try:
+        from . import serving as _serving  # noqa: F401
+        feats.append((
+            "serving: multi-replica router", True,
+            "serving.Router over N engine_v2 workers (prefix-cache-aware "
+            "placement, retry-with-replay failover, SLO shedding, "
+            "circuit breaker; BENCH_MODE=router)"))
+    except Exception as e:  # pragma: no cover — import breakage only
+        feats.append(("serving: multi-replica router", False, str(e)))
+
     # telemetry / monitor backends (telemetry/ + monitor/): which push
     # backends can actually activate, and where the pull endpoint +
     # flight recorder would land for this process

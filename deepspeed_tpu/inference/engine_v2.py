@@ -2455,6 +2455,47 @@ class InferenceEngineV2:
         return None if self._prefix_cache is None \
             else self._prefix_cache.stats()
 
+    def residency_digest(self, max_entries: int = 4096) -> list[int] | None:
+        """Chain hashes of every page the shared-prefix cache holds
+        (``prefix_cache.chain_hashes`` scheme), newest-first — the
+        serving replica's heartbeat payload for the router's prefix-aware
+        placement. None when the cache is disabled (the router then falls
+        back to least-loaded placement for this replica)."""
+        return None if self._prefix_cache is None \
+            else self._prefix_cache.residency_digest(max_entries)
+
+    def prefix_cache_version(self) -> int:
+        """Digest version (moves on trie insert/evict): the replica
+        heartbeat re-ships its residency digest only when this did."""
+        return 0 if self._prefix_cache is None \
+            else self._prefix_cache.version
+
+    def load_summary(self) -> dict:
+        """Scheduler backlog + pool headroom for the replica heartbeat:
+        the router's least-loaded placement signal and shed estimator."""
+        out = self.scheduler.load_summary()
+        out["free_blocks"] = self.state.allocator.free_blocks
+        out["max_seqs"] = self.config.max_seqs
+        out["inflight"] = len(self._inflight)
+        return out
+
+    def drain(self, deadline_s: float | None = None) -> bool:
+        """Graceful-drain hook (the serving tier's replica shutdown path):
+        step until every admitted sequence is done and the async pipeline
+        is empty — callers stop admitting first. Returns False if
+        ``deadline_s`` elapses with work still pending (the caller then
+        escalates — in the router's case, by failing the stragglers with
+        a structured reason instead of hanging a fleet shutdown on one
+        wedged sequence). The engine stays usable either way."""
+        t0 = time.perf_counter()
+        while any(not s.done for s in self.state.seqs.values()) \
+                or self._inflight:
+            if deadline_s is not None \
+                    and time.perf_counter() - t0 > deadline_s:
+                return False
+            self.step()
+        return True
+
     def _record_dispatch_telemetry(self, kind: str, useful: int,
                                    budget: int, uids) -> None:
         """Dispatch-side SLO instruments: queue wait (admission → first

@@ -31,8 +31,38 @@ that every block-list mutation goes through that refcounted API).
 """
 from __future__ import annotations
 
+import hashlib
 import heapq
+import struct
 from dataclasses import dataclass, field
+
+
+def page_hash(parent: int, key) -> int:
+    """Stable 64-bit hash of one page under its parent chain: the router
+    and every replica must agree on it ACROSS PROCESSES (python's builtin
+    ``hash`` is salted per process), so it is blake2b over the parent
+    hash + the page's token ids, not ``hash(tuple)``."""
+    h = hashlib.blake2b(digest_size=8)
+    h.update(int(parent).to_bytes(8, "little", signed=False))
+    h.update(struct.pack(f"<{len(key)}q", *(int(t) for t in key)))
+    return int.from_bytes(h.digest(), "little")
+
+
+def chain_hashes(tokens, block_size: int) -> list[int]:
+    """Rolling chain hash at every full-page boundary of ``tokens``:
+    ``out[j]`` commits to tokens ``[0, (j+1)*block_size)``. This is the
+    wire form of the trie's structural path key — a replica's
+    :meth:`PrefixCache.residency_digest` is the set of these values for
+    every page it holds, and the router's prefix-aware placement matches
+    an incoming prompt's chain against those digests."""
+    if block_size < 1:
+        raise ValueError("block_size must be >= 1")
+    out: list[int] = []
+    h = 0
+    for j in range(len(tokens) // block_size):
+        h = page_hash(h, tokens[j * block_size:(j + 1) * block_size])
+        out.append(h)
+    return out
 
 
 @dataclass
@@ -45,6 +75,10 @@ class PageNode:
     parent: "PageNode | None"
     refs: int = 0
     last_used: int = 0
+    #: full-path chain hash (:func:`page_hash` over the parent's) —
+    #: immutable for the node's lifetime, computed once at insert so the
+    #: heartbeat-cadence residency digest never re-hashes the trie
+    chain_hash: int = 0
     children: dict[tuple[int, ...], "PageNode"] = field(default_factory=dict)
 
     @property
@@ -64,6 +98,9 @@ class PrefixCache:
         self.root = PageNode(key=(), block=-1, parent=None, refs=1)
         self._clock = 0              # LRU stamp (monotone per operation)
         self._n_nodes = 0
+        #: bumped on every digest-affecting mutation (insert/evict) — a
+        #: replica heartbeat re-ships its digest only when this moved
+        self.version = 0
         # lifetime stats (the engine folds these into its stats dict)
         self.hit_tokens = 0
         self.lookup_tokens = 0
@@ -126,6 +163,24 @@ class PrefixCache:
     def blocks(self) -> set[int]:
         """Every block id the trie currently owns (pool audit)."""
         return {n.block for n in self._nodes()}
+
+    def residency_digest(self, max_entries: int = 4096) -> list[int]:
+        """Chain hashes (:func:`chain_hashes` scheme) of every cached page,
+        capped at ``max_entries`` most-recently-used — the compact
+        residency summary a serving replica ships in its heartbeat so the
+        router can place a request on the replica already holding its
+        longest prefix chain. Hashes are precomputed at insert
+        (``PageNode.chain_hash``) and ``version`` moves only on
+        insert/evict, so a heartbeat-cadence caller pays one trie walk —
+        and only when something changed. A listed hash commits to its
+        whole path (which exists while the node does), so "longest j with
+        ``chain[j]`` in the digest" is exactly the cached-chain length
+        even under the MRU cap."""
+        out = [(n.last_used, n.chain_hash) for n in self._nodes()]
+        if len(out) > max_entries:
+            out.sort(reverse=True)               # keep the most recent
+            out = out[:max_entries]
+        return [h for _, h in out]
 
     # -- the read path ----------------------------------------------------
     def match(self, tokens, max_tokens: int | None = None) -> list[PageNode]:
@@ -215,10 +270,13 @@ class PrefixCache:
                 to_free.append(blocks[j])
                 self.deduped_pages += 1
             else:
-                child = PageNode(key=key, block=blocks[j], parent=node)
+                child = PageNode(key=key, block=blocks[j], parent=node,
+                                 chain_hash=page_hash(node.chain_hash,
+                                                      key))
                 node.children[key] = child
                 self._n_nodes += 1
                 self.inserted_pages += 1
+                self.version += 1
             child.last_used = self._clock
             node = child
         to_free.extend(blocks[n_full:])
@@ -250,6 +308,7 @@ class PrefixCache:
             del victim.parent.children[victim.key]
             self._n_nodes -= 1
             self.evicted_pages += 1
+            self.version += 1
             out.append(victim.block)
             parent = victim.parent
             if parent is not self.root and parent.evictable:

@@ -211,6 +211,27 @@ class SplitFuseScheduler:
         return sum(1 for seq in self.state.seqs.values()
                    if not seq.sched_done)
 
+    def load_summary(self) -> dict:
+        """Compact load view for a serving replica's heartbeat (the
+        router's least-loaded placement signal and shed estimator):
+        live sequences, backlog (prompt tokens not yet scheduled + decode
+        budget remaining), and the prefill/decode pending split. Host-only
+        dict ops — cheap enough for a sub-second heartbeat cadence."""
+        live = queued = pending_tokens = 0
+        for seq in self.state.seqs.values():
+            live += 1
+            if seq.sched_done:
+                continue
+            queued += 1
+            pending_tokens += max(seq.pending_sched - 1, 0) \
+                + max(seq.max_new_tokens - seq.n_generated
+                      - seq.n_inflight, 0)
+        has_prefill, has_decode = self.pending_kinds()
+        return {"live": live, "queued": queued,
+                "pending_tokens": pending_tokens,
+                "pending_prefill": has_prefill,
+                "pending_decode": has_decode}
+
     def next_step(self, prefer: str | None = None) -> StepPlan | None:
         """Plan-building entry point; see :meth:`_next_step_inner` for the
         policy. Telemetry wrapper: plan construction runs under a

@@ -141,18 +141,46 @@ class FaultInjector:
       ``truncate_tag`` (bool)         truncate a state file after the save
       ``stall_train_step_s`` (float)  sleep inside the train-step guard
 
+    Fleet-level points (serving/replica.py — the chaos matrix; all
+    count-based via :meth:`countdown`, so every failover path is exercised
+    at a SEEDED request/chunk index, not by chance):
+      ``replica_slow_start_s`` (float)       sleep before the ready handshake
+      ``replica_crash_on_start`` (bool)      die at startup, every incarnation
+                                             (the crash-loop → breaker drill)
+      ``replica_crash_on_put`` (int k)       die handling the k-th admit
+      ``replica_crash_during_prefill`` (int) die on the k-th prefill step
+      ``replica_hang_after_chunks`` (int k)  stop the event loop (heartbeats
+                                             included) before sending the
+                                             k-th stream chunk...
+      ``replica_hang_s`` (float)             ...for this long (default 3600;
+                                             finite values un-hang so the
+                                             stale-delivery dedup path runs)
+      ``replica_drop_done`` (int k)          swallow the k-th completion reply
+                                             (lost-reply → request deadline)
+      ``replica_stall_stream_after_chunks``  (int k) stop sending stream
+                                             messages after the k-th chunk
+                                             while heartbeats CONTINUE (the
+                                             wedged-engine shape; un-stalled
+                                             late delivery drills dedup)...
+      ``replica_stall_stream_s`` (float)     ...for this long (default 1.0)
+
     Crashes raise :class:`InjectedFault` (catchable in-process), or hard-kill
     the process with ``os._exit(INJECTED_CRASH_EXIT_CODE)`` when
-    ``DS_TPU_FAULT_HARD=1`` — the subprocess tests use the hard mode to
-    simulate a real mid-save kill with no unwind handlers running.
+    ``DS_TPU_FAULT_HARD=1`` (or ``hard=True``) — the subprocess tests use
+    the hard mode to simulate a real mid-save kill with no unwind handlers
+    running; replica workers pin it so an injected crash is a real
+    no-unwind process death.
     """
 
-    def __init__(self, spec: dict | None = None, env: str | None = None):
+    def __init__(self, spec: dict | None = None, env: str | None = None,
+                 hard: bool | None = None):
         self.spec: dict[str, Any] = dict(spec or {})
         self.spec.update(parse_fault_spec(
             env if env is not None else os.environ.get("DS_TPU_FAULT_INJECT")))
         self._consumed: set[str] = set()
-        self.hard = os.environ.get("DS_TPU_FAULT_HARD") == "1"
+        self._counts: dict[str, int] = {}
+        self.hard = os.environ.get("DS_TPU_FAULT_HARD") == "1" \
+            if hard is None else bool(hard)
         if self.spec:
             logger.warning(f"fault injection ARMED: {sorted(self.spec)} "
                            f"(hard={self.hard}) — this is a drill")
@@ -170,14 +198,34 @@ class FaultInjector:
         self._consumed.add(point)
         return self.spec[point]
 
-    def maybe_crash(self, point: str, where: str) -> None:
-        if self.fire(point) is None:
-            return
+    def countdown(self, point: str) -> bool:
+        """Count-based firing for per-occurrence points: an int value k
+        fires on the k-th call (bare True = the first), then the point is
+        consumed. Deterministic chaos drills key off these — "the 3rd
+        admit", "the 2nd stream chunk" — so a failover path is pinned to
+        a seeded index instead of left to timing."""
+        if point not in self.spec or point in self._consumed:
+            return False
+        self._counts[point] = self._counts.get(point, 0) + 1
+        v = self.spec[point]
+        k = 1 if v is True else int(v)
+        if self._counts[point] < k:
+            return False
+        self._consumed.add(point)
+        return True
+
+    def crash_now(self, point: str, where: str) -> None:
+        """Unconditional crash (callers gate via :meth:`countdown`)."""
         logger.error(f"fault injection: crashing at '{point}' ({where})")
         if self.hard:
             # no unwind, no atexit, no orbax cleanup — a real SIGKILL shape
             os._exit(INJECTED_CRASH_EXIT_CODE)
         raise InjectedFault(point, where)
+
+    def maybe_crash(self, point: str, where: str) -> None:
+        if self.fire(point) is None:
+            return
+        self.crash_now(point, where)
 
     def nan_scale(self, step: int) -> float:
         """1.0, or NaN exactly once when ``step`` hits ``nan_grads_step``."""

@@ -1,0 +1,30 @@
+"""Resilient multi-replica serving tier.
+
+A stateless router (router.py) fronts N ``engine_v2`` replica worker
+processes (replica.py) over a newline-JSON pipe protocol (protocol.py)
+with a deadline on every wait. Placement is prefix-cache-aware
+(placement.py: chain-hash the prompt's page-aligned prefix, prefer the
+replica whose residency digest holds the longest chain); the fleet layer
+(fleet.py) supervises replica processes with heartbeat liveness,
+exponential-backoff restarts and a crash-loop circuit breaker; failed or
+wedged replicas' in-flight requests are replayed onto survivors and
+dedup'd by trace ID + attempt nonce so results commit exactly once.
+workload.py generates the seeded multi-tenant traces the bench and chaos
+suites replay.
+
+See README.md "Serving fleet" for topology, knobs, and the
+"a replica died" runbook.
+"""
+from .fleet import Fleet, FleetConfig
+from .placement import StickyMap, chain_hashes, match_pages, pick_replica
+from .protocol import (ChannelClosed, ChannelTimeout, LineChannel,
+                       RequestRecord, poll_channels)
+from .router import AdmissionError, Router, RouterConfig
+from .workload import TraceConfig, synth_trace
+
+__all__ = [
+    "AdmissionError", "ChannelClosed", "ChannelTimeout", "Fleet",
+    "FleetConfig", "LineChannel", "RequestRecord", "Router",
+    "RouterConfig", "StickyMap", "TraceConfig", "chain_hashes",
+    "match_pages", "pick_replica", "poll_channels", "synth_trace",
+]

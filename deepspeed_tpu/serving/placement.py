@@ -1,0 +1,110 @@
+"""Prefix-cache-aware placement: put a request where its KV already is.
+
+The router hashes an incoming prompt's page-aligned prefix chain
+(``inference.prefix_cache.chain_hashes`` — the same structural radix key
+the replica-side trie uses) and prefers the replica whose residency
+digest holds the LONGEST chain: every matched page is prefill compute
+the replica skips and pool pages it shares (SGLang-router-style
+cache-aware routing). Two signals feed the decision:
+
+- **digest** (ground truth, lags): each replica heartbeats the chain
+  hashes of pages its prefix cache actually holds. Pages enter the trie
+  at sequence release, so the digest trails live traffic by one request
+  lifetime.
+- **sticky map** (estimate, immediate): the router remembers its own
+  recent placements by chain hash. Two same-prefix requests arriving
+  back-to-back co-locate even before the first releases — exactly the
+  burst the shared-prefix cache exists for.
+
+Fallback is least-loaded over the replica heartbeats' load summaries.
+A dead/draining replica never appears in ``candidates`` — the caller
+(router) filters states first.
+"""
+from __future__ import annotations
+
+from collections import OrderedDict
+
+from ..inference.prefix_cache import chain_hashes  # noqa: F401  (re-export:
+#     the router and tests hash prompts with THE SAME function the
+#     replica-side trie digests are built from)
+
+
+def load_score(load: dict | None) -> float:
+    """Scalar backlog estimate from a replica heartbeat's load summary:
+    live sequences dominate, queued-but-unscheduled tokens break ties
+    (256 tokens ~ one sequence's worth of pending work)."""
+    if not load:
+        return 0.0
+    return float(load.get("live", 0)) \
+        + float(load.get("pending_tokens", 0)) / 256.0
+
+
+def match_pages(chain: list[int], digest) -> int:
+    """Longest cached prefix (in pages) of a prompt chain against one
+    replica's residency digest. Chain hashes commit to their whole path,
+    so membership of ``chain[j]`` alone proves the replica holds all of
+    pages ``0..j`` — scan from the deep end."""
+    if not digest:
+        return 0
+    for j in range(len(chain) - 1, -1, -1):
+        if chain[j] in digest:
+            return j + 1
+    return 0
+
+
+class StickyMap:
+    """Bounded LRU of the router's own recent placements, keyed by chain
+    hash: chain hash -> replica slot. Purely an estimate (the replica may
+    have evicted since), so a hit only biases placement — correctness
+    never depends on it."""
+
+    def __init__(self, cap: int = 4096):
+        self.cap = cap
+        self._m: OrderedDict[int, int] = OrderedDict()
+
+    def note(self, chain: list[int], slot: int) -> None:
+        for h in chain:
+            self._m.pop(h, None)
+            self._m[h] = slot
+        while len(self._m) > self.cap:
+            self._m.popitem(last=False)
+
+    def lookup(self, chain: list[int]) -> tuple[int, int] | None:
+        """(slot, matched_pages) for the deepest remembered chain hash."""
+        for j in range(len(chain) - 1, -1, -1):
+            slot = self._m.get(chain[j])
+            if slot is not None:
+                return slot, j + 1
+        return None
+
+    def forget_slot(self, slot: int) -> None:
+        """A replica died/restarted: its remembered residency is gone."""
+        for h in [h for h, s in self._m.items() if s == slot]:
+            del self._m[h]
+
+
+def pick_replica(candidates: list, chain: list[int],
+                 sticky: StickyMap | None = None) -> tuple[object, int]:
+    """Choose a replica for a request whose prompt chain is ``chain``.
+
+    ``candidates``: objects with ``.slot`` (int), ``.digest`` (set of
+    chain hashes or None) and ``.load`` (heartbeat load dict or None) —
+    the router's READY replicas with admission headroom. Returns
+    ``(replica, est_hit_pages)`` where the estimate is the matched pages
+    backing the decision (the placement-quality counter's numerator).
+    Preference order: deepest digest match, then deepest sticky-map
+    match, then least loaded; every tie breaks toward the lower load,
+    then the lower slot (determinism — chaos tests replay placement)."""
+    if not candidates:
+        raise ValueError("no candidate replicas")
+    best, best_key, best_hit = None, None, 0
+    sticky_hit = sticky.lookup(chain) if sticky is not None else None
+    for rep in candidates:
+        pages = match_pages(chain, rep.digest)
+        s_pages = sticky_hit[1] \
+            if sticky_hit is not None and sticky_hit[0] == rep.slot else 0
+        # digest outranks sticky at any depth (it is ground truth)
+        key = (pages, s_pages, -load_score(rep.load), -rep.slot)
+        if best_key is None or key > best_key:
+            best, best_key, best_hit = rep, key, max(pages, s_pages)
+    return best, best_hit

@@ -1,0 +1,220 @@
+"""Newline-JSON wire protocol between the router and replica workers.
+
+One JSON object per line, over the replica subprocess's stdin/stdout
+pipes. The format is deliberately boring: every message is replayable and
+greppable, a replica's stream can be captured and re-fed for a
+deterministic repro, and the router can resend the SAME request record to
+another replica after a failure and (greedy decoding being deterministic)
+obtain a bit-identical token stream — retry-with-replay is the protocol's
+whole failover story.
+
+Message vocabulary (``t`` is the type tag)::
+
+  router -> replica
+    {"t":"put","id":str,"prompt":[int],"max_new":int,"eos":int|null,
+     "tenant":str}                          admit a request
+    {"t":"flush","id":str}                  abandon/clean up a request
+    {"t":"drain"}                           finish in-flight, refuse puts
+    {"t":"ping"}                            answer with a heartbeat now
+    {"t":"shutdown"}                        exit after "bye"
+
+  replica -> router
+    {"t":"ready","pid":int,"block_size":int,"max_live":int,"epoch":int}
+    {"t":"chunk","id":str,"off":int,"toks":[int]}    stream tokens; "off"
+                                            is the stream offset of the
+                                            first token (replay dedup)
+    {"t":"done","id":str,"toks":[int]}      FULL final stream — the
+                                            authoritative result; chunks
+                                            only serve streaming latency
+    {"t":"failed","id":str,"reason":str}    structured per-request failure
+    {"t":"hb","load":{...},"digest":[int]|null}  liveness + backlog +
+                                            prefix-cache residency digest
+    {"t":"bye"}                             clean shutdown ack
+
+Deadlines are LAW here (bin/check_deadlines.py lints this package): every
+read and write below is bounded by ``select`` with an explicit timeout —
+a wedged replica must never be able to hang the router, and a wedged
+router must never hang a replica. Reads that time out return ``None``
+(the caller's poll loop decides what staleness means); writes that time
+out raise :class:`ChannelTimeout` (a full pipe means the peer stopped
+reading — the caller treats it like a death).
+"""
+from __future__ import annotations
+
+import json
+import os
+import select
+import time
+from dataclasses import dataclass, field
+
+
+class ChannelClosed(Exception):
+    """Peer hung up (EOF / EPIPE): the process died or exited."""
+
+
+class ChannelTimeout(Exception):
+    """A bounded write could not complete: the peer stopped reading."""
+
+
+class LineChannel:
+    """Newline-JSON message channel over a (read fd, write fd) pair with
+    a deadline on EVERY operation. Both fds are switched to non-blocking;
+    waits go through ``select`` with explicit timeouts. Unparseable input
+    lines are counted and skipped, never fatal — a stray ``print`` to a
+    replica's stdout must not take its slot down."""
+
+    def __init__(self, rfd: int | None, wfd: int | None,
+                 own_fds: bool = True):
+        self.rfd = rfd
+        self.wfd = wfd
+        #: False when the fds belong to someone else's file objects (a
+        #: Popen's pipes): close() then only marks the channel dead and
+        #: the owner closes the fds, so they are never double-closed
+        self.own_fds = own_fds
+        for fd in (rfd, wfd):
+            if fd is not None:
+                os.set_blocking(fd, False)
+        self._buf = b""
+        self._msgs: list[dict] = []
+        self.bad_lines = 0
+        self.closed = False
+
+    # -- receive ---------------------------------------------------------
+    def _pump(self) -> None:
+        """Drain whatever is readable RIGHT NOW into parsed messages."""
+        while True:
+            try:
+                data = os.read(self.rfd, 65536)
+            except BlockingIOError:
+                return
+            except OSError:
+                self.closed = True
+                return
+            if not data:                      # EOF: peer is gone
+                self.closed = True
+                return
+            self._buf += data
+            while b"\n" in self._buf:
+                line, self._buf = self._buf.split(b"\n", 1)
+                if not line.strip():
+                    continue
+                try:
+                    msg = json.loads(line)
+                    if not isinstance(msg, dict) or "t" not in msg:
+                        raise ValueError("not a tagged message")
+                except (ValueError, UnicodeDecodeError):
+                    self.bad_lines += 1
+                    continue
+                self._msgs.append(msg)
+
+    def recv(self, timeout: float) -> dict | None:
+        """Next message, waiting up to ``timeout`` seconds. ``None`` on
+        timeout; :class:`ChannelClosed` once the peer is gone AND every
+        buffered message has been consumed (death must not eat the
+        messages that raced it)."""
+        if self._msgs:
+            return self._msgs.pop(0)
+        deadline = time.perf_counter() + max(timeout, 0.0)
+        while True:
+            if not self.closed:
+                wait = max(deadline - time.perf_counter(), 0.0)
+                r, _, _ = select.select([self.rfd], [], [], wait)
+                if r:
+                    self._pump()
+            if self._msgs:
+                return self._msgs.pop(0)
+            if self.closed:
+                raise ChannelClosed("peer closed the channel")
+            if time.perf_counter() >= deadline:
+                return None
+
+    def pending(self) -> bool:
+        """True if a recv(0) would return a message without waiting."""
+        if not self._msgs and not self.closed:
+            self._pump()
+        return bool(self._msgs)
+
+    # -- send ------------------------------------------------------------
+    def send(self, msg: dict, timeout: float) -> None:
+        """Write one message, waiting up to ``timeout`` for pipe space.
+        Raises :class:`ChannelTimeout` when the peer stops reading and
+        :class:`ChannelClosed` on EPIPE."""
+        data = json.dumps(msg, separators=(",", ":")).encode() + b"\n"
+        deadline = time.perf_counter() + max(timeout, 0.0)
+        while data:
+            wait = max(deadline - time.perf_counter(), 0.0)
+            _, w, _ = select.select([], [self.wfd], [], wait)
+            if not w:
+                raise ChannelTimeout(
+                    f"send timed out after {timeout}s ({len(data)} bytes "
+                    f"unwritten) — peer stopped reading")
+            try:
+                n = os.write(self.wfd, data)
+            except BlockingIOError:
+                continue
+            except (BrokenPipeError, OSError) as e:
+                self.closed = True
+                raise ChannelClosed(f"peer closed the channel ({e})")
+            data = data[n:]
+
+    def close(self) -> None:
+        if self.own_fds:
+            for fd in (self.rfd, self.wfd):
+                if fd is not None:
+                    try:
+                        os.close(fd)
+                    except OSError:
+                        pass                   # already closed by the peer
+        self.closed = True
+
+
+def poll_channels(channels: list[LineChannel],
+                  timeout: float) -> list[LineChannel]:
+    """One bounded ``select`` across many channels: the router's event
+    loop blocks HERE (and only here) for up to ``timeout`` seconds, then
+    drains every readable channel. Channels holding already-buffered
+    messages short-circuit the wait. Returns the channels with messages
+    pending (closed channels included — the caller must observe the
+    death via their ``recv`` raising)."""
+    ready = [ch for ch in channels if ch.pending() or ch.closed]
+    if ready:
+        return ready
+    fds = {ch.rfd: ch for ch in channels if not ch.closed}
+    if not fds:
+        # nothing alive to wait on: honor the pacing bound anyway so a
+        # caller's poll loop cannot spin hot on an all-dead fleet
+        time.sleep(min(timeout, 0.05))
+        return []
+    r, _, _ = select.select(list(fds), [], [], max(timeout, 0.0))
+    for fd in r:
+        fds[fd]._pump()
+    return [ch for ch in channels if ch.pending() or ch.closed]
+
+
+@dataclass
+class RequestRecord:
+    """One serving request as a replayable record: everything a replica
+    needs to reproduce the stream from scratch lives here, so failover is
+    literally "send the same record to someone else". ``trace_id`` is the
+    dedup key end to end — results commit exactly once per trace ID no
+    matter how many replicas saw the record."""
+    trace_id: str
+    prompt: list[int]
+    max_new_tokens: int = 16
+    eos_token_id: int | None = None
+    tenant: str = "default"
+    priority: int = 0
+    submitted_t: float = field(default=0.0, compare=False)
+
+    def to_wire(self) -> dict:
+        return {"t": "put", "id": self.trace_id, "prompt": self.prompt,
+                "max_new": self.max_new_tokens, "eos": self.eos_token_id,
+                "tenant": self.tenant}
+
+    @classmethod
+    def from_wire(cls, msg: dict) -> "RequestRecord":
+        return cls(trace_id=str(msg["id"]),
+                   prompt=[int(t) for t in msg["prompt"]],
+                   max_new_tokens=int(msg.get("max_new", 16)),
+                   eos_token_id=msg.get("eos"),
+                   tenant=str(msg.get("tenant", "default")))

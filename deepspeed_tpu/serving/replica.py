@@ -1,0 +1,461 @@
+"""Replica worker: one engine process behind the router, speaking the
+newline-JSON protocol on stdin/stdout.
+
+Two backends share the loop:
+
+- ``toy``: a deterministic pure-host generator (LCG stream seeded from
+  the prompt) over a REAL :class:`~..inference.prefix_cache.PrefixCache`
+  instance — the chaos matrix runs dozens of multi-process
+  fault-injection cases in tier-1 seconds because nothing imports or
+  compiles a model, while placement/digest code paths are the production
+  ones. Determinism is the point: a replayed request on ANY replica
+  reproduces the byte-identical stream, so failover tests assert
+  bit-equality, not similarity.
+- ``engine``: a real :class:`~..inference.engine_v2.InferenceEngineV2`
+  built from a named tiny model config + seed (identical weights in
+  every replica by construction — greedy failover replay is bit-identical
+  for the same reason it is in the toy).
+
+Fault injection (``cfg["faults"]`` ->
+:class:`~..runtime.resilience.FaultInjector`, count-based via
+``countdown``) drills every failover path deterministically:
+crash-on-start / on the k-th put / during prefill, a process-wide hang
+(heartbeats stop -> the router's liveness deadline), a stream-only stall
+(heartbeats continue -> the router's per-request deadline, and the
+un-stalled stale delivery exercises the dedup-by-trace-ID guard), and a
+dropped completion reply. Crashes are HARD (``os._exit``) — a real
+no-unwind death, not an exception the loop could accidentally absorb.
+
+The loop never blocks unboundedly: reads poll with a short timeout so
+stepping and heartbeats interleave with message handling, and writes are
+deadline-bounded (a dead router cannot wedge a replica in a pipe write).
+"""
+from __future__ import annotations
+
+import os
+import sys
+import time
+
+from ..inference.prefix_cache import PrefixCache
+from ..runtime.resilience import FaultInjector
+from ..utils.logging import logger
+from .protocol import (ChannelClosed, ChannelTimeout, LineChannel,
+                       RequestRecord)
+
+_MASK = (1 << 64) - 1
+
+#: structured per-request failure reasons a replica may report
+FAIL_REASONS = ("capacity", "draining", "duplicate", "internal")
+
+
+def _mix(s: int, t: int) -> int:
+    return (s * 6364136223846793005 + t + 1442695040888963407) & _MASK
+
+
+class ToyBackend:
+    """Deterministic token generator + real prefix-cache bookkeeping.
+
+    A prompt prefills at ``prefill_chunk`` tokens per step (minus the
+    prefix-cache hit — cached pages are skipped exactly like the real
+    scheduler skips them), then decodes ``tokens_per_step`` per step,
+    optionally sleeping ``decode_delay_s`` per token to simulate a loaded
+    device for shed/SLO tests."""
+
+    def __init__(self, cfg: dict):
+        self.vocab = int(cfg.get("vocab", 1024))
+        self.block_size = int(cfg.get("block_size", 16))
+        self.max_live = int(cfg.get("max_live", 8))
+        self.cache_pages = int(cfg.get("cache_pages", 256))
+        self.prefill_chunk = int(cfg.get("prefill_chunk", 64))
+        self.tokens_per_step = int(cfg.get("tokens_per_step", 4))
+        self.decode_delay_s = float(cfg.get("decode_delay_s", 0.0))
+        #: the real radix trie — digest/match/publish are the production
+        #: code paths (host-only; named ``radix`` because this backend
+        #: OWNS its fake pool — StateManager's refcounted-API lint governs
+        #: the engine's pool, not this simulation)
+        self.radix = PrefixCache(self.block_size)
+        self._next_block = 1
+        self.seqs: dict[str, dict] = {}
+        self.order: list[str] = []
+        self.prefix_hit_tokens = 0
+
+    def has_work(self) -> bool:
+        return bool(self.seqs)
+
+    def put(self, rec: RequestRecord) -> str | None:
+        if rec.trace_id in self.seqs:
+            return "duplicate"
+        if len(self.seqs) >= self.max_live:
+            return "capacity"
+        nodes = self.radix.match(rec.prompt, max_tokens=len(rec.prompt) - 1)
+        self.radix.acquire(nodes)
+        hit = len(nodes) * self.block_size
+        self.prefix_hit_tokens += hit
+        seed = 0
+        for t in rec.prompt:
+            seed = _mix(seed, int(t))
+        self.seqs[rec.trace_id] = {
+            "rec": rec, "nodes": nodes, "generated": [],
+            "prefill_left": len(rec.prompt) - hit, "seed": seed}
+        self.order.append(rec.trace_id)
+        return None
+
+    def cancel(self, rid: str) -> None:
+        seq = self.seqs.pop(rid, None)
+        if seq is None:
+            return
+        self.order.remove(rid)
+        self.radix.release(seq["nodes"])
+
+    def _finish(self, rid: str) -> None:
+        """Release path: publish full computed pages into the trie (the
+        blocks are fake ids — the trie only tracks ownership), exactly
+        like StateManager.release, so the residency digest grows the way
+        a real replica's does."""
+        seq = self.seqs.pop(rid)
+        self.order.remove(rid)
+        tokens = list(seq["rec"].prompt) + seq["generated"]
+        n_full = len(tokens) // self.block_size
+        blocks = [n.block for n in seq["nodes"]]
+        blocks += [self._fresh_block() for _ in range(n_full - len(blocks))]
+        self.radix.publish(tokens, blocks, len(seq["nodes"]), len(tokens))
+        over = len(self.radix) - self.cache_pages
+        if over > 0:
+            self.radix.evict(over)
+
+    def _fresh_block(self) -> int:
+        self._next_block += 1
+        return self._next_block
+
+    def step(self, inj: FaultInjector) -> list[tuple]:
+        """Advance every live sequence one scheduling quantum. Returns
+        ``(rid, kind, toks, off)`` events; ``done`` events carry the FULL
+        final stream (the protocol's authoritative result)."""
+        events: list[tuple] = []
+        for rid in list(self.order):
+            seq = self.seqs[rid]
+            rec = seq["rec"]
+            if seq["prefill_left"] > 0:
+                if inj.countdown("replica_crash_during_prefill"):
+                    inj.crash_now("replica_crash_during_prefill",
+                                  f"prefill of {rid}")
+                seq["prefill_left"] -= min(self.prefill_chunk,
+                                           seq["prefill_left"])
+                continue
+            n = min(self.tokens_per_step,
+                    rec.max_new_tokens - len(seq["generated"]))
+            off = len(seq["generated"])
+            new: list[int] = []
+            for i in range(n):
+                seq["seed"] = _mix(seq["seed"], off + i)
+                tok = (seq["seed"] >> 33) % self.vocab
+                new.append(int(tok))
+                if rec.eos_token_id is not None \
+                        and tok == rec.eos_token_id:
+                    break
+            if self.decode_delay_s:
+                time.sleep(self.decode_delay_s * len(new))
+            seq["generated"].extend(new)
+            done = len(seq["generated"]) >= rec.max_new_tokens or (
+                rec.eos_token_id is not None
+                and rec.eos_token_id in new)
+            if new:
+                events.append((rid, "chunk", new, off))
+            if done:
+                toks = list(seq["generated"])
+                self._finish(rid)
+                events.append((rid, "done", toks, 0))
+        return events
+
+    def drain_done(self) -> bool:
+        return not self.seqs
+
+    def load(self) -> dict:
+        pend = sum(s["prefill_left"]
+                   + (s["rec"].max_new_tokens - len(s["generated"]))
+                   for s in self.seqs.values())
+        return {"live": len(self.seqs), "queued": len(self.seqs),
+                "pending_tokens": pend,
+                "pending_prefill": any(s["prefill_left"] > 0
+                                       for s in self.seqs.values()),
+                "pending_decode": any(s["prefill_left"] == 0
+                                      for s in self.seqs.values()),
+                "max_seqs": self.max_live}
+
+    def digest(self, max_entries: int = 4096) -> list[int]:
+        return self.radix.residency_digest(max_entries)
+
+    def digest_version(self) -> int:
+        return self.radix.version
+
+
+class EngineBackend:
+    """A real ``InferenceEngineV2`` over a tiny named model. Weights are
+    deterministic in the (model, overrides, seed) triple, so N replicas
+    built from the same spec hold IDENTICAL parameters — greedy replay on
+    a survivor is bit-identical to the stream the dead replica was
+    producing."""
+
+    def __init__(self, cfg: dict):
+        import jax                               # deferred: toy mode never
+        from ..models import build_model         # pays the jax/flax import
+        from ..inference.engine_v2 import InferenceEngineV2
+
+        model = build_model(cfg.get("model", "tiny-gpt2"),
+                            **(cfg.get("overrides") or {}))
+        ecfg = dict(cfg.get("engine") or {})
+        ecfg.setdefault("block_size", 16)
+        ecfg.setdefault("num_blocks", 128)
+        ecfg.setdefault("max_seqs", 4)
+        ecfg.setdefault("max_seq_len", 512)
+        self.eng = InferenceEngineV2(
+            model, rng=jax.random.PRNGKey(int(cfg.get("seed", 0))),
+            config=ecfg)
+        self.block_size = self.eng.config.block_size
+        self.max_live = self.eng.config.max_seqs
+        self._uids: dict[str, int] = {}
+        self._next_uid = 1
+        self._sent: dict[str, int] = {}          # rid -> tokens streamed
+
+    def has_work(self) -> bool:
+        return bool(self._uids) or bool(self.eng._inflight)
+
+    def put(self, rec: RequestRecord) -> str | None:
+        if rec.trace_id in self._uids:
+            return "duplicate"
+        if not self.eng.can_schedule(len(rec.prompt), rec.max_new_tokens):
+            return "capacity"
+        uid = self._next_uid
+        self._next_uid += 1
+        try:
+            self.eng.put(uid, rec.prompt, rec.max_new_tokens,
+                         eos_token_id=rec.eos_token_id, tenant=rec.tenant)
+        except (RuntimeError, ValueError) as e:
+            logger.warning(f"replica: admit of {rec.trace_id} failed: {e}")
+            return "capacity"
+        self._uids[rec.trace_id] = uid
+        self._sent[rec.trace_id] = 0
+        return None
+
+    def cancel(self, rid: str) -> None:
+        uid = self._uids.pop(rid, None)
+        if uid is not None:
+            self.eng.flush(uid)
+            self._sent.pop(rid, None)
+
+    def _in_prefill(self) -> bool:
+        return any(not s.done and s.pending_tokens > 1
+                   for s in self.eng.state.seqs.values())
+
+    def step(self, inj: FaultInjector) -> list[tuple]:
+        if not self.has_work():
+            return []
+        if self._in_prefill() \
+                and inj.countdown("replica_crash_during_prefill"):
+            inj.crash_now("replica_crash_during_prefill", "engine prefill")
+        emitted = self.eng.step()
+        events: list[tuple] = []
+        by_uid = {uid: rid for rid, uid in self._uids.items()}
+        for uid, toks in emitted.items():
+            rid = by_uid.get(uid)
+            if rid is None or not toks:
+                continue
+            events.append((rid, "chunk", [int(t) for t in toks],
+                           self._sent[rid]))
+            self._sent[rid] += len(toks)
+        for rid, uid in list(self._uids.items()):
+            seq = self.eng.state.seqs.get(uid)
+            if seq is not None and seq.done \
+                    and not self.eng._uid_inflight(uid):
+                toks = [int(t) for t in self.eng.flush(uid)]
+                del self._uids[rid]
+                self._sent.pop(rid, None)
+                events.append((rid, "done", toks, 0))
+        return events
+
+    def drain_done(self) -> bool:
+        return not self.has_work()
+
+    def load(self) -> dict:
+        return self.eng.load_summary()
+
+    def digest(self, max_entries: int = 4096) -> list[int]:
+        return self.eng.residency_digest(max_entries) or []
+
+    def digest_version(self) -> int:
+        return self.eng.prefix_cache_version()
+
+
+def _build_backend(cfg: dict):
+    kind = cfg.get("backend", "toy")
+    if kind == "toy":
+        return ToyBackend(cfg)
+    if kind == "engine":
+        return EngineBackend(cfg)
+    raise ValueError(f"unknown replica backend {kind!r}")
+
+
+def serve(cfg: dict, chan: LineChannel) -> int:
+    """The replica event loop. Returns a process exit code; raises only
+    on injected soft faults (the worker runs injection HARD, so in
+    production shape a crash is an ``os._exit``)."""
+    inj = FaultInjector(spec=cfg.get("faults") or {}, env="", hard=True)
+    v = inj.fire("replica_slow_start_s")
+    if v:
+        time.sleep(float(v))
+    if inj.countdown("replica_crash_on_start"):
+        inj.crash_now("replica_crash_on_start", "replica startup")
+    backend = _build_backend(cfg)
+
+    telem = None
+    snap_path = cfg.get("telemetry_snapshot")
+    if snap_path:
+        from ..telemetry import configure
+        telem = configure(enabled=True)
+    hb_interval = float(cfg.get("hb_interval_s", 0.05))
+    send_t = float(cfg.get("send_timeout_s", 2.0))
+    digest_max = int(cfg.get("digest_max", 4096))
+    chan.send({"t": "ready", "pid": os.getpid(),
+               "block_size": backend.block_size,
+               "max_live": backend.max_live,
+               "epoch": int(cfg.get("epoch", 0))}, timeout=send_t)
+
+    draining = False
+    attempts: dict[str, int] = {}        # rid -> router attempt nonce
+    last_hb = 0.0
+    digest_ver_sent = -1                 # first heartbeat always ships it
+    stall_until = 0.0
+    stalled: list[dict] = []             # stream msgs queued during a stall
+
+    def _stream(msg: dict) -> None:
+        """Send a chunk/done/failed message, honoring an active
+        stream-stall window (heartbeats keep flowing — the 'engine
+        wedged, process alive' shape)."""
+        if time.monotonic() < stall_until:
+            stalled.append(msg)
+            return
+        chan.send(msg, timeout=send_t)
+
+    while True:
+        busy = backend.has_work()
+        try:
+            msg = chan.recv(timeout=0.001 if busy else
+                            min(hb_interval, 0.05))
+        except ChannelClosed:
+            return 0                     # router went away
+        if msg is not None:
+            t = msg.get("t")
+            if t == "put":
+                rid = str(msg["id"])
+                attempts[rid] = int(msg.get("a", 0))
+                if draining:
+                    _stream({"t": "failed", "id": rid, "a": attempts[rid],
+                             "reason": "draining"})
+                else:
+                    if inj.countdown("replica_crash_on_put"):
+                        inj.crash_now("replica_crash_on_put",
+                                      f"admit of {rid}")
+                    # a replayed put for a request this replica already
+                    # runs (router presumed us dead, then re-picked us):
+                    # restart from scratch — the attempt nonce above
+                    # already invalidates the old stream's messages
+                    backend.cancel(rid)
+                    reason = backend.put(RequestRecord.from_wire(msg))
+                    if reason:
+                        _stream({"t": "failed", "id": rid,
+                                 "a": attempts[rid], "reason": reason})
+                    elif telem is not None:
+                        telem.registry.counter(
+                            "serving_replica_requests_total",
+                            help="requests admitted by this replica").inc()
+            elif t == "flush":
+                backend.cancel(str(msg["id"]))
+            elif t == "drain":
+                draining = True
+            elif t == "ping":
+                last_hb = 0.0            # answer with an immediate hb
+            elif t == "shutdown":
+                try:
+                    chan.send({"t": "bye"}, timeout=1.0)
+                except (ChannelClosed, ChannelTimeout):
+                    pass                 # router already gone: exit anyway
+                return 0
+
+        for rid, kind, toks, off in backend.step(inj):
+            a = attempts.get(rid, 0)
+            if kind == "chunk":
+                if inj.countdown("replica_hang_after_chunks"):
+                    # process-wide wedge: heartbeats stop too, the
+                    # router's liveness deadline is the only way out
+                    time.sleep(float(inj.value("replica_hang_s") or 3600.0))
+                if inj.countdown("replica_stall_stream_after_chunks"):
+                    stall_until = time.monotonic() + float(
+                        inj.value("replica_stall_stream_s") or 1.0)
+                _stream({"t": "chunk", "id": rid, "a": a, "off": off,
+                         "toks": toks})
+                if telem is not None:
+                    telem.registry.counter(
+                        "serving_replica_tokens_total",
+                        help="tokens streamed by this replica").inc(
+                        len(toks))
+            elif kind == "done":
+                attempts.pop(rid, None)
+                if inj.countdown("replica_drop_done"):
+                    continue             # lost completion reply
+                _stream({"t": "done", "id": rid, "a": a, "toks": toks})
+            else:
+                attempts.pop(rid, None)
+                _stream({"t": "failed", "id": rid, "a": a,
+                         "reason": str(toks)})
+
+        if stalled and time.monotonic() >= stall_until:
+            # stall expired: deliver the queued stream late — the router
+            # has usually reassigned by now and must drop these as stale
+            for m in stalled:
+                chan.send(m, timeout=send_t)
+            stalled.clear()
+
+        now = time.monotonic()
+        if now - last_hb >= hb_interval:
+            last_hb = now
+            hb: dict = {"t": "hb", "load": backend.load()}
+            # the digest rides the heartbeat only when the trie actually
+            # changed — at heartbeat cadence, recomputing and re-shipping
+            # a warm cache's thousands of chain hashes every few dozen
+            # ms is pure waste (the router keeps its last copy)
+            ver = backend.digest_version()
+            if ver != digest_ver_sent:
+                hb["digest"] = backend.digest(digest_max)
+                digest_ver_sent = ver
+            chan.send(hb, timeout=send_t)
+            if telem is not None:
+                telem.write_snapshot(snap_path)
+
+
+def main(argv: list[str]) -> int:
+    import json
+
+    raw = argv[1] if len(argv) > 1 else os.environ.get(
+        "DS_TPU_REPLICA_CONFIG", "{}")
+    if raw.startswith("@"):
+        with open(raw[1:], encoding="utf-8") as f:
+            raw = f.read()
+    cfg = json.loads(raw)
+    # fd hygiene: the protocol owns a PRIVATE dup of stdout, and fd 1 is
+    # pointed at stderr — any stray print()/C-level write to stdout lands
+    # in the log instead of corrupting the message stream
+    proto_fd = os.dup(1)
+    os.dup2(2, 1)
+    sys.stdout = sys.stderr
+    chan = LineChannel(0, proto_fd)
+    try:
+        return serve(cfg, chan)
+    except (ChannelClosed, ChannelTimeout) as e:
+        logger.warning(f"replica: channel lost ({e}); exiting")
+        return 0
+    except KeyboardInterrupt:
+        return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main(sys.argv))

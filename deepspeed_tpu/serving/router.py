@@ -1,0 +1,657 @@
+"""The stateless serving router: admission, placement, failover.
+
+One router process fronts N replica workers (fleet.py). Requests are
+replayable records (protocol.py); the router owns nothing durable — its
+whole state is reconstructible from the records in flight, which is what
+makes failover "resend the record and dedup by trace ID".
+
+The control loop (:meth:`Router.poll`) is single-threaded and every wait
+in it is bounded (bin/check_deadlines.py lints the package): one
+``select`` across replica channels, deadline checks, restart policy,
+dispatch. No message, death, or wedge anywhere in the fleet can make the
+router block unboundedly.
+
+Request lifecycle::
+
+    submit -> [admission: tenant cap, queue bound, SLO shed]
+           -> queued (per-priority FIFO)
+           -> assigned (prefix-cache-aware placement, attempt nonce n)
+           -> streaming (chunks dedup'd/appended against the committed
+              prefix; stale attempts dropped by (slot, epoch, nonce))
+           -> done (replica's "done" carries the FULL stream —
+              authoritative, committed exactly once)
+         | -> failed {replica_lost | timeout | <replica reason> | ...}
+         | -> shed {queue_full | tenant_limit | shed_slo | shed_overload
+                    | draining | no_capacity}
+
+Failover: when a replica dies (process exit, EOF, heartbeat silence) or
+a single request's stream stalls past ``request_timeout_s``, its
+in-flight requests are REPLAYED onto a surviving replica — same record,
+fresh attempt nonce. Greedy decoding makes the replayed stream
+bit-identical, so the router keeps the already-streamed committed prefix
+and appends only beyond it; messages from the presumed-dead attempt are
+dropped by nonce (a slow original can never double-commit). Every retry,
+shed, stale drop, restart and breaker-open is a ``serving_router_*``
+counter, and ``/metrics?aggregate=1`` merges the replicas' snapshot
+files into one fleet scrape.
+"""
+from __future__ import annotations
+
+import os
+import time
+from collections import deque
+from dataclasses import dataclass, field
+
+from ..telemetry import LATENCY_BUCKETS_S, get_telemetry, configure as \
+    telemetry_configure, sanitize_label_value
+from ..telemetry.reqtrace import (TENANT_CARDINALITY_CAP,
+                                  TENANT_OVERFLOW_LABEL)
+from ..utils.logging import logger
+from .fleet import DRAINING, Fleet, FleetConfig, QUARANTINED, READY
+from .placement import StickyMap, chain_hashes, pick_replica
+from .protocol import ChannelClosed, RequestRecord, poll_channels
+
+#: terminal request states
+DONE, FAILED, SHED = "done", "failed", "shed"
+QUEUED, ASSIGNED = "queued", "assigned"
+
+
+class AdmissionError(RuntimeError):
+    """Structured admission refusal: ``reason`` is machine-readable (the
+    shed taxonomy in the module docstring), the message is for humans."""
+
+    def __init__(self, reason: str, detail: str = ""):
+        super().__init__(f"request refused: {reason}"
+                         + (f" ({detail})" if detail else ""))
+        self.reason = reason
+
+
+@dataclass
+class RouterConfig:
+    fleet: FleetConfig = field(default_factory=FleetConfig)
+    #: queued (not yet assigned) requests the router will hold
+    max_queue: int = 256
+    #: live (queued+assigned) requests per tenant; 0 = unlimited
+    per_tenant_live: int = 0
+    #: TTFT SLO driving shed decisions: when the estimated queue wait
+    #: (backlog tokens over the observed fleet token rate) exceeds
+    #: ``slo_ttft_s * shed_headroom``, priority<=0 admissions shed with
+    #: reason "shed_slo" (higher priorities ride the queue bound only).
+    #: None disables estimate-based shedding.
+    slo_ttft_s: float | None = None
+    shed_headroom: float = 1.0
+    #: per-request activity deadline: no chunk/done for this long (while
+    #: the replica itself stays healthy) -> the assignment is presumed
+    #: lost and the request replays elsewhere
+    request_timeout_s: float = 30.0
+    #: replays a request survives before failing "replica_lost"/"timeout"
+    max_retries: int = 2
+    poll_interval_s: float = 0.02
+    #: verify replayed greedy streams against the committed prefix (a
+    #: mismatch is counted either way; strict additionally fails the
+    #:  request — determinism is a correctness property here)
+    strict_replay: bool = False
+    telemetry: bool = False
+
+
+@dataclass
+class _Req:
+    rec: RequestRecord
+    chain: list[int]
+    status: str = QUEUED
+    committed: list[int] = field(default_factory=list)
+    result: list[int] | None = None
+    reason: str | None = None
+    attempt: int = 0                  # bumps per assignment (dedup nonce)
+    retries: int = 0
+    assigned_slot: int = -1
+    assigned_epoch: int = -1
+    submit_t: float = 0.0
+    assign_t: float = 0.0
+    first_tok_t: float = 0.0
+    done_t: float = 0.0
+    last_activity_t: float = 0.0
+    hit_pages: int = 0
+    placed: list[int] = field(default_factory=list)   # slot per attempt
+
+
+class Router:
+    def __init__(self, cfg: RouterConfig | None = None):
+        self.cfg = cfg or RouterConfig()
+        telem = get_telemetry()
+        if self.cfg.telemetry:
+            telem = telemetry_configure(enabled=True)
+            snap = self.cfg.fleet.snapshot_dir
+            if snap:
+                os.makedirs(snap, exist_ok=True)
+                telem.reconfigure(peer_snapshot_glob=os.path.join(
+                    snap, "*.json"))
+        self._telem = telem
+        self.fleet = Fleet(self.cfg.fleet, telemetry=telem)
+        self._reqs: dict[str, _Req] = {}
+        self._queues: dict[int, deque[str]] = {}
+        self._sticky = StickyMap()
+        self._assigned_n: dict[int, int] = {}     # slot -> live assignments
+        self._tenant_live: dict[str, int] = {}
+        self._tenants_seen: set[str] = set()
+        self._draining = False
+        self._tid_ctr = 0
+        self._commits: deque[tuple[float, int]] = deque()  # (t, n) window
+        self.double_commits = 0
+        self.stale_msgs = 0
+        self.replay_mismatches = 0
+
+    # -- lifecycle -------------------------------------------------------
+    def start(self, min_ready: int = 1) -> None:
+        """Spawn the fleet and wait (bounded by the fleet's
+        ``ready_timeout_s``) until ``min_ready`` replicas answered."""
+        self.fleet.start()
+        deadline = time.monotonic() + self.cfg.fleet.ready_timeout_s
+        while len(self.fleet.ready()) < min_ready:
+            if time.monotonic() >= deadline:
+                raise RuntimeError(
+                    f"fleet: {len(self.fleet.ready())}/{min_ready} "
+                    f"replicas ready within "
+                    f"{self.cfg.fleet.ready_timeout_s}s")
+            self.poll(0.05)
+
+    def close(self) -> None:
+        self.fleet.shutdown()
+
+    def __enter__(self) -> "Router":
+        self.start()
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.close()
+
+    # -- admission -------------------------------------------------------
+    def submit(self, prompt, *, tenant: str = "default",
+               max_new_tokens: int = 16, eos_token_id: int | None = None,
+               priority: int = 0, trace_id: str | None = None) -> str:
+        """Admit a request or refuse it with a structured
+        :class:`AdmissionError`. Returns the trace ID; results arrive via
+        :meth:`poll`/:meth:`run` and :meth:`result`."""
+        if self._draining:
+            self._count_shed("draining", tenant)
+            raise AdmissionError("draining")
+        if self.fleet.replicas and all(r.state == QUARANTINED
+                                       for r in self.fleet.replicas):
+            # degrade mode: every slot's breaker is open — nothing will
+            # serve this within any SLO, fail fast with a structured
+            # reason instead of queueing into the void
+            self._count_shed("no_capacity", tenant)
+            raise AdmissionError("no_capacity",
+                                 "all replica slots quarantined")
+        cap = self.cfg.per_tenant_live
+        if cap and self._tenant_live.get(tenant, 0) >= cap:
+            self._count_shed("tenant_limit", tenant)
+            raise AdmissionError("tenant_limit",
+                                 f"{tenant} at {cap} live requests")
+        n_queued = sum(len(q) for q in self._queues.values())
+        if n_queued >= self.cfg.max_queue:
+            victim = self._lowest_priority_queued(below=priority)
+            if victim is None:
+                self._count_shed("queue_full", tenant)
+                raise AdmissionError(
+                    "queue_full", f"{n_queued} queued (max "
+                    f"{self.cfg.max_queue}), none lower priority")
+            # priority shed: a lower-priority queued request yields its
+            # place — it terminates SHED with a structured reason, the
+            # submitter of THIS request gets the slot
+            self._terminate(victim, SHED, "shed_overload")
+        if self.cfg.slo_ttft_s is not None and priority <= 0:
+            est = self._est_queue_wait_s()
+            if est is not None and est > self.cfg.slo_ttft_s \
+                    * self.cfg.shed_headroom:
+                self._count_shed("shed_slo", tenant)
+                raise AdmissionError(
+                    "shed_slo", f"estimated queue wait {est:.2f}s over "
+                    f"TTFT SLO {self.cfg.slo_ttft_s}s")
+
+        self._tid_ctr += 1
+        tid = trace_id or f"r{os.getpid():x}-{self._tid_ctr}"
+        if tid in self._reqs:
+            raise ValueError(f"duplicate trace id {tid}")
+        bs = self._fleet_block_size()
+        rec = RequestRecord(trace_id=tid,
+                            prompt=[int(t) for t in prompt],
+                            max_new_tokens=int(max_new_tokens),
+                            eos_token_id=eos_token_id, tenant=tenant,
+                            priority=int(priority),
+                            submitted_t=time.monotonic())
+        # the chain commits to full pages of the PREFIX a replica could
+        # actually serve from cache: the prompt's last token always
+        # computes fresh (its forward produces the first logits)
+        chain = chain_hashes(rec.prompt[:-1], bs) if bs else []
+        req = _Req(rec=rec, chain=chain, submit_t=rec.submitted_t)
+        self._reqs[tid] = req
+        self._queues.setdefault(rec.priority, deque()).append(tid)
+        self._tenant_live[tenant] = self._tenant_live.get(tenant, 0) + 1
+        if self._telem.enabled:
+            self._telem.registry.counter(
+                "serving_router_requests_total",
+                help="requests admitted by the router").inc()
+            self._telem.registry.counter(
+                "serving_tenant_requests_total",
+                labels={"tenant": self._tenant_label(tenant)},
+                help="router admissions per tenant").inc()
+        return tid
+
+    def _lowest_priority_queued(self, below: int) -> str | None:
+        for p in sorted(self._queues):
+            if p >= below:
+                return None
+            q = self._queues[p]
+            if q:
+                return q[0]              # oldest at the lowest priority
+        return None
+
+    def _est_queue_wait_s(self) -> float | None:
+        """Backlog tokens over the observed commit rate (5s window).
+        None while cold — estimate-based shedding never fires before the
+        fleet has produced tokens to estimate from."""
+        now = time.monotonic()
+        while self._commits and now - self._commits[0][0] > 5.0:
+            self._commits.popleft()
+        tok = sum(n for _, n in self._commits)
+        if tok < 16:
+            return None
+        # rate over the ACTUAL observed span (floored against div-zero),
+        # not the window width — right after warm-up the history covers
+        # far less than 5s and dividing by the window would underestimate
+        # the fleet ~25x and shed load it could serve within SLO
+        span = min(max(now - self._commits[0][0], 0.25), 5.0)
+        rate = tok / span
+        backlog = sum(
+            r.rec.max_new_tokens + len(r.rec.prompt) // 8
+            for r in self._reqs.values() if r.status == QUEUED)
+        return backlog / rate
+
+    # -- the control loop ------------------------------------------------
+    def poll(self, budget_s: float | None = None) -> None:
+        """One tick: reap/restart replicas, replay orphans, pump
+        messages, enforce per-request deadlines, dispatch the queue."""
+        now = time.monotonic()
+        for r in self.fleet.maintain(now):
+            self._sticky.forget_slot(r.slot)
+            self._replay_orphans(r.slot, r.epoch, "replica_lost")
+        for ch in poll_channels(
+                self.fleet.channels(),
+                self.cfg.poll_interval_s if budget_s is None else budget_s):
+            h = self.fleet.by_channel(ch)
+            if h is None:
+                continue
+            while True:
+                try:
+                    msg = ch.recv(timeout=0)
+                except ChannelClosed:
+                    break                # maintain() reaps it next tick
+                if msg is None:
+                    break
+                h.last_msg_t = time.monotonic()
+                self._handle(h, msg)
+        self._check_deadlines(time.monotonic())
+        self._dispatch(time.monotonic())
+
+    def run(self, deadline_s: float = 60.0) -> dict:
+        """Poll until every submitted request is terminal, or fail the
+        stragglers with reason ``router_deadline`` at the deadline (the
+        loop is bounded NO MATTER WHAT the fleet does). Returns
+        :meth:`results`."""
+        deadline = time.monotonic() + deadline_s
+        while any(r.status in (QUEUED, ASSIGNED)
+                  for r in self._reqs.values()):
+            if time.monotonic() >= deadline:
+                for tid, r in list(self._reqs.items()):
+                    if r.status in (QUEUED, ASSIGNED):
+                        self._terminate(tid, FAILED, "router_deadline")
+                break
+            self.poll()
+        return self.results()
+
+    # -- message handling ------------------------------------------------
+    def _handle(self, h, msg: dict) -> None:
+        t = msg.get("t")
+        if t == "ready":
+            self.fleet.on_ready(h, msg)
+        elif t == "hb":
+            h.load = msg.get("load")
+            if "digest" in msg:
+                # absent key = unchanged since the last shipped digest
+                # (replicas version it); the router keeps its copy
+                d = msg["digest"]
+                h.digest = set(d) if d else None
+        elif t in ("chunk", "done", "failed"):
+            self._on_stream(h, msg)
+        elif t == "bye":
+            h.state = DRAINING
+
+    def _stale(self, h, req: _Req | None, msg: dict) -> bool:
+        if (req is None or req.status != ASSIGNED
+                or req.assigned_slot != h.slot
+                or req.assigned_epoch != h.epoch
+                or int(msg.get("a", -1)) != req.attempt):
+            self.stale_msgs += 1
+            if self._telem.enabled:
+                self._telem.registry.counter(
+                    "serving_router_stale_msgs_total",
+                    help="stream messages dropped by the (slot, epoch, "
+                         "attempt) dedup guard — a presumed-dead "
+                         "replica's late delivery").inc()
+            return True
+        return False
+
+    def _on_stream(self, h, msg: dict) -> None:
+        tid = str(msg.get("id"))
+        req = self._reqs.get(tid)
+        if self._stale(h, req, msg):
+            return
+        now = time.monotonic()
+        req.last_activity_t = now
+        if msg["t"] == "chunk":
+            off = int(msg.get("off", 0))
+            toks = [int(x) for x in msg.get("toks", ())]
+            self._append_stream(req, off, toks, now)
+        elif msg["t"] == "done":
+            toks = [int(x) for x in msg.get("toks", ())]
+            if req.committed and req.committed != \
+                    toks[:len(req.committed)]:
+                self._note_mismatch(req)
+                if self.cfg.strict_replay:
+                    self._terminate(tid, FAILED, "replay_mismatch")
+                    return
+            req.result = toks
+            req.done_t = now
+            if req.first_tok_t == 0.0 and toks:
+                req.first_tok_t = now
+            self._observe_latency(req)
+            self._note_commit(now, max(len(toks) - len(req.committed), 0))
+            self._terminate(tid, DONE, None)
+        else:                            # failed
+            reason = str(msg.get("reason", "internal"))
+            if reason == "draining":
+                # the replica is winding down, not broken: stop routing
+                # to it and requeue WITHOUT burning a retry (the drain
+                # deadline bounds this, not the retry budget)
+                h.state = DRAINING
+                self._unassign(req)
+                req.status = QUEUED
+                self._queues.setdefault(req.rec.priority,
+                                        deque()).appendleft(
+                    req.rec.trace_id)
+                return
+            self._retry_or_fail(req, reason)
+
+    def _append_stream(self, req: _Req, off: int, toks: list[int],
+                       now: float) -> None:
+        """Fold a chunk into the committed stream. A replayed attempt
+        restarts at off 0 — the overlap with the committed prefix must
+        match bit-for-bit (greedy determinism); only tokens beyond the
+        prefix append. Gaps (off past the committed end) mean a dropped
+        chunk: ignore — the authoritative "done" stream heals it."""
+        have = len(req.committed)
+        if off > have:
+            return
+        overlap = req.committed[off:]
+        if overlap and toks[:len(overlap)] != overlap[:len(toks)]:
+            self._note_mismatch(req)
+            if self.cfg.strict_replay:
+                self._terminate(req.rec.trace_id, FAILED,
+                                "replay_mismatch")
+                return
+        new = toks[have - off:]
+        if not new:
+            return
+        if req.first_tok_t == 0.0:
+            req.first_tok_t = now
+            if self._telem.enabled:
+                self._telem.registry.histogram(
+                    "serving_router_ttft_s", buckets=LATENCY_BUCKETS_S,
+                    help="submit -> first streamed token "
+                         "(router-observed)").observe(now - req.submit_t)
+                self._telem.registry.histogram(
+                    "serving_tenant_ttft_s", buckets=LATENCY_BUCKETS_S,
+                    labels={"tenant": self._tenant_label(req.rec.tenant)},
+                    help="per-tenant router-observed TTFT").observe(
+                    now - req.submit_t)
+                self._telem.registry.histogram(
+                    "serving_router_queue_wait_s",
+                    buckets=LATENCY_BUCKETS_S,
+                    help="submit -> assignment dispatch").observe(
+                    req.assign_t - req.submit_t)
+        req.committed.extend(new)
+        self._note_commit(now, len(new))
+
+    def _note_mismatch(self, req: _Req) -> None:
+        self.replay_mismatches += 1
+        logger.error(f"router: replay stream mismatch on "
+                     f"{req.rec.trace_id} attempt {req.attempt} — greedy "
+                     f"replay should be bit-identical")
+        if self._telem.enabled:
+            self._telem.registry.counter(
+                "serving_router_replay_mismatch_total",
+                help="replayed streams disagreeing with the committed "
+                     "prefix (should be zero under greedy "
+                     "decoding)").inc()
+
+    def _note_commit(self, now: float, n: int) -> None:
+        if n > 0:
+            self._commits.append((now, n))
+
+    def _observe_latency(self, req: _Req) -> None:
+        if not self._telem.enabled or req.result is None:
+            return
+        n = len(req.result)
+        if n >= 2 and req.first_tok_t:
+            tbt = (req.done_t - req.first_tok_t) / (n - 1)
+            self._telem.registry.histogram(
+                "serving_router_tbt_s", buckets=LATENCY_BUCKETS_S,
+                help="per-token time between tokens (router-observed, "
+                     "amortized over the stream)").observe(tbt, n=n - 1)
+
+    # -- failover --------------------------------------------------------
+    def _replay_orphans(self, slot: int, epoch: int, reason: str) -> None:
+        for tid, req in list(self._reqs.items()):
+            if req.status == ASSIGNED and req.assigned_slot == slot \
+                    and req.assigned_epoch <= epoch:
+                self._retry_or_fail(req, reason)
+
+    def _retry_or_fail(self, req: _Req, reason: str) -> None:
+        tid = req.rec.trace_id
+        self._unassign(req)
+        if req.retries >= self.cfg.max_retries:
+            self._terminate(tid, FAILED, reason)
+            return
+        req.retries += 1
+        req.status = QUEUED
+        # replay jumps the line: the request already waited its turn once
+        self._queues.setdefault(req.rec.priority, deque()).appendleft(tid)
+        if self._telem.enabled:
+            self._telem.registry.counter(
+                "serving_router_retries_total",
+                help="requests replayed onto another replica after a "
+                     "loss").inc()
+        logger.warning(f"router: replaying {tid} (attempt "
+                       f"{req.attempt + 1}, cause {reason}, "
+                       f"{len(req.committed)} tokens already streamed)")
+
+    def _check_deadlines(self, now: float) -> None:
+        for tid, req in list(self._reqs.items()):
+            if req.status != ASSIGNED:
+                continue
+            if now - req.last_activity_t > self.cfg.request_timeout_s:
+                # the replica may be healthy (lost reply / wedged stream)
+                # — clean up our sequence there, then replay
+                slot = req.assigned_slot
+                if 0 <= slot < len(self.fleet.replicas):
+                    self.fleet.replicas[slot].send(
+                        {"t": "flush", "id": tid})
+                self._retry_or_fail(req, "timeout")
+
+    # -- dispatch --------------------------------------------------------
+    def _candidates(self) -> list:
+        return [r for r in self.fleet.ready()
+                if self._assigned_n.get(r.slot, 0) < max(r.max_live, 1)]
+
+    def _dispatch(self, now: float) -> None:
+        while True:
+            cands = self._candidates()
+            if not cands:
+                return
+            tid = None
+            for p in sorted(self._queues, reverse=True):
+                if self._queues[p]:
+                    tid = self._queues[p].popleft()
+                    break
+            if tid is None:
+                return
+            req = self._reqs[tid]
+            rep, hit_pages = pick_replica(cands, req.chain, self._sticky)
+            req.attempt += 1
+            req.status = ASSIGNED
+            req.assigned_slot = rep.slot
+            req.assigned_epoch = rep.epoch
+            req.assign_t = req.last_activity_t = now
+            req.hit_pages = hit_pages
+            req.placed.append(rep.slot)
+            self._assigned_n[rep.slot] = \
+                self._assigned_n.get(rep.slot, 0) + 1
+            self._sticky.note(req.chain, rep.slot)
+            wire = req.rec.to_wire()
+            wire["a"] = req.attempt
+            if not rep.send(wire):
+                # send failed: the slot is toast; requeue and let
+                # maintain() reap it next tick
+                self._retry_or_fail(req, "send_failed")
+                return
+            if self._telem.enabled:
+                bs = rep.block_size or self._fleet_block_size() or 1
+                self._telem.registry.counter(
+                    "serving_router_placements_total",
+                    help="dispatch decisions").inc()
+                self._telem.registry.counter(
+                    "serving_router_placement_prefix_tokens_total",
+                    help="prompt tokens estimated cache-resident at the "
+                         "chosen replica (placement quality "
+                         "numerator)").inc(hit_pages * bs)
+                self._telem.registry.counter(
+                    "serving_router_placement_lookup_tokens_total",
+                    help="page-aligned prompt tokens considered by "
+                         "placement (denominator)").inc(
+                    len(req.chain) * bs)
+                self._telem.registry.gauge(
+                    "serving_router_queue_depth",
+                    help="requests queued at the router").set(
+                    sum(len(q) for q in self._queues.values()))
+
+    # -- bookkeeping -----------------------------------------------------
+    def _unassign(self, req: _Req) -> None:
+        if req.assigned_slot >= 0:
+            n = self._assigned_n.get(req.assigned_slot, 0)
+            self._assigned_n[req.assigned_slot] = max(n - 1, 0)
+        req.assigned_slot = req.assigned_epoch = -1
+
+    def _terminate(self, tid: str, status: str, reason: str | None) -> None:
+        req = self._reqs.get(tid)
+        if req is None:
+            return
+        if req.status in (DONE, FAILED, SHED):
+            self.double_commits += 1
+            logger.error(f"router: refusing double terminal transition "
+                         f"for {tid} ({req.status} -> {status})")
+            return
+        if req.status == QUEUED:
+            for q in self._queues.values():
+                if tid in q:
+                    q.remove(tid)
+                    break
+        self._unassign(req)
+        req.status = status
+        req.reason = reason
+        t = self._tenant_live.get(req.rec.tenant, 1) - 1
+        self._tenant_live[req.rec.tenant] = max(t, 0)
+        if self._telem.enabled:
+            if status == DONE:
+                self._telem.registry.counter(
+                    "serving_router_completed_total",
+                    help="requests completed exactly once").inc()
+            elif status == FAILED:
+                self._telem.registry.counter(
+                    "serving_router_failed_total",
+                    labels={"reason": sanitize_label_value(reason)},
+                    help="requests failed with a structured "
+                         "reason").inc()
+            else:
+                self._count_shed(reason or "shed", req.rec.tenant)
+
+    def _count_shed(self, reason: str, tenant: str) -> None:
+        if not self._telem.enabled:
+            return
+        self._telem.registry.counter(
+            "serving_router_sheds_total",
+            labels={"reason": sanitize_label_value(reason)},
+            help="admissions refused / queued requests shed, by "
+                 "structured reason").inc()
+        self._telem.registry.counter(
+            "serving_tenant_shed_total",
+            labels={"tenant": self._tenant_label(tenant)},
+            help="per-tenant sheds").inc()
+
+    def _tenant_label(self, tenant: str) -> str:
+        v = sanitize_label_value(tenant)
+        if v in self._tenants_seen \
+                or len(self._tenants_seen) < TENANT_CARDINALITY_CAP:
+            self._tenants_seen.add(v)
+            return v
+        return TENANT_OVERFLOW_LABEL
+
+    def _fleet_block_size(self) -> int:
+        for r in self.fleet.replicas:
+            if r.block_size:
+                return r.block_size
+        return int(self.cfg.fleet.replica.get("block_size", 16))
+
+    # -- results / drain -------------------------------------------------
+    def result(self, tid: str) -> dict:
+        req = self._reqs[tid]
+        return {"status": req.status, "reason": req.reason,
+                "tokens": list(req.result) if req.result is not None
+                else list(req.committed),
+                "tenant": req.rec.tenant, "attempts": req.attempt,
+                "retries": req.retries, "placed": list(req.placed),
+                "hit_pages": req.hit_pages,
+                "ttft_s": (req.first_tok_t - req.submit_t)
+                if req.first_tok_t else None}
+
+    def results(self) -> dict:
+        return {tid: self.result(tid) for tid in self._reqs}
+
+    def drain(self, deadline_s: float = 30.0) -> bool:
+        """Graceful drain: stop admitting (submit sheds "draining"),
+        finish everything already admitted — queued included — then tell
+        the replicas to wind down. The replica-side drain goes out only
+        once the router's queue is EMPTY: sending it earlier makes
+        replicas bounce the router's own still-queued dispatches.
+        Stragglers past the deadline fail with reason ``drain_timeout``.
+        True if everything in flight completed."""
+        self._draining = True
+        deadline = time.monotonic() + deadline_s
+        drain_sent = False
+        while any(r.status in (QUEUED, ASSIGNED)
+                  for r in self._reqs.values()):
+            if not drain_sent and not any(
+                    r.status == QUEUED for r in self._reqs.values()):
+                for rep in self.fleet.ready():
+                    rep.send({"t": "drain"})
+                drain_sent = True
+            if time.monotonic() >= deadline:
+                for tid, r in list(self._reqs.items()):
+                    if r.status in (QUEUED, ASSIGNED):
+                        self._terminate(tid, FAILED, "drain_timeout")
+                return False
+            self.poll()
+        if not drain_sent:
+            for rep in self.fleet.ready():
+                rep.send({"t": "drain"})
+        return True

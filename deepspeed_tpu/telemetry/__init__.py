@@ -33,8 +33,17 @@ from .reqtrace import (LIFECYCLE_EVENTS, TENANT_CARDINALITY_CAP,
 from .spans import NULL_SPAN, SpanTracer
 from .exposition import TelemetryHTTPServer
 
+#: metric-name prefix of every router-side series (serving/router.py) —
+#: the registry-zeroing scopes the bench and the router harness use to
+#: coexist in one process registry (Telemetry.reset_metrics)
+SERVING_ROUTER_PREFIX = "serving_router_"
+#: families the ROUTER harness owns per measured scenario: its own
+#: counters plus the per-tenant attribution it emits in the PR-7 format
+ROUTER_RUN_PREFIXES = (SERVING_ROUTER_PREFIX, "serving_tenant_")
+
 __all__ = [
     "Telemetry", "get_telemetry", "configure",
+    "SERVING_ROUTER_PREFIX", "ROUTER_RUN_PREFIXES",
     "SpanTracer", "MetricsRegistry", "Counter", "Gauge", "Histogram",
     "FlightRecorder", "TelemetryHTTPServer", "MFUTracker", "ReqTracer",
     "mfu", "goodput", "device_peak_flops", "sanitize_metric_name",
@@ -200,6 +209,17 @@ class Telemetry:
             h["reqtrace_traces"] = self.reqtrace.traces_started
             h["reqtrace_breaches"] = self.reqtrace.breaches
         return h
+
+    def reset_metrics(self, prefix: str | tuple[str, ...] | None = None,
+                      keep: tuple[str, ...] = ()) -> None:
+        """THE registry-zeroing entry point for per-run measurement scopes
+        (bench phases, router bench scenarios). Components co-resident in
+        one process zero only their own families: the bench-driven engine
+        resets with ``keep=(SERVING_ROUTER_PREFIX,)`` and the router
+        harness resets with ``prefix=ROUTER_RUN_PREFIXES`` — an inline
+        ``registry.reset()`` at either site would clobber the other
+        component's series mid-run."""
+        self.registry.reset(prefix=prefix, keep=keep)
 
     # -- reading ---------------------------------------------------------
     def snapshot(self) -> dict:

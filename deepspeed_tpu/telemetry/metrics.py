@@ -287,11 +287,26 @@ class MetricsRegistry:
                     h.sum += s["sum"]
                     h.count += s["count"]
 
-    def reset(self) -> None:
-        """Drop every series (bench zeroes the registry per measured run,
-        like it zeroes engine stats)."""
+    def reset(self, prefix: str | tuple[str, ...] | None = None,
+              keep: tuple[str, ...] = ()) -> None:
+        """Drop metric families — all of them by default (bench zeroes the
+        registry per measured run, like it zeroes engine stats), or only
+        those whose name starts with ``prefix``. Families starting with a
+        ``keep`` prefix always survive: two components sharing one
+        process-wide registry (bench-driven engine + co-resident router)
+        each zero THEIR families per measured run without clobbering the
+        other's — see ``Telemetry.reset_metrics``."""
+        if isinstance(prefix, str):
+            prefix = (prefix,)
         with self._lock:
-            self._metrics.clear()
+            if prefix is None and not keep:
+                self._metrics.clear()
+                return
+            for name in list(self._metrics):
+                if keep and name.startswith(keep):
+                    continue
+                if prefix is None or name.startswith(prefix):
+                    del self._metrics[name]
 
     # -- exposition ------------------------------------------------------
     def render_prometheus(self, exemplars: bool = False) -> str:

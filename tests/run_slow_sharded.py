@@ -50,6 +50,9 @@ HEAVY = [
     # integrations each compile a tiny engine (the breach case with the
     # spec verify + merge programs on top)
     "test_reqtrace.py",
+    # serving fleet: the engine-backend failover test spawns TWO replica
+    # subprocesses that each compile a tiny engine
+    "test_serving.py",
 ]
 
 

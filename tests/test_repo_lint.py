@@ -31,6 +31,7 @@ swallows = _load("check_exception_swallows")
 metric_lint = _load("check_metric_names")
 state_lint = _load("check_state_invariants")
 reqtrace_lint = _load("check_reqtrace_events")
+deadline_lint = _load("check_deadlines")
 
 
 def test_repo_has_no_import_time_device_probes():
@@ -266,3 +267,65 @@ def test_swallow_detector_allows_narrow_logged_and_del(tmp_path):
         "        except Exception:\n"    # shutdown teardown race: idiomatic
         "            pass\n")
     assert swallows.check_file(str(ok)) == []
+
+
+# --- bounded waits in the serving tier --------------------------------------
+
+def test_serving_tier_has_no_unbounded_waits():
+    violations = deadline_lint.check_repo(ROOT)
+    assert violations == [], "\n".join(violations)
+
+
+def test_deadline_detector_flags_bare_waits(tmp_path):
+    serving = tmp_path / "deepspeed_tpu" / "serving"
+    serving.mkdir(parents=True)
+    bad = serving / "bad.py"
+    bad.write_text(
+        "import select, time\n"
+        "def f(q, th, sock, proc, ch, ev):\n"
+        "    q.get()\n"                            # bare get: flagged
+        "    q.get(timeout=1.0)\n"                 # bounded: ok
+        "    d = {}\n"
+        "    d.get('k')\n"                         # dict.get: ok (argful)
+        "    th.join()\n"                          # bare join: flagged
+        "    th.join(timeout=2)\n"                 # ok
+        "    ','.join(['a'])\n"                    # str.join: ok
+        "    ev.wait()\n"                          # bare wait: flagged
+        "    proc.wait(timeout=5)\n"               # ok
+        "    proc.poll()\n"                        # non-blocking: ok
+        "    sock.recv(4096)\n"                    # raw socket: flagged
+        "    ch.recv(timeout=0.1)\n"               # deadline kw: ok
+        "    sock.accept()\n"                      # flagged
+        "    f2 = sock.makefile()\n"
+        "    f2.readline()\n"                      # flagged
+        "    select.select([0], [], [])\n"         # no timeout: flagged
+        "    select.select([0], [], [], 0.5)\n"    # ok
+        "    p = select.poll()\n"                  # constructor: flagged
+        "    time.sleep(0.1)\n"                    # pacing: ok
+        "    time.sleep(3600)\n")                  # forever-ish: flagged
+    out = deadline_lint.check_file(str(bad))
+    assert len(out) == 9, "\n".join(out)
+    for frag in (":3:", ":7:", ":10:", ":13:", ":15:", ":17:", ":18:",
+                 ":20:", ":22:"):
+        assert any(frag in v for v in out), (frag, out)
+
+
+def test_deadline_detector_honors_allowlist(tmp_path):
+    """replica.py's serve() carries the fault-injected hang — THE
+    unbounded sleep under test — and nothing else does."""
+    serving = tmp_path / "deepspeed_tpu" / "serving"
+    serving.mkdir(parents=True)
+    rep = serving / "replica.py"
+    rep.write_text(
+        "import time\n"
+        "def serve(inj):\n"
+        "    time.sleep(3600)\n"                   # allowlisted hang
+        "def other():\n"
+        "    time.sleep(3600)\n")                  # flagged
+    out = deadline_lint.check_file(str(rep))
+    assert len(out) == 1 and ":5:" in out[0]
+
+
+def test_deadline_lint_requires_the_serving_package():
+    out = deadline_lint.check_repo("/nonexistent")
+    assert len(out) == 1 and "missing" in out[0]

@@ -678,3 +678,45 @@ def test_training_engine_telemetry_and_timer_means(tmp_path, global_telem):
     assert len(csv.read_text().strip().split("\n")) >= 2  # header + means
     # spans mirrored as step spans
     assert any(e["name"] == "train_batch" for e in t.tracer.events())
+
+
+def test_registry_scoped_reset_two_components():
+    """The registry-zeroing helper (Telemetry.reset_metrics /
+    MetricsRegistry.reset with prefix/keep scopes): a bench-driven engine
+    and a co-resident router share one process registry, and each zeroes
+    ITS families per measured run without clobbering the other's — the
+    inline registry.reset() the bench used to do would wipe the router's
+    counters mid-scenario."""
+    from deepspeed_tpu.telemetry import (ROUTER_RUN_PREFIXES,
+                                         SERVING_ROUTER_PREFIX, Telemetry)
+
+    t = Telemetry(enabled=True)
+    # engine-side families (bench's measured-run scope)...
+    t.registry.counter("serving_requests_total").inc(3)
+    t.registry.histogram("serving_ttft_s").observe(0.1)
+    # ...and router-side families, co-resident
+    t.registry.counter("serving_router_requests_total").inc(7)
+    t.registry.counter("serving_router_sheds_total",
+                       labels={"reason": "queue_full"}).inc()
+    t.registry.counter("serving_tenant_requests_total",
+                       labels={"tenant": "acme"}).inc()
+
+    # bench zeroes ITS run: router families survive
+    t.reset_metrics(keep=ROUTER_RUN_PREFIXES)
+    snap = t.snapshot()
+    assert "serving_requests_total" not in snap
+    assert "serving_ttft_s" not in snap
+    assert snap["serving_router_requests_total"]["series"][0]["value"] == 7
+    assert "serving_tenant_requests_total" in snap
+
+    # router zeroes ITS scenario: engine families survive
+    t.registry.counter("serving_requests_total").inc(5)
+    t.reset_metrics(prefix=ROUTER_RUN_PREFIXES)
+    snap = t.snapshot()
+    assert not any(k.startswith(SERVING_ROUTER_PREFIX) for k in snap)
+    assert "serving_tenant_requests_total" not in snap
+    assert snap["serving_requests_total"]["series"][0]["value"] == 5
+
+    # no scope = the historical full wipe
+    t.reset_metrics()
+    assert t.snapshot() == {}
