@@ -4,7 +4,10 @@ trace emission uses a declared transition kind.
 
 telemetry/reqtrace.py declares the canonical lifecycle-transition set
 (``LIFECYCLE_EVENTS``) — enqueue/admit/evict/prefill_chunk/decode_step/
-decode_window/spec_round/spec_depth_adapt/rollback/rewind/commit/release.
+decode_window/spec_round/spec_depth_adapt/rollback/rewind/commit/release/
+migrate_out/migrate_in (the migration pair is emitted on BOTH replicas
+of a disaggregated handoff, carrying the serving trace ID that links
+them).
 The value of a request timeline is COMPLETENESS: a postmortem that shows
 admit and commit but silently lacks the rollback in between reads as a
 healthy request. Transitions are emitted from five modules (engine_v2,

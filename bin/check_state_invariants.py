@@ -23,7 +23,13 @@ in ``deepspeed_tpu/`` outside the allowlisted ``StateManager`` methods:
   ``StateManager`` methods (``provision`` / ``commit_speculative`` /
   ``rollback_provisional`` / ``rewind``) — a stray mutation elsewhere
   would let a verify round's rejected candidates skip the rollback
-  bookkeeping and desync the full-pool ``audit()``.
+  bookkeeping and desync the full-pool ``audit()``;
+- assignments to a ``.migrating`` attribute (KV-page migration's
+  pin/freeze flag): legal ONLY inside the refcounted
+  export/import/abort API (``migrate_out`` / ``export_ack`` /
+  ``export_abort`` / ``migrate_in_begin`` / ``import_commit`` /
+  ``abort_import``) — a stray mutation would let a pinned export's
+  pages be scheduled or released mid-transfer.
 
 Reads (``allocator.free_blocks``, ``prefix_cache.stats()``, iterating
 ``seq.blocks``) are fine anywhere.
@@ -43,11 +49,19 @@ STATE_FILE = "deepspeed_tpu/inference/ragged.py"
 
 #: (rule, function name) pairs allowed inside STATE_FILE
 ALLOWED = {
-    "allocator": {"_alloc", "release"},
-    "prefix_cache": {"admit", "release", "_alloc"},
-    "blocks": {"admit"},
+    "allocator": {"_alloc", "release", "migrate_in_begin",
+                  "import_commit", "abort_import"},
+    "prefix_cache": {"admit", "release", "_alloc", "import_commit"},
+    "blocks": {"admit", "migrate_in_begin", "import_commit",
+               "abort_import"},
     "n_provisional": {"provision", "commit_speculative",
                       "rollback_provisional", "rewind"},
+    #: KV-page migration (inference/migration.py): the pin/freeze flag.
+    #: A stray mutation would let a "pinned" export's pages be scheduled
+    #: or released mid-transfer — exactly the double-own/stale hazard the
+    #: refcounted export/import/abort API exists to prevent.
+    "migrating": {"migrate_out", "export_ack", "export_abort",
+                  "migrate_in_begin", "import_commit", "abort_import"},
 }
 
 #: mutating list-method names (on a ``.blocks`` attribute)
@@ -55,7 +69,8 @@ LIST_MUTATORS = {"append", "extend", "insert", "pop", "remove", "clear",
                  "sort", "reverse"}
 
 #: prefix-cache methods that change block ownership / pinning
-CACHE_MUTATORS = {"match", "acquire", "release", "publish", "evict"}
+CACHE_MUTATORS = {"match", "acquire", "release", "publish", "evict",
+                  "adopt"}
 
 
 def _chain(node: ast.expr) -> list[str]:
@@ -127,6 +142,9 @@ class _Visitor(ast.NodeVisitor):
             elif isinstance(t, ast.Attribute) and t.attr == "n_provisional":
                 self._flag(node, "n_provisional",
                            "assignment to a .n_provisional attribute")
+            elif isinstance(t, ast.Attribute) and t.attr == "migrating":
+                self._flag(node, "migrating",
+                           "assignment to a .migrating attribute")
             elif isinstance(t, (ast.Tuple, ast.List)):
                 self._check_targets(node, t.elts)
 

@@ -107,6 +107,22 @@ def feature_report() -> list[tuple[str, bool, str]]:
     except Exception as e:  # pragma: no cover — import breakage only
         feats.append(("serving: multi-replica router", False, str(e)))
 
+    # disaggregated prefill/decode (serving/disagg.py over the KV-page
+    # migration primitive in inference/migration.py): host logic + the
+    # engine's pool read/scatter — an import check here too
+    try:
+        from .inference import migration as _mig  # noqa: F401
+        from .serving import disagg as _disagg  # noqa: F401
+        feats.append((
+            "serving: disaggregated prefill/decode", True,
+            "FleetConfig roles=['prefill','decode',...] — KV page-bundle "
+            "handoff through the router (pinned-until-ack, resumable, "
+            "bit-identical greedy), remote replicas via --listen "
+            "sockets, scale-hint gauges; BENCH_MODE=disagg"))
+    except Exception as e:  # pragma: no cover — import breakage only
+        feats.append(("serving: disaggregated prefill/decode", False,
+                      str(e)))
+
     # telemetry / monitor backends (telemetry/ + monitor/): which push
     # backends can actually activate, and where the pull endpoint +
     # flight recorder would land for this process

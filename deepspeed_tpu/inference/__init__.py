@@ -4,6 +4,12 @@ from .engine_v2 import (  # noqa: F401
     RaggedInferenceConfig,
     build_engine,
 )
+from .migration import (  # noqa: F401
+    BundleAssembler,
+    MigrationError,
+    PageBundle,
+    iter_chunks,
+)
 from .prefix_cache import PageNode, PrefixCache  # noqa: F401
 from .ragged import BlockedAllocator, SequenceDescriptor, StateManager  # noqa: F401
 from .sampling import sample_logits  # noqa: F401

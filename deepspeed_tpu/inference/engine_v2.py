@@ -587,7 +587,12 @@ class InferenceEngineV2:
                       # step would have produced
                       "spec_rounds": 0, "spec_verifies": 0,
                       "spec_proposed": 0, "spec_accepted": 0,
-                      "spec_steps_saved": 0, "spec_accept_rate": 0.0}
+                      "spec_steps_saved": 0, "spec_accept_rate": 0.0,
+                      # KV-page migration (inference/migration.py):
+                      # disaggregated prefill/decode handoffs through
+                      # this engine's pool, both directions + payload
+                      "migrations_out": 0, "migrations_in": 0,
+                      "migration_bytes_out": 0, "migration_bytes_in": 0}
         # measure the host<->device readback latency ONCE instead of
         # guessing it (VERDICT r04 weak #4: a fixed 0.15s age gate meant
         # the opportunistic commit path never fired — every drain
@@ -2045,7 +2050,8 @@ class InferenceEngineV2:
             for uid, new in self._drain(drain_all=True).items():
                 self._spec_emit.setdefault(uid, []).extend(new)
         live = [s for s in self.state.seqs.values()
-                if not s.done and s.slot >= 0 and s.pending_tokens == 1
+                if not s.done and not s.frozen and s.slot >= 0
+                and s.pending_tokens == 1
                 and s.n_generated < s.max_new_tokens]
         if not live:
             return False
@@ -2422,6 +2428,15 @@ class InferenceEngineV2:
         without stalling the pipeline at all."""
         while self._inflight and self._uid_inflight(uid):
             self._drain(force=True)         # pops (at least) the oldest
+        seq = self.state.seqs.get(uid)
+        if seq is not None and seq.migrating == "out":
+            # flushing a pinned export = the abort path: unfreeze first,
+            # then the normal release below publishes/frees as usual
+            self.state.export_abort(uid)
+        elif seq is not None and seq.migrating == "in":
+            # a half-imported sequence has no committed content: hand the
+            # whole reservation back instead of releasing/publishing
+            self.state.abort_import(uid)
         if self._spec is not None:
             # spec rounds are atomic within a step() call, but a failed
             # verify dispatch may have been caught by a driver that then
@@ -2488,13 +2503,205 @@ class InferenceEngineV2:
         a structured reason instead of hanging a fleet shutdown on one
         wedged sequence). The engine stays usable either way."""
         t0 = time.perf_counter()
-        while any(not s.done for s in self.state.seqs.values()) \
+        # frozen (mid-migration) sequences are excluded: they schedule
+        # nothing by design, and their fate — export ack or abort — is
+        # the serving tier's call, not this loop's
+        while any(not s.done and not s.frozen
+                  for s in self.state.seqs.values()) \
                 or self._inflight:
             if deadline_s is not None \
                     and time.perf_counter() - t0 > deadline_s:
                 return False
             self.step()
         return True
+
+    # ------------------------------------------------------------------
+    # KV-page migration (inference/migration.py): disaggregated
+    # prefill/decode serving moves a sequence's computed KV between
+    # engine pools — host-bounce today (device pages -> host bytes ->
+    # peer pool), device-to-device later. Ownership/rollback rides
+    # StateManager's refcounted migration API; these wrappers add the
+    # device half: reading the page extents out and scattering them in.
+    # ------------------------------------------------------------------
+    def can_import(self, n_tokens: int, remaining_gen: int) -> bool:
+        """Would ``import_reserve`` succeed right now? (The serving
+        replica's admission check before it acks a migration begin.)"""
+        if self._ring_tokens:
+            return False
+        return self.state.can_admit(n_tokens, remaining_gen)
+
+    def export_migration(self, uid: int, trace_id: str = "",
+                         tenant: str = "default") -> "PageBundle":
+        """Snapshot a live sequence into a :class:`PageBundle`: drain the
+        async pipeline up to the last step referencing this uid (the
+        committed view then IS the pool content), pin it via
+        ``StateManager.migrate_out``, and read its page extents to host.
+        The sequence stays frozen — pages bit-stable — until
+        ``export_commit`` (importer acked) or ``export_abort``."""
+        from .migration import PageBundle
+        from .prefix_cache import chain_hashes
+
+        if self._ring_tokens:
+            raise RuntimeError(
+                "page migration requires linear block tables "
+                "(rolling-ring mode reuses page slots in place)")
+        while self._inflight and self._uid_inflight(uid):
+            self._drain(force=True)
+        snap = self.state.migrate_out(uid, trace=trace_id or None)
+        bs = self.config.block_size
+        n_full = len(snap["page_blocks"])
+        with self._telem.span("migrate_out", pages=n_full):
+            if n_full:
+                # one device gather + one transfer for every full page
+                pages_h = np.asarray(self.kv_pool[:, :, :, np.asarray(
+                    snap["page_blocks"], np.int32)])
+            tail = None
+            if snap["tail_rows"]:
+                tail = np.asarray(
+                    self.kv_pool[:, :, :, snap["tail_block"],
+                                 :snap["tail_rows"]]).tobytes()
+        page_blobs = [pages_h[:, :, :, j].tobytes() for j in range(n_full)]
+        m = self.mcfg
+        page_bytes = (m.num_layers * 2 * m.kv_heads * bs * m.head_dim
+                      * np.dtype(self._kv_dtype).itemsize)
+        bundle = PageBundle(
+            trace_id=trace_id,
+            tokens=snap["tokens"],
+            prompt_len=len(snap["tokens"]) - snap["n_generated"],
+            n_computed=snap["n_computed"],
+            n_generated=snap["n_generated"],
+            max_new_tokens=snap["max_new_tokens"],
+            eos_id=snap["eos_id"], tenant=tenant,
+            block_size=bs,
+            kv_dtype=np.dtype(self._kv_dtype).name,
+            page_bytes=page_bytes,
+            tail_rows=snap["tail_rows"],
+            tail_bytes=len(tail or b""),
+            # the engine's fp8-KV pool is scale-free e4m3 (no side-car
+            # scale arrays); pools that carry them ship them here
+            chain=chain_hashes(snap["tokens"][:n_full * bs], bs),
+            scales=None,
+            pages=page_blobs, tail=tail)
+        bundle.validate()
+        self.stats["migrations_out"] += 1
+        self.stats["migration_bytes_out"] += bundle.payload_bytes
+        return bundle
+
+    def export_commit(self, uid: int) -> list[int]:
+        """The importer acked: the stream lives there now. Unpin, mark
+        done, and flush — release publishes the computed pages into the
+        LOCAL trie, so this replica keeps serving the prefix from cache.
+        Returns the tokens generated here (the committed stream prefix)."""
+        self.state.export_ack(uid)
+        return self.flush(uid)
+
+    def export_abort(self, uid: int) -> None:
+        """Transfer failed/refused: unpin. The sequence is decode-ready
+        again and resumes locally exactly where it stopped."""
+        self.state.export_abort(uid)
+
+    def _import_page_fn(self):
+        """One-page pool scatter, compiled once: (pool, block, page) ->
+        pool with that block replaced. Donated + layout-pinned like the
+        step programs, so an import never copies the pool."""
+        if getattr(self, "_import_page_jit", None) is None:
+            self._import_page_jit = jax.jit(
+                lambda pool, idx, page: pool.at[:, :, :, idx].set(page),
+                donate_argnums=(0,),
+                in_shardings=(self._pool_format, None, None),
+                out_shardings=self._pool_format)
+        return self._import_page_jit
+
+    def import_reserve(self, uid: int, meta: dict) -> None:
+        """Claim capacity for an arriving bundle BEFORE its first payload
+        byte: slot + full remaining block budget, sequence frozen until
+        ``import_complete``. Raises (refusing the migration) on any
+        geometry/dtype mismatch — a host-bounce between pools of
+        different page layouts would corrupt KV silently."""
+        from .migration import MigrationError, PageBundle
+
+        shell = PageBundle.from_meta(meta)
+        if self._ring_tokens:
+            raise MigrationError("rolling-ring pools cannot import "
+                                 "page chains")
+        if shell.block_size != self.config.block_size:
+            raise MigrationError(
+                f"block_size mismatch: bundle {shell.block_size}, "
+                f"pool {self.config.block_size}")
+        if shell.kv_dtype != np.dtype(self._kv_dtype).name:
+            raise MigrationError(
+                f"kv dtype mismatch: bundle {shell.kv_dtype}, pool "
+                f"{np.dtype(self._kv_dtype).name}")
+        m = self.mcfg
+        want = (m.num_layers * 2 * m.kv_heads * self.config.block_size
+                * m.head_dim * np.dtype(self._kv_dtype).itemsize)
+        if shell.page_bytes != want:
+            raise MigrationError(
+                f"page geometry mismatch: bundle pages are "
+                f"{shell.page_bytes}B, this pool's are {want}B")
+        if self._rt.enabled:
+            self._rt.begin(uid, tenant=shell.tenant,
+                           prompt=shell.prompt_len)
+        try:
+            self.state.migrate_in_begin(
+                uid, shell.tokens, shell.n_computed, shell.n_generated,
+                shell.max_new_tokens, eos_id=shell.eos_id,
+                trace=shell.trace_id or None)
+        except Exception:
+            self._rt.drop(uid)
+            raise
+        # the stream prefix generated on the exporter: flush() returns
+        # prior + locally-generated, the full authoritative stream
+        self._results[uid] = list(
+            shell.tokens[shell.prompt_len:])
+
+    def import_complete(self, uid: int, bundle: "PageBundle") -> None:
+        """Payload landed: scatter the page extents into the pool and
+        commit — the full pages seed the local prefix trie (the
+        cross-replica radix cache leg) and the sequence unfreezes
+        decode-ready. The resume step is a plain decode of the last
+        token: nothing is recomputed, so a greedy stream continues
+        bit-identically."""
+        bundle.validate()
+        seq = self.state.seqs[uid]
+        bs = self.config.block_size
+        m = self.mcfg
+        page_shape = (m.num_layers, 2, m.kv_heads, bs, m.head_dim)
+        dt = np.dtype(self._kv_dtype)
+        fn = self._import_page_fn()
+        with self._telem.span("migrate_in", pages=bundle.n_full):
+            for j in range(bundle.n_full):
+                page = np.frombuffer(bundle.pages[j],
+                                     dtype=dt).reshape(page_shape)
+                self.kv_pool = fn(self.kv_pool,
+                                  np.int32(seq.blocks[j]), page)
+            if bundle.tail_rows:
+                rows = np.frombuffer(bundle.tail, dtype=dt).reshape(
+                    (m.num_layers, 2, m.kv_heads, bundle.tail_rows,
+                     m.head_dim))
+                page = np.zeros(page_shape, dt)
+                page[:, :, :, :bundle.tail_rows] = rows
+                self.kv_pool = fn(
+                    self.kv_pool, np.int32(seq.blocks[bundle.n_full]),
+                    page)
+        self.state.import_commit(uid)
+        if self._spec is not None:
+            # the proposer sees the full imported history as its
+            # "prompt"; a refused mirror admit just means root-only trees
+            self._spec.admit(uid, list(seq.tokens),
+                             seq.max_new_tokens - seq.n_generated
+                             + self._spec_tracker.base_depth + 1)
+        self.stats["migrations_in"] += 1
+        self.stats["migration_bytes_in"] += bundle.payload_bytes
+        if self._telem.enabled:
+            self._admit_t[uid] = time.perf_counter()
+
+    def import_abort(self, uid: int) -> None:
+        """Transfer died before commit: free the reservation; the trie
+        was never touched, nothing leaks."""
+        self.state.abort_import(uid)
+        self._results.pop(uid, None)
+        self._rt.drop(uid)
 
     def _record_dispatch_telemetry(self, kind: str, useful: int,
                                    budget: int, uids) -> None:

@@ -1,0 +1,285 @@
+"""KV-page migration: serializable page bundles for disaggregated serving.
+
+Splitwise (ISCA'24) and DistServe (OSDI'24) split prefill and decode onto
+separate pools and ship the prompt's KV cache between them. This module is
+the transfer half of that primitive for the paged pool: a sequence's
+computed KV — page-aligned full pages plus the partial tail extent — and
+the metadata needed to resume it elsewhere (token chain, computed/generated
+counters, prefix-cache chain hashes, quant-scale sidecar) packed into a
+:class:`PageBundle` that serializes over the line-JSON serving protocol.
+
+Ownership and rollback live in :class:`~.ragged.StateManager`'s refcounted
+migration API (``migrate_out`` / ``export_ack`` / ``export_abort`` /
+``migrate_in_begin`` / ``import_commit`` / ``abort_import`` — the AST lint
+``bin/check_state_invariants.py`` pins every page-ownership mutation to
+it). This module owns only the WIRE form:
+
+- :func:`iter_chunks` slices a bundle's payload into bounded
+  self-describing chunks (page index, intra-page offset, crc32) so the
+  transfer rides the existing deadline-bounded ``LineChannel`` protocol
+  one small message at a time — resumable per-chunk: a receiver that
+  observes a gap after EOF names the missing chunk ids and the sender
+  (the router, which buffers the bundle) resends exactly those.
+- :class:`BundleAssembler` is the receive side: collects chunks in any
+  order, verifies each crc, reports gaps, and reassembles the payload.
+
+Transport today is host-bounce (device pages -> host bytes -> peer pool);
+the bundle layout is deliberately transport-agnostic so a device-to-device
+path can replace the byte payload without touching the ownership story.
+"""
+from __future__ import annotations
+
+import base64
+import hashlib
+import struct
+import zlib
+from dataclasses import dataclass, field
+
+from .prefix_cache import chain_hashes
+
+#: default max raw payload bytes per wire chunk: small enough that one
+#: chunk never monopolizes a poll tick or a pipe buffer, large enough
+#: that a typical page is one chunk
+CHUNK_BYTES = 256 * 1024
+
+
+class MigrationError(RuntimeError):
+    """A bundle failed validation (bad crc, gap, meta mismatch)."""
+
+
+@dataclass
+class PageBundle:
+    """One sequence's migratable state: metadata + per-page KV payload.
+
+    ``pages[j]`` holds page ``j`` of ``tokens`` (``block_size`` tokens of
+    KV, serialized); ``tail`` holds the partial extent ``tail_rows``
+    tokens of KV past the last full page — together exactly the
+    ``n_computed`` committed-KV tokens, so the importer resumes with a
+    plain decode step (bit-identical continuation; nothing is
+    recomputed). ``chain`` carries the prefix-cache chain hashes of the
+    full pages: the importer seeds its radix trie with them
+    (cross-replica radix cache) and the router places the bundle on the
+    replica already holding the deepest chain."""
+    trace_id: str
+    tokens: list[int]
+    prompt_len: int
+    n_computed: int
+    n_generated: int
+    max_new_tokens: int
+    eos_id: int | None
+    tenant: str
+    block_size: int
+    kv_dtype: str                       # pool dtype name; "toy" = synthetic
+    page_bytes: int                     # serialized size of one full page
+    tail_rows: int
+    tail_bytes: int
+    chain: list[int] = field(default_factory=list)
+    #: per-page quant-scale sidecar. The engine's fp8-KV pool is
+    #: scale-free (e4m3 covers K/V activations), so this is None there;
+    #: pools that carry side-car scales ship them here, one blob per page.
+    scales: list[str] | None = None
+    pages: list[bytes] = field(default_factory=list)
+    tail: bytes | None = None
+
+    @property
+    def n_full(self) -> int:
+        return self.n_computed // self.block_size
+
+    @property
+    def payload_bytes(self) -> int:
+        return sum(len(p) for p in self.pages) + len(self.tail or b"")
+
+    def validate(self) -> None:
+        if not self.tokens:
+            raise MigrationError("empty token chain")
+        if not 0 <= self.n_computed <= len(self.tokens) - 1:
+            raise MigrationError(
+                f"n_computed {self.n_computed} outside "
+                f"[0, {len(self.tokens) - 1}]")
+        if self.n_generated != len(self.tokens) - self.prompt_len:
+            raise MigrationError(
+                f"token chain of {len(self.tokens)} disagrees with "
+                f"prompt {self.prompt_len} + generated {self.n_generated}")
+        if len(self.pages) != self.n_full:
+            raise MigrationError(f"{len(self.pages)} pages for "
+                                 f"{self.n_full} full-page extents")
+        if any(len(p) != self.page_bytes for p in self.pages):
+            raise MigrationError("page payload size drift")
+        if self.tail_rows and (self.tail is None
+                               or len(self.tail) != self.tail_bytes):
+            raise MigrationError("partial tail extent missing or torn")
+        want = chain_hashes(self.tokens[:self.n_full * self.block_size],
+                            self.block_size)
+        if self.chain != want:
+            raise MigrationError("chain hashes disagree with the token "
+                                 "chain (corrupt meta)")
+
+    # -- wire form --------------------------------------------------------
+    def meta(self) -> dict:
+        """The payload-free wire header (rides the handoff message)."""
+        return {"id": self.trace_id, "tok": list(self.tokens),
+                "plen": self.prompt_len, "nc": self.n_computed,
+                "ng": self.n_generated, "max_new": self.max_new_tokens,
+                "eos": self.eos_id, "tenant": self.tenant,
+                "bs": self.block_size, "dtype": self.kv_dtype,
+                "page_bytes": self.page_bytes,
+                "tail_rows": self.tail_rows, "tail_bytes": self.tail_bytes,
+                "chain": list(self.chain), "scales": self.scales}
+
+    @classmethod
+    def from_meta(cls, meta: dict) -> "PageBundle":
+        """Payload-less shell from a wire header (the receive side fills
+        pages/tail via :class:`BundleAssembler`)."""
+        return cls(trace_id=str(meta["id"]),
+                   tokens=[int(t) for t in meta["tok"]],
+                   prompt_len=int(meta["plen"]),
+                   n_computed=int(meta["nc"]),
+                   n_generated=int(meta["ng"]),
+                   max_new_tokens=int(meta["max_new"]),
+                   eos_id=meta.get("eos"),
+                   tenant=str(meta.get("tenant", "default")),
+                   block_size=int(meta["bs"]),
+                   kv_dtype=str(meta["dtype"]),
+                   page_bytes=int(meta["page_bytes"]),
+                   tail_rows=int(meta["tail_rows"]),
+                   tail_bytes=int(meta["tail_bytes"]),
+                   chain=[int(h) for h in meta["chain"]],
+                   scales=meta.get("scales"))
+
+
+def iter_chunks(bundle: PageBundle,
+                max_bytes: int = CHUNK_BYTES) -> list[dict]:
+    """Slice a bundle's payload into self-describing wire chunks:
+    ``{"i": chunk id, "p": page index (-1 = tail), "o": offset within the
+    page, "n": raw bytes, "crc": crc32, "data": base64}``. Chunk ids are
+    dense ``0..len-1`` — the EOF message carries the count and a receiver
+    names gaps by id."""
+    out: list[dict] = []
+    payloads = [(j, p) for j, p in enumerate(bundle.pages)]
+    if bundle.tail:
+        payloads.append((-1, bundle.tail))
+    i = 0
+    for p, blob in payloads:
+        for o in range(0, len(blob), max_bytes):
+            raw = blob[o:o + max_bytes]
+            out.append({"i": i, "p": p, "o": o, "n": len(raw),
+                        "crc": zlib.crc32(raw),
+                        "data": base64.b64encode(raw).decode("ascii")})
+            i += 1
+    return out
+
+
+class BundleAssembler:
+    """Receive side of a chunked bundle transfer: collects chunks in any
+    order, rejects corrupt ones (crc), names gaps after EOF, reassembles.
+    Duplicate deliveries are idempotent (a resend after a ``mig_need``
+    may race the original)."""
+
+    def __init__(self, meta: dict):
+        self.bundle = PageBundle.from_meta(meta)
+        self._parts: dict[int, tuple[int, int, bytes]] = {}
+        self.total: int | None = None
+        self.bytes_received = 0
+
+    def add(self, msg: dict) -> None:
+        raw = base64.b64decode(msg["data"])
+        if len(raw) != int(msg["n"]) or zlib.crc32(raw) != int(msg["crc"]):
+            raise MigrationError(
+                f"chunk {msg.get('i')} failed its crc — torn transfer")
+        i = int(msg["i"])
+        if i not in self._parts:
+            self.bytes_received += len(raw)
+        self._parts[i] = (int(msg["p"]), int(msg["o"]), raw)
+
+    def eof(self, total: int) -> None:
+        self.total = int(total)
+
+    def missing(self) -> list[int]:
+        """Chunk ids not yet received (valid after :meth:`eof`)."""
+        if self.total is None:
+            raise MigrationError("missing() before eof")
+        return sorted(set(range(self.total)) - set(self._parts))
+
+    def assemble(self) -> PageBundle:
+        """Reassemble and validate; raises :class:`MigrationError` on any
+        gap, size drift, or chain mismatch."""
+        if self.total is None or self.missing():
+            raise MigrationError(f"assemble with gaps: {self.missing()}")
+        b = self.bundle
+        pages: dict[int, list[tuple[int, bytes]]] = {}
+        for p, o, raw in self._parts.values():
+            pages.setdefault(p, []).append((o, raw))
+        for p in pages:
+            pages[p] = b"".join(r for _, r in sorted(pages[p]))
+        b.pages = [pages.get(j, b"") for j in range(b.n_full)]
+        b.tail = pages.get(-1) if b.tail_rows else None
+        b.validate()
+        return b
+
+
+# -- toy payloads ----------------------------------------------------------
+# The serving tier's toy backend (serving/replica.py) has no device pool;
+# its "KV pages" are deterministic bytes derived from the page's chain
+# hash, so the multi-process chaos/bit-identity suite exercises the real
+# chunking/crc/resume/abort machinery — and an importer VERIFIES payload
+# integrity — in tier-1 seconds.
+
+TOY_PAGE_BYTES = 48
+
+
+def toy_page_payload(chain_hash: int,
+                     page_bytes: int = TOY_PAGE_BYTES) -> bytes:
+    h = hashlib.blake2b(struct.pack("<Q", chain_hash & (1 << 64) - 1),
+                        digest_size=16)
+    blob = h.digest()
+    return (blob * (-(-page_bytes // len(blob))))[:page_bytes]
+
+
+def toy_tail_payload(prefix_hash: int, tail_tokens) -> bytes:
+    h = hashlib.blake2b(struct.pack("<Q", prefix_hash & (1 << 64) - 1),
+                        digest_size=16)
+    for t in tail_tokens:
+        h.update(struct.pack("<q", int(t)))
+    return h.digest()
+
+
+def toy_bundle(trace_id: str, prompt: list[int], generated: list[int],
+               max_new_tokens: int, eos_id: int | None, tenant: str,
+               block_size: int) -> PageBundle:
+    """Build the toy backend's synthetic-but-verifiable bundle: payloads
+    are pure functions of the chain, so the importer re-derives and
+    compares them (transfer-integrity oracle)."""
+    tokens = list(prompt) + list(generated)
+    n_computed = len(tokens) - 1
+    n_full = n_computed // block_size
+    chain = chain_hashes(tokens[:n_full * block_size], block_size)
+    tail_rows = n_computed - n_full * block_size
+    tail = toy_tail_payload(chain[-1] if chain else 0,
+                            tokens[n_full * block_size:n_computed]) \
+        if tail_rows else None
+    return PageBundle(
+        trace_id=trace_id, tokens=tokens, prompt_len=len(prompt),
+        n_computed=n_computed, n_generated=len(generated),
+        max_new_tokens=max_new_tokens, eos_id=eos_id, tenant=tenant,
+        block_size=block_size, kv_dtype="toy",
+        page_bytes=TOY_PAGE_BYTES, tail_rows=tail_rows,
+        tail_bytes=len(tail or b""),
+        chain=chain, scales=None,
+        pages=[toy_page_payload(h) for h in chain], tail=tail)
+
+
+def toy_verify(bundle: PageBundle) -> None:
+    """The toy importer's integrity oracle: every payload must equal the
+    chain-derived expectation (what checksumming the real KV bytes proves
+    for the engine path)."""
+    bundle.validate()
+    for j, h in enumerate(bundle.chain):
+        if bundle.pages[j] != toy_page_payload(h, bundle.page_bytes):
+            raise MigrationError(f"toy page {j} payload corrupt")
+    if bundle.tail_rows:
+        want = toy_tail_payload(
+            bundle.chain[-1] if bundle.chain else 0,
+            bundle.tokens[bundle.n_full * bundle.block_size:
+                          bundle.n_computed])
+        if bundle.tail != want:
+            raise MigrationError("toy tail payload corrupt")

@@ -282,6 +282,50 @@ class PrefixCache:
         to_free.extend(blocks[n_full:])
         return to_free
 
+    def adopt(self, tokens, blocks: list[int],
+              n_tokens: int) -> tuple[list[PageNode], list[int]]:
+        """Insert-and-pin a migrated-in page chain (KV-page migration,
+        inference/migration.py): every full page of ``tokens[:n_tokens]``
+        becomes a trie node holding the caller's block — unless an
+        identical chain page is already cached, in which case the
+        caller's freshly-written copy is surrendered and the existing
+        node serves (identical content by construction: same token chain,
+        same weights). The whole chain is ACQUIRED for the importing
+        sequence before returning, so an allocation elsewhere can never
+        evict a page between insert and pin. Returns ``(chain nodes,
+        surrendered duplicate blocks)``; the caller (StateManager
+        ``import_commit`` — the only legal caller, see
+        bin/check_state_invariants.py) points the sequence's table front
+        at the nodes and frees the duplicates."""
+        bs = self.block_size
+        n_full = min(n_tokens, len(tokens)) // bs
+        if n_full > len(blocks):
+            raise ValueError(f"{n_full} imported pages but only "
+                             f"{len(blocks)} blocks")
+        self._clock += 1
+        node = self.root
+        out: list[PageNode] = []
+        to_free: list[int] = []
+        for j in range(n_full):
+            key = tuple(tokens[j * bs:(j + 1) * bs])
+            child = node.children.get(key)
+            if child is not None:
+                to_free.append(blocks[j])
+                self.deduped_pages += 1
+            else:
+                child = PageNode(key=key, block=blocks[j], parent=node,
+                                 chain_hash=page_hash(node.chain_hash,
+                                                      key))
+                node.children[key] = child
+                self._n_nodes += 1
+                self.inserted_pages += 1
+                self.version += 1
+            child.refs += 1
+            child.last_used = self._clock
+            out.append(child)
+            node = child
+        return out, to_free
+
     # -- eviction ---------------------------------------------------------
     def evict(self, n: int) -> list[int]:
         """Reclaim up to ``n`` blocks, least-recently-used first, leaf-

@@ -88,6 +88,23 @@ class SequenceDescriptor:
     #: ``commit_speculative`` / ``rollback_provisional`` / ``rewind``) may
     #: mutate this — bin/check_state_invariants.py enforces it.
     n_provisional: int = 0
+    #: KV-page migration (migration.py): None = not migrating; "out" = an
+    #: exported page bundle is in flight to another pool (pages PINNED —
+    #: the scheduler must not write them and release is refused until the
+    #: importer acks or the export aborts); "in" = the sequence was
+    #: created by ``migrate_in_begin`` and its pages are still being
+    #: filled (not schedulable until ``import_commit``). Only the
+    #: refcounted migration API (``migrate_out`` / ``export_ack`` /
+    #: ``export_abort`` / ``migrate_in_begin`` / ``import_commit`` /
+    #: ``abort_import``) may mutate this — bin/check_state_invariants.py
+    #: enforces it.
+    migrating: str | None = None
+
+    @property
+    def frozen(self) -> bool:
+        """True while a migration pins this sequence: its pages must stay
+        bit-stable (out) or are still arriving (in) — never schedulable."""
+        return self.migrating is not None
 
     @property
     def pending_tokens(self) -> int:
@@ -120,9 +137,11 @@ class SequenceDescriptor:
 
     @property
     def sched_done(self) -> bool:
-        """Nothing left to dispatch (committed-done OR budget fully
-        in flight)."""
-        return self.done or self.gen_remaining_sched <= 0
+        """Nothing left to dispatch (committed-done, budget fully in
+        flight, OR frozen by an in-flight page migration — every plan
+        builder gates on this, so freezing here freezes the sequence out
+        of prefill steps, decode plans, windows and spec rounds alike)."""
+        return self.done or self.frozen or self.gen_remaining_sched <= 0
 
     def commit_generated(self, new_tokens: list[int],
                          n_computed: int) -> list[int]:
@@ -193,6 +212,9 @@ class StateManager:
         # pages the last _alloc call reclaimed from the prefix LRU (admit
         # folds this into its lifecycle event for attribution)
         self._last_evicted = 0
+        # serving-tier trace IDs of in-flight imports (uid -> trace),
+        # emitted on the migrate_in lifecycle event at import_commit
+        self._mig_trace: dict[int, str | None] = {}
 
     def attach_prefix_cache(self, cache) -> None:
         """Enable shared-prefix serving (engine init, linear tables only —
@@ -313,7 +335,18 @@ class StateManager:
         full pages whose KV is COMPUTED are published into the trie
         (blocks donated, dedup'd against concurrent publishers) instead of
         freed; shared pages drop their refcount. Callers (engine flush)
-        must have drained in-flight steps referencing this uid first."""
+        must have drained in-flight steps referencing this uid first.
+
+        Refused while a migration pins the sequence: an exported bundle's
+        pages must stay bit-stable until the importer acks
+        (``export_ack`` / ``export_abort`` first), and a half-imported
+        sequence owns pages with no committed content
+        (``abort_import``)."""
+        if self.seqs[uid].frozen:
+            raise RuntimeError(
+                f"uid {uid} is pinned by an in-flight migration "
+                f"({self.seqs[uid].migrating!r}): settle it via "
+                f"export_ack/export_abort/abort_import before release")
         seq = self.seqs.pop(uid)
         published = 0
         if self.prefix_cache is not None and seq.slot >= 0:
@@ -466,6 +499,198 @@ class StateManager:
             rt.event(uid, "rewind", to_len=len(tokens),
                      kept_kv=seq.n_computed)
 
+    # --- KV-page migration: the refcounted export/import/abort API -------
+    # Disaggregated prefill/decode serving (inference/migration.py,
+    # serving/disagg.py) moves a sequence's computed KV pages between
+    # pools. Ownership never changes hands mid-transfer: the exporter's
+    # pages stay owned by the (frozen) source sequence until the importer
+    # ACKS — ``sched_done`` freezes the sequence out of every plan
+    # builder, so page content is bit-stable for the whole transfer — and
+    # the importer's pages are ordinary owned blocks until
+    # ``import_commit`` seeds the prefix trie from them. An abort on
+    # either side is pure bookkeeping: unfreeze (source) or free the
+    # reservation (importer); no block is ever double-owned or leaked.
+    # These six methods are the ONLY legal mutators of ``migrating``
+    # (bin/check_state_invariants.py rejects any other site).
+
+    def migrate_out(self, uid: int, trace: str | None = None) -> dict:
+        """Pin a live sequence for export and return its page-chain
+        snapshot: token history, committed-KV extent, and the pool blocks
+        holding it (full pages + the partial tail extent). Callers
+        (engine) must have drained in-flight steps referencing this uid
+        first — the committed view IS the pool content then. The
+        sequence stays live and owns its pages; it is merely frozen until
+        ``export_ack`` (importer took over → release) or
+        ``export_abort`` (resume decoding locally). ``trace`` is the
+        serving-tier trace ID: both replicas' lifecycle events carry it,
+        so one request's export and import line up under one key."""
+        seq = self.seqs[uid]
+        if seq.frozen:
+            raise RuntimeError(f"uid {uid} is already migrating "
+                               f"({seq.migrating!r})")
+        if seq.done:
+            raise RuntimeError(f"uid {uid} is done: nothing to migrate")
+        if seq.n_provisional:
+            raise RuntimeError(
+                f"uid {uid} has a provisional speculative tree in flight "
+                f"— commit or roll it back before migrating")
+        if seq.n_inflight:
+            raise RuntimeError(
+                f"uid {uid} has {seq.n_inflight} sampled tokens in "
+                f"flight — drain the pipeline before migrating")
+        bs = self.block_size
+        if -(-(len(seq.tokens) + seq.max_new_tokens - seq.n_generated)
+             // bs) > self.max_blocks_per_seq:
+            # a wrap-capable sequence's rolling table reuses page slots in
+            # place — the linear page chain the bundle format commits to
+            # does not exist for it
+            raise RuntimeError(
+                f"uid {uid} can wrap its block table "
+                f"(rolling-ring regime): page migration requires linear "
+                f"tables")
+        n_full = seq.n_computed // bs
+        tail_rows = seq.n_computed - n_full * bs
+        seq.migrating = "out"
+        rt = self.reqtrace
+        if rt is not None and rt.enabled:
+            rt.event(uid, "migrate_out", pages=n_full, tail=tail_rows,
+                     tokens=len(seq.tokens), trace=trace)
+        return {
+            "uid": uid, "tokens": list(seq.tokens),
+            "n_computed": seq.n_computed,
+            "n_generated": seq.n_generated,
+            "max_new_tokens": seq.max_new_tokens,
+            "eos_id": seq.eos_id, "block_size": bs,
+            "page_blocks": list(seq.blocks[:n_full]),
+            "tail_block": seq.blocks[n_full] if tail_rows else None,
+            "tail_rows": tail_rows,
+        }
+
+    def export_ack(self, uid: int) -> None:
+        """The importer owns the stream now: unfreeze and mark the source
+        sequence done so the caller's normal flush path releases it
+        (publishing its computed pages into the LOCAL trie — the source
+        replica keeps serving the prefix from cache)."""
+        seq = self.seqs[uid]
+        if seq.migrating != "out":
+            raise RuntimeError(f"uid {uid} has no export in flight")
+        seq.migrating = None
+        seq.done = True
+
+    def export_abort(self, uid: int) -> None:
+        """Transfer failed or was refused: unfreeze. The sequence is
+        decode-ready again and resumes exactly where it stopped — no
+        block changed hands, nothing to roll back."""
+        seq = self.seqs[uid]
+        if seq.migrating != "out":
+            raise RuntimeError(f"uid {uid} has no export in flight")
+        seq.migrating = None
+
+    def migrate_in_begin(self, uid: int, tokens: list[int],
+                         n_computed: int, n_generated: int,
+                         max_new_tokens: int, eos_id: int | None = None,
+                         trace: str | None = None) -> SequenceDescriptor:
+        """Reserve a slot + the FULL remaining block budget for an
+        arriving sequence (capacity is claimed before the first payload
+        byte lands, so a concurrent admit can never strand a
+        half-transferred bundle). The sequence is created frozen
+        (``migrating="in"``): the caller writes the bundle's KV payload
+        into the returned descriptor's blocks, then ``import_commit``
+        seeds the prefix trie and unfreezes — or ``abort_import`` hands
+        every block back."""
+        if uid in self.seqs:
+            raise ValueError(f"uid {uid} already live")
+        if not tokens:
+            raise ValueError("empty token chain")
+        if not 0 <= n_computed <= len(tokens) - 1:
+            raise ValueError(
+                f"n_computed {n_computed} outside [0, {len(tokens) - 1}] "
+                f"(the last token is always recomputed)")
+        if n_generated > max_new_tokens:
+            raise ValueError(f"n_generated {n_generated} exceeds the "
+                             f"budget {max_new_tokens}")
+        if not self._free_slots:
+            raise RuntimeError("no free sequence slots")
+        bs = self.block_size
+        remaining = max_new_tokens - n_generated
+        if -(-(len(tokens) + remaining) // bs) > self.max_blocks_per_seq:
+            # mirrors admit: the imported chain must stay linear (and,
+            # with a prefix cache attached, must never wrap trie pages)
+            raise RuntimeError(
+                f"import of {len(tokens)} + {remaining} tokens would wrap "
+                f"the {self.max_blocks_per_seq} x {bs} block table")
+        seq = SequenceDescriptor(uid=uid, tokens=list(tokens),
+                                 max_new_tokens=max_new_tokens,
+                                 eos_id=eos_id,
+                                 slot=self._free_slots.pop(0))
+        try:
+            fresh = self._alloc(self._blocks_for(len(tokens) + remaining))
+        except RuntimeError:
+            self._free_slots.insert(0, seq.slot)
+            raise
+        seq.blocks = fresh
+        seq.n_computed = n_computed
+        seq.n_sched = n_computed
+        seq.n_generated = n_generated
+        seq.migrating = "in"
+        self._mig_trace[uid] = trace
+        self.seqs[uid] = seq
+        return seq
+
+    def import_commit(self, uid: int) -> None:
+        """Payload landed: seed the local prefix trie from the imported
+        full pages (the first leg of the distributed radix cache — the
+        pages become shared trie nodes this sequence references, and
+        every later same-prefix admit on this pool hits them) and
+        unfreeze. Duplicate pages another sequence already published
+        dedup: the freshly-written copy goes back to the allocator and
+        the table points at the cached block (identical content by
+        construction — same token chain, same weights)."""
+        seq = self.seqs[uid]
+        if seq.migrating != "in":
+            raise RuntimeError(f"uid {uid} has no import in flight")
+        bs = self.block_size
+        n_full = seq.n_computed // bs
+        if self.prefix_cache is not None and n_full > 0:
+            nodes, dups = self.prefix_cache.adopt(
+                seq.tokens, seq.blocks[:n_full], n_full * bs)
+            if len(nodes) != n_full:    # pragma: no cover — adopt contract
+                raise RuntimeError(
+                    f"uid {uid}: adopted {len(nodes)} trie pages, "
+                    f"expected {n_full}")
+            self._shared_nodes[uid] = nodes
+            seq.n_shared_blocks = n_full
+            seq.blocks = [n.block for n in nodes] + seq.blocks[n_full:]
+            seq.prefix_hit_tokens = 0     # imported, not served from cache
+            if dups:
+                self.allocator.free(dups)
+        seq.migrating = None
+        rt = self.reqtrace
+        if rt is not None and rt.enabled:
+            rt.event(uid, "migrate_in", pages=n_full,
+                     tokens=len(seq.tokens), shared=seq.n_shared_blocks,
+                     trace=self._mig_trace.pop(uid, None))
+        else:
+            self._mig_trace.pop(uid, None)
+
+    def abort_import(self, uid: int) -> None:
+        """Transfer died before commit: free the whole reservation and
+        the slot. The trie was never touched (seeding happens at commit),
+        so this cannot leak or double-own a block."""
+        seq = self.seqs.get(uid)
+        if seq is None:
+            return
+        if seq.migrating != "in":
+            raise RuntimeError(f"uid {uid} has no import in flight")
+        self.seqs.pop(uid)
+        self._mig_trace.pop(uid, None)
+        if seq.blocks:
+            self.allocator.free(seq.blocks)
+        seq.blocks = []
+        if seq.slot >= 0:
+            self._free_slots.append(seq.slot)
+            self._free_slots.sort()
+
     def audit(self) -> None:
         """Debug-mode FULL-POOL audit: every non-trash block is owned by
         exactly one of {free list, prefix trie, one sequence's owned
@@ -487,6 +712,20 @@ class StateManager:
                 owners[b] = "trie"
         ref_counts: dict[int, int] = {}
         for uid, seq in self.seqs.items():
+            if seq.migrating not in (None, "out", "in"):
+                raise AssertionError(
+                    f"uid {uid}: bad migration state {seq.migrating!r}")
+            if seq.migrating == "in" and seq.n_shared_blocks:
+                raise AssertionError(
+                    f"uid {uid}: importing sequence already shares "
+                    f"{seq.n_shared_blocks} trie pages (seeding must "
+                    f"happen at import_commit)")
+            if seq.migrating == "out" and (seq.n_inflight
+                                           or seq.n_provisional):
+                raise AssertionError(
+                    f"uid {uid}: exported sequence has in-flight work "
+                    f"(inflight {seq.n_inflight}, provisional "
+                    f"{seq.n_provisional}) — pages are not bit-stable")
             if seq.n_provisional < 0:
                 raise AssertionError(
                     f"uid {uid}: negative provisional count "

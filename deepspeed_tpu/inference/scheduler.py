@@ -217,9 +217,15 @@ class SplitFuseScheduler:
         live sequences, backlog (prompt tokens not yet scheduled + decode
         budget remaining), and the prefill/decode pending split. Host-only
         dict ops — cheap enough for a sub-second heartbeat cadence."""
-        live = queued = pending_tokens = 0
+        live = queued = pending_tokens = migrating = 0
         for seq in self.state.seqs.values():
             live += 1
+            if seq.frozen:
+                # a migration pins this sequence (pages bit-stable or
+                # still arriving): it holds capacity but schedules
+                # nothing — the router's disagg placement reads this
+                migrating += 1
+                continue
             if seq.sched_done:
                 continue
             queued += 1
@@ -229,6 +235,7 @@ class SplitFuseScheduler:
         has_prefill, has_decode = self.pending_kinds()
         return {"live": live, "queued": queued,
                 "pending_tokens": pending_tokens,
+                "migrating": migrating,
                 "pending_prefill": has_prefill,
                 "pending_decode": has_decode}
 

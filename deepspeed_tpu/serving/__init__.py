@@ -1,30 +1,39 @@
 """Resilient multi-replica serving tier.
 
-A stateless router (router.py) fronts N ``engine_v2`` replica worker
-processes (replica.py) over a newline-JSON pipe protocol (protocol.py)
-with a deadline on every wait. Placement is prefix-cache-aware
+A stateless router (router.py) fronts N ``engine_v2`` replica workers
+(replica.py) over a newline-JSON protocol (protocol.py) with a deadline
+on every wait — local stdio pipes by default, TCP/unix sockets for
+remote replicas (transport.py). Placement is prefix-cache-aware
 (placement.py: chain-hash the prompt's page-aligned prefix, prefer the
 replica whose residency digest holds the longest chain); the fleet layer
 (fleet.py) supervises replica processes with heartbeat liveness,
 exponential-backoff restarts and a crash-loop circuit breaker; failed or
 wedged replicas' in-flight requests are replayed onto survivors and
 dedup'd by trace ID + attempt nonce so results commit exactly once.
-workload.py generates the seeded multi-tenant traces the bench and chaos
-suites replay.
+Replicas take roles (disagg.py): prefill-role replicas run prompts and
+hand each sequence's KV pages off to a decode-capable replica through
+the router (chunked, resumable, pinned-until-ack — the KV-page migration
+primitive in inference/migration.py), and per-role autoscale hint gauges
+ride the router's existing load signals. workload.py generates the
+seeded multi-tenant traces the bench and chaos suites replay.
 
-See README.md "Serving fleet" for topology, knobs, and the
-"a replica died" runbook.
+See README.md "Serving fleet" / "Disaggregated serving" for topology,
+knobs, and runbooks.
 """
+from .disagg import MigrationState, ROLES, ScaleAdvisor
 from .fleet import Fleet, FleetConfig
 from .placement import StickyMap, chain_hashes, match_pages, pick_replica
 from .protocol import (ChannelClosed, ChannelTimeout, LineChannel,
                        RequestRecord, poll_channels)
 from .router import AdmissionError, Router, RouterConfig
+from .transport import SocketChannel, SocketListener, connect_channel
 from .workload import TraceConfig, synth_trace
 
 __all__ = [
     "AdmissionError", "ChannelClosed", "ChannelTimeout", "Fleet",
-    "FleetConfig", "LineChannel", "RequestRecord", "Router",
-    "RouterConfig", "StickyMap", "TraceConfig", "chain_hashes",
-    "match_pages", "pick_replica", "poll_channels", "synth_trace",
+    "FleetConfig", "LineChannel", "MigrationState", "ROLES",
+    "RequestRecord", "Router", "RouterConfig", "ScaleAdvisor",
+    "SocketChannel", "SocketListener", "StickyMap", "TraceConfig",
+    "chain_hashes", "connect_channel", "match_pages", "pick_replica",
+    "poll_channels", "synth_trace",
 ]

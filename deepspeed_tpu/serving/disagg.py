@@ -1,0 +1,177 @@
+"""Disaggregated prefill/decode serving: roles, handoffs, scale hints.
+
+Prefill and decode have opposite roofline profiles (compute-bound vs
+HBM-bound — bench ``device_probe``/``time_split`` shows it on this very
+engine), so production systems split them onto separate pools and ship
+the KV cache across (Splitwise ISCA'24, DistServe OSDI'24). This module
+is the serving-tier half of that split over the KV-page migration
+primitive (``inference/migration.py``):
+
+- **roles**: every replica slot is ``prefill``, ``decode`` or ``mixed``
+  (the default — today's behavior). The router places new prompts on
+  prefill-capable replicas; a prefill-role replica runs the prompt and
+  the first sampled token, then freezes the sequence and emits a
+  **handoff**: bundle metadata + chunked page payload, streamed to the
+  router over the same deadline-bounded line-JSON protocol as tokens.
+- **the router relays**: it buffers the bundle (it already holds every
+  request as a replayable record — the bundle is just more of the same),
+  picks a decode-capable target by residency digest against the bundle's
+  chain hashes (the same cache-aware placement admission uses), and
+  streams the chunks on. The transfer is resumable per-chunk: the
+  importer names gaps after EOF (``mig_need``) and the router resends
+  exactly those from its buffer.
+- **pinned-until-ack**: the source keeps the pages frozen until the
+  importer's ``mig_ack`` comes back through the router. A decode-replica
+  death mid-migration falls back to PR-8 retry-with-replay on a
+  survivor; a source death after the ack costs nothing (the stream
+  already lives on the target). If no decode-capable replica is ready,
+  the router sends ``mig_resume`` and the source simply keeps decoding —
+  role-split degrades to mixed instead of failing requests.
+
+:class:`ScaleAdvisor` closes the loop operationally: per-role
+scale-up/down **hints** (gauges only, no actuator) derived from the
+router's queue-wait estimate and the per-role replica load summaries.
+"""
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+
+ROLE_PREFILL, ROLE_DECODE, ROLE_MIXED = "prefill", "decode", "mixed"
+ROLES = (ROLE_PREFILL, ROLE_DECODE, ROLE_MIXED)
+#: roles that may take fresh prompts / that may take migrated-in decodes
+PREFILL_CAPABLE = (ROLE_PREFILL, ROLE_MIXED)
+DECODE_CAPABLE = (ROLE_DECODE, ROLE_MIXED)
+
+
+@dataclass
+class MigrationState:
+    """Router-side bookkeeping for one in-flight handoff. The router
+    buffers the source's chunks verbatim (re-tagged with the target's
+    attempt nonce on relay), which is what makes the target leg
+    resumable — and a target failure cheap to retry."""
+    meta: dict
+    src_slot: int
+    src_epoch: int
+    started_t: float
+    #: chunk id -> wire message (as received from the source)
+    chunks: dict[int, dict] = field(default_factory=dict)
+    total: int | None = None
+    #: "recv" (source -> router) | "xfer" (router -> target, awaiting ack)
+    phase: str = "recv"
+    tgt_slot: int = -1
+    resends: int = 0
+    payload_bytes: int = 0
+
+    def add_chunk(self, msg: dict) -> None:
+        i = int(msg["i"])
+        if i not in self.chunks:
+            self.payload_bytes += int(msg.get("n", 0))
+        self.chunks[i] = msg
+
+    @property
+    def complete(self) -> bool:
+        return self.total is not None and len(self.chunks) >= self.total \
+            and all(i in self.chunks for i in range(self.total))
+
+    def missing(self) -> list[int]:
+        if self.total is None:
+            return []
+        return sorted(set(range(self.total)) - set(self.chunks))
+
+
+def role_of(handle) -> str:
+    """A replica handle's role, defaulting to mixed (pre-role configs)."""
+    return getattr(handle, "role", None) or ROLE_MIXED
+
+
+class ScaleAdvisor:
+    """Per-role autoscale **hints** from signals the router already has:
+    the queue-wait estimator (backlog tokens over the observed commit
+    rate) and per-role replica load summaries. Pure signal — gauges named
+    ``serving_router_scale_hint{role,direction}`` flip to 1 when the
+    condition holds; nothing in-process acts on them.
+
+    - **scale-up (prefill)**: estimated queue wait breaches the TTFT SLO
+      headroom (new prompts queue at prefill-capable replicas), or
+      requests are queued with zero ready prefill-capable slots.
+    - **scale-up (decode)**: decode-capable occupancy (live sequences
+      over capacity) stays above ``busy_util``, or a handoff found no
+      ready decode-capable slot (the router fell back to mig_resume).
+    - **scale-down**: a role's replicas served nothing — no live
+      sequence, nothing queued for them — for ``idle_s`` straight.
+    """
+
+    def __init__(self, slo_ttft_s: float | None = None,
+                 headroom: float = 0.8, busy_util: float = 0.85,
+                 idle_s: float = 10.0, min_interval_s: float = 0.25):
+        self.slo_ttft_s = slo_ttft_s
+        self.headroom = headroom
+        self.busy_util = busy_util
+        self.idle_s = idle_s
+        self.min_interval_s = min_interval_s
+        self._last_update = 0.0
+        self._busy_t: dict[str, float] = {}
+        #: last computed hints: (role, direction) -> 0/1
+        self.hints: dict[tuple[str, str], int] = {}
+        #: set by the router when a handoff had no decode-capable target
+        self.decode_starved = False
+
+    def update(self, now: float, handles, n_queued: int,
+               est_queue_wait_s: float | None,
+               registry=None) -> dict[tuple[str, str], int] | None:
+        """Recompute hints (rate-limited); returns them, or None when
+        skipped. ``handles``: READY replica handles (``.role`` +
+        heartbeat ``.load``)."""
+        if now - self._last_update < self.min_interval_s:
+            return None
+        self._last_update = now
+        by_role: dict[str, list] = {}
+        for h in handles:
+            by_role.setdefault(role_of(h), []).append(h)
+        roles_present = set(by_role)
+        hints: dict[tuple[str, str], int] = {}
+        for role in sorted(roles_present):
+            reps = by_role[role]
+            live = sum((h.load or {}).get("live", 0) for h in reps)
+            cap = sum(max(h.max_live, 1) for h in reps)
+            queued_here = n_queued if role in PREFILL_CAPABLE else 0
+            up = 0
+            if role in PREFILL_CAPABLE:
+                if self.slo_ttft_s is not None \
+                        and est_queue_wait_s is not None \
+                        and est_queue_wait_s > self.slo_ttft_s \
+                        * self.headroom:
+                    up = 1
+            if role in DECODE_CAPABLE:
+                if cap and live / cap > self.busy_util:
+                    up = 1
+                if role == ROLE_DECODE and self.decode_starved:
+                    up = 1
+            busy = live > 0 or queued_here > 0
+            if busy or role not in self._busy_t:
+                self._busy_t[role] = now if busy else \
+                    self._busy_t.get(role, now)
+            down = int(not busy
+                       and now - self._busy_t.get(role, now) > self.idle_s)
+            hints[(role, "up")] = up
+            hints[(role, "down")] = down
+        # a starved role with ZERO ready replicas never shows up in
+        # handles — queued work with no prefill-capable slot, or a
+        # fallback'd handoff with no decode slot, is the loudest up
+        # signal there is
+        if n_queued > 0 and not (roles_present & set(PREFILL_CAPABLE)):
+            hints[(ROLE_PREFILL, "up")] = 1
+        if self.decode_starved and ROLE_DECODE not in roles_present:
+            hints[(ROLE_DECODE, "up")] = 1
+        self.decode_starved = False
+        self.hints = hints
+        if registry is not None:
+            for (role, direction), v in hints.items():
+                registry.gauge(
+                    "serving_router_scale_hint",
+                    labels={"role": role, "direction": direction},
+                    help="per-role autoscale hint (1 = act): scale-up on "
+                         "queue-wait SLO pressure / decode saturation, "
+                         "scale-down on sustained idle — signals only, "
+                         "no actuator").set(v)
+        return hints

@@ -46,9 +46,15 @@ class FleetConfig:
     replica: dict = field(default_factory=dict)   # backend config template
     #: per-slot overrides (chaos: {"0": {"faults": {...}}})
     per_slot: dict = field(default_factory=dict)
+    #: disaggregated serving roles by slot index ("prefill" | "decode" |
+    #: "mixed"); shorter than n_replicas leaves the tail mixed. A
+    #: per-slot/replica-template "role" key overrides this convenience.
+    roles: list | None = None
     hb_timeout_s: float = 2.0
     ready_timeout_s: float = 60.0
     send_timeout_s: float = 2.0
+    #: remote slots (replica/per-slot "address" set): bounded dial time
+    connect_timeout_s: float = 5.0
     backoff_base_s: float = 0.1
     backoff_max_s: float = 5.0
     breaker_window_s: float = 30.0
@@ -75,6 +81,15 @@ class ReplicaHandle:
         self.digest: set[int] | None = None
         self.max_live = 0
         self.block_size = 0
+        cfg = self._config()
+        #: disaggregated serving role (serving/disagg.py); the replica's
+        #: ready message confirms (and, for remote slots whose config
+        #: lives with the daemon, overrides) it
+        self.role = str(cfg.get("role", "mixed"))
+        #: remote transport: an address here means this slot DIALS a
+        #: replica daemon (transport.connect_channel) instead of spawning
+        #: a subprocess; restart policy = reconnect with backoff
+        self.address = cfg.get("address")
         self.deaths: deque[float] = deque()      # breaker window
         self.next_spawn_t = 0.0
         self.breaker_open_until = 0.0
@@ -84,6 +99,9 @@ class ReplicaHandle:
     # -- config ----------------------------------------------------------
     def _config(self) -> dict:
         cfg = dict(self.fcfg.replica)
+        roles = self.fcfg.roles
+        if roles and self.slot < len(roles):
+            cfg["role"] = roles[self.slot]
         cfg.update(self.fcfg.per_slot.get(str(self.slot), {}))
         cfg["replica_id"] = self.slot
         cfg["epoch"] = self.epoch
@@ -94,9 +112,29 @@ class ReplicaHandle:
 
     # -- lifecycle -------------------------------------------------------
     def spawn(self) -> None:
-        if self.proc is not None:
+        if self.proc is not None or self.chan is not None:
             self.kill()          # never orphan a previous incarnation
         self.epoch += 1
+        if self.address:
+            # remote slot: dial the daemon. A failed dial leaves the slot
+            # SPAWNING with no channel — the next maintain() tick
+            # observes the death and applies the normal backoff/breaker
+            # policy (a downed remote host costs retries, not a hang).
+            from .transport import connect_channel
+
+            self.state = SPAWNING
+            self.load = self.digest = None
+            self.last_msg_t = time.monotonic()
+            try:
+                self.chan = connect_channel(
+                    self.address, timeout=self.fcfg.connect_timeout_s)
+                logger.info(f"fleet: slot {self.slot} connected to "
+                            f"{self.address} (epoch {self.epoch})")
+            except OSError as e:
+                self.chan = None
+                logger.warning(f"fleet: slot {self.slot} dial of "
+                               f"{self.address} failed: {e}")
+            return
         env = dict(os.environ)
         env.setdefault("JAX_PLATFORMS", "cpu")
         # the worker must import THIS package tree regardless of the
@@ -131,7 +169,8 @@ class ReplicaHandle:
                     f"(pid {self.proc.pid})")
 
     def alive(self, now: float, hb_timeout: float) -> bool:
-        if self.proc is None or self.proc.poll() is not None:
+        if not self.address \
+                and (self.proc is None or self.proc.poll() is not None):
             return False
         if self.chan is None or self.chan.closed:
             return False
@@ -213,7 +252,7 @@ class Fleet:
         but DEAD/QUARANTINED) is left alone — double-start must not
         orphan live worker processes."""
         for r in self.replicas:
-            if r.proc is None or r.state == DEAD:
+            if (r.proc is None and r.chan is None) or r.state == DEAD:
                 r.spawn()
 
     def maintain(self, now: float) -> list[ReplicaHandle]:
@@ -223,9 +262,12 @@ class Fleet:
         for r in self.replicas:
             if r.state in (READY, DRAINING, SPAWNING) \
                     and not r.alive(now, self.cfg.hb_timeout_s):
-                cause = "exited" if (r.proc is None
-                                     or r.proc.poll() is not None) \
-                    else "unresponsive"
+                if r.address:
+                    cause = "disconnected"
+                elif r.proc is None or r.proc.poll() is not None:
+                    cause = "exited"
+                else:
+                    cause = "unresponsive"
                 logger.warning(f"fleet: slot {r.slot} epoch {r.epoch} "
                                f"died ({cause})")
                 r.kill()
@@ -285,6 +327,9 @@ class Fleet:
         r.state = READY
         r.max_live = int(msg.get("max_live", 1))
         r.block_size = int(msg.get("block_size", 0))
+        # the worker's own view of its role wins (a remote daemon's
+        # config lives with the daemon, not the fleet)
+        r.role = str(msg.get("role", r.role))
         if r.half_open:
             # the probe came up: give it a clean slate
             r.half_open = False

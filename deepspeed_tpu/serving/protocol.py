@@ -17,9 +17,26 @@ Message vocabulary (``t`` is the type tag)::
     {"t":"drain"}                           finish in-flight, refuse puts
     {"t":"ping"}                            answer with a heartbeat now
     {"t":"shutdown"}                        exit after "bye"
+    {"t":"mig_begin","id":str,"a":int,"meta":{...}}  a page bundle is
+                                            about to arrive (decode
+                                            role): claim capacity now
+    {"t":"mig_chunk","id":str,"a":int,"i":int,"p":int,"o":int,"n":int,
+     "crc":int,"data":b64}                  one bundle payload chunk
+                                            (also replica->router on the
+                                            export leg)
+    {"t":"mig_eof","id":str,"a":int,"chunks":int}    transfer complete
+                                            (both legs); the importer
+                                            checks for gaps
+    {"t":"mig_ack","id":str}                importer took over: release
+                                            the pinned export
+    {"t":"mig_abort","id":str}              migration dead: drop the
+                                            pinned export entirely
+    {"t":"mig_resume","id":str}             no decode-capable replica:
+                                            unfreeze and keep decoding
 
   replica -> router
-    {"t":"ready","pid":int,"block_size":int,"max_live":int,"epoch":int}
+    {"t":"ready","pid":int,"block_size":int,"max_live":int,"epoch":int,
+     "role":"prefill"|"decode"|"mixed"}
     {"t":"chunk","id":str,"off":int,"toks":[int]}    stream tokens; "off"
                                             is the stream offset of the
                                             first token (replay dedup)
@@ -29,6 +46,16 @@ Message vocabulary (``t`` is the type tag)::
     {"t":"failed","id":str,"reason":str}    structured per-request failure
     {"t":"hb","load":{...},"digest":[int]|null}  liveness + backlog +
                                             prefix-cache residency digest
+    {"t":"handoff","id":str,"a":int,"meta":{...},"chunks":int}  this
+                                            sequence crossed the
+                                            prefill->decode boundary;
+                                            bundle chunks follow
+    {"t":"mig_ack","id":str,"a":int}        import committed (decode
+                                            role): the stream continues
+                                            here
+    {"t":"mig_need","id":str,"a":int,"missing":[int]}  gaps after EOF —
+                                            resend exactly these chunk
+                                            ids (resumable transfer)
     {"t":"bye"}                             clean shutdown ack
 
 Deadlines are LAW here (bin/check_deadlines.py lints this package): every

@@ -69,6 +69,12 @@ class ToyBackend:
         self.prefill_chunk = int(cfg.get("prefill_chunk", 64))
         self.tokens_per_step = int(cfg.get("tokens_per_step", 4))
         self.decode_delay_s = float(cfg.get("decode_delay_s", 0.0))
+        #: disaggregated serving role (serving/disagg.py): "prefill"
+        #: freezes each sequence after its first sampled token and hands
+        #: it off; "decode"/"mixed" serve to completion (a decode replica
+        #: ALSO accepts fresh puts — the router's fallback when no
+        #: prefill-capable slot is ready)
+        self.role = str(cfg.get("role", "mixed"))
         #: the real radix trie — digest/match/publish are the production
         #: code paths (host-only; named ``radix`` because this backend
         #: OWNS its fake pool — StateManager's refcounted-API lint governs
@@ -78,6 +84,11 @@ class ToyBackend:
         self.seqs: dict[str, dict] = {}
         self.order: list[str] = []
         self.prefix_hit_tokens = 0
+        self._handoff: list[str] = []      # crossed the boundary this step
+        self._exports: dict[str, dict] = {}     # rid -> frozen seq (pinned)
+        self._imports: dict[str, object] = {}   # rid -> BundleAssembler
+        self.migrations_out = 0
+        self.migrations_in = 0
 
     def has_work(self) -> bool:
         return bool(self.seqs)
@@ -104,8 +115,14 @@ class ToyBackend:
         seq = self.seqs.pop(rid, None)
         if seq is None:
             return
-        self.order.remove(rid)
-        self.radix.release(seq["nodes"])
+        if rid in self.order:
+            self.order.remove(rid)
+        if rid in self._handoff:
+            self._handoff.remove(rid)
+        self._exports.pop(rid, None)
+        self._imports.pop(rid, None)
+        if seq.get("nodes"):
+            self.radix.release(seq["nodes"])
 
     def _finish(self, rid: str) -> None:
         """Release path: publish full computed pages into the trie (the
@@ -144,6 +161,13 @@ class ToyBackend:
                 continue
             n = min(self.tokens_per_step,
                     rec.max_new_tokens - len(seq["generated"]))
+            if self.role == "prefill" and not seq.get("resumed"):
+                # prefill role: sample exactly the FIRST token (TTFT is
+                # this replica's product), then freeze the sequence for
+                # handoff — unless that token already finishes it. A
+                # mig_resume'd sequence serves out locally at full rate
+                # (role-split degraded to mixed for it).
+                n = min(n, 1)
             off = len(seq["generated"])
             new: list[int] = []
             for i in range(n):
@@ -165,21 +189,166 @@ class ToyBackend:
                 toks = list(seq["generated"])
                 self._finish(rid)
                 events.append((rid, "done", toks, 0))
+            elif self.role == "prefill" and seq["generated"] \
+                    and not seq.get("resumed"):
+                # crossed the prefill->decode boundary: freeze (out of
+                # the step loop, capacity + trie pins held) until the
+                # handoff settles — take_handoffs() exports it
+                self.order.remove(rid)
+                self._handoff.append(rid)
         return events
+
+    # -- KV-page migration (disaggregated serving) -----------------------
+    def take_handoffs(self) -> list[tuple]:
+        """Bundle every sequence that crossed the prefill->decode
+        boundary this step: ``(rid, PageBundle, catchup, off)`` — catchup
+        is always empty for the toy (every generated token was streamed
+        as a chunk already). Pages are synthetic chain-derived payloads
+        (migration.toy_page_payload) the importer VERIFIES, so the chaos
+        suite proves transfer integrity, not just bookkeeping."""
+        from ..inference.migration import toy_bundle
+
+        out = []
+        for rid in self._handoff:
+            seq = self.seqs[rid]
+            rec = seq["rec"]
+            self._exports[rid] = seq
+            out.append((rid, toy_bundle(
+                rid, list(rec.prompt), list(seq["generated"]),
+                rec.max_new_tokens, rec.eos_token_id, rec.tenant,
+                self.block_size), [], 0))
+        self._handoff = []
+        return out
+
+    def export_commit(self, rid: str) -> None:
+        """Importer acked: publish the computed pages into the local trie
+        (the source keeps serving this prefix from cache) and drop the
+        sequence."""
+        seq = self._exports.pop(rid, None)
+        if seq is None:
+            return
+        self.seqs.pop(rid, None)
+        tokens = list(seq["rec"].prompt) + seq["generated"]
+        n_computed = len(tokens) - 1
+        n_full = n_computed // self.block_size
+        blocks = [n.block for n in seq["nodes"]]
+        blocks += [self._fresh_block()
+                   for _ in range(max(n_full - len(blocks), 0))]
+        self.radix.publish(tokens, blocks[:n_full], len(seq["nodes"]),
+                           n_full * self.block_size)
+        self.migrations_out += 1
+        over = len(self.radix) - self.cache_pages
+        if over > 0:
+            self.radix.evict(over)
+
+    def export_abort(self, rid: str, resume: bool) -> None:
+        """Transfer failed. ``resume`` = keep serving it here (role-split
+        degrades to mixed); otherwise drop it entirely (the router
+        replays elsewhere)."""
+        if resume and rid in self._exports:
+            seq = self._exports.pop(rid)
+            seq["resumed"] = True       # finish locally, no re-handoff
+            self.order.append(rid)
+        else:
+            self.cancel(rid)
+
+    def import_begin(self, rid: str, meta: dict) -> str | None:
+        """Reserve capacity for an arriving bundle; structured refusal
+        reason or None."""
+        from ..inference.migration import BundleAssembler
+
+        if rid in self.seqs:
+            return "duplicate"
+        if len(self.seqs) >= self.max_live:
+            return "capacity"
+        self._imports[rid] = BundleAssembler(meta)
+        # capacity placeholder: holds the slot while chunks stream
+        self.seqs[rid] = {"rec": None, "importing": True, "nodes": [],
+                          "generated": [], "prefill_left": 0, "seed": 0}
+        return None
+
+    def import_chunk(self, rid: str, msg: dict) -> str | None:
+        from ..inference.migration import MigrationError
+
+        asm = self._imports.get(rid)
+        if asm is None:
+            return "import_failed"
+        try:
+            asm.add(msg)
+        except MigrationError:
+            return "import_failed"
+        return None
+
+    def import_eof(self, rid: str, total: int):
+        """``("need", missing ids)`` | ``("ok", None)`` | ``("fail",
+        reason)``. On ok the sequence is live and decode-ready: the toy
+        re-derives its LCG state from the token chain, and the imported
+        full pages seed the local radix (the distributed-cache leg — the
+        digest grows before this replica ever finished a request)."""
+        from ..inference.migration import MigrationError, toy_verify
+
+        asm = self._imports.get(rid)
+        if asm is None:
+            if rid in self.seqs and not self.seqs[rid].get("importing"):
+                return ("ok", None)    # duplicate EOF after commit: re-ack
+            return ("fail", "import_failed")
+        asm.eof(total)
+        missing = asm.missing()
+        if missing:
+            return ("need", missing)
+        try:
+            bundle = asm.assemble()
+            toy_verify(bundle)      # payload integrity oracle
+        except MigrationError:
+            self.import_abort(rid)
+            return ("fail", "import_failed")
+        del self._imports[rid]
+        prompt = bundle.tokens[:bundle.prompt_len]
+        generated = bundle.tokens[bundle.prompt_len:]
+        n_aligned = bundle.n_full * self.block_size
+        nodes, _ = self.radix.adopt(
+            bundle.tokens,
+            [self._fresh_block() for _ in range(bundle.n_full)],
+            n_aligned)
+        seed = 0
+        for t in prompt:
+            seed = _mix(seed, int(t))
+        for i in range(len(generated)):
+            seed = _mix(seed, i)
+        self.seqs[rid] = {
+            "rec": RequestRecord(
+                trace_id=rid, prompt=[int(t) for t in prompt],
+                max_new_tokens=bundle.max_new_tokens,
+                eos_token_id=bundle.eos_id, tenant=bundle.tenant),
+            "nodes": nodes, "generated": [int(t) for t in generated],
+            "prefill_left": 0, "seed": seed}
+        self.order.append(rid)
+        self.migrations_in += 1
+        return ("ok", None)
+
+    def import_abort(self, rid: str) -> None:
+        if rid in self._imports:
+            del self._imports[rid]
+            self.seqs.pop(rid, None)
 
     def drain_done(self) -> bool:
         return not self.seqs
 
     def load(self) -> dict:
+        # frozen sequences (handoff pending / export pinned / import
+        # arriving) hold capacity but schedule nothing — mirror the
+        # engine's load_summary shape
+        active = [self.seqs[r] for r in self.order]
         pend = sum(s["prefill_left"]
                    + (s["rec"].max_new_tokens - len(s["generated"]))
-                   for s in self.seqs.values())
-        return {"live": len(self.seqs), "queued": len(self.seqs),
+                   for s in active)
+        return {"live": len(self.seqs), "queued": len(active),
                 "pending_tokens": pend,
+                "migrating": len(self.seqs) - len(active),
                 "pending_prefill": any(s["prefill_left"] > 0
-                                       for s in self.seqs.values()),
+                                       for s in active),
                 "pending_decode": any(s["prefill_left"] == 0
-                                      for s in self.seqs.values()),
+                                      for s in active),
                 "max_seqs": self.max_live}
 
     def digest(self, max_entries: int = 4096) -> list[int]:
@@ -208,14 +377,26 @@ class EngineBackend:
         ecfg.setdefault("num_blocks", 128)
         ecfg.setdefault("max_seqs", 4)
         ecfg.setdefault("max_seq_len", 512)
+        if str(cfg.get("role", "mixed")) == "prefill":
+            # a prefill-role replica hands each sequence off right after
+            # its first sampled token: a multi-token decode window would
+            # only generate tokens the decode pool exists to own
+            ecfg.setdefault("decode_window", 1)
         self.eng = InferenceEngineV2(
             model, rng=jax.random.PRNGKey(int(cfg.get("seed", 0))),
             config=ecfg)
         self.block_size = self.eng.config.block_size
         self.max_live = self.eng.config.max_seqs
+        self.role = str(cfg.get("role", "mixed"))
         self._uids: dict[str, int] = {}
         self._next_uid = 1
         self._sent: dict[str, int] = {}          # rid -> tokens streamed
+        self._tenants: dict[str, str] = {}       # rid -> tenant label
+        self._exports: dict[str, int] = {}       # rid -> frozen uid
+        self._imports: dict[str, object] = {}    # rid -> BundleAssembler
+        self._resumed: set[str] = set()          # mig_resume'd: serve local
+        self.migrations_out = 0
+        self.migrations_in = 0
 
     def has_work(self) -> bool:
         return bool(self._uids) or bool(self.eng._inflight)
@@ -235,11 +416,18 @@ class EngineBackend:
             return "capacity"
         self._uids[rec.trace_id] = uid
         self._sent[rec.trace_id] = 0
+        self._tenants[rec.trace_id] = rec.tenant
         return None
 
     def cancel(self, rid: str) -> None:
         uid = self._uids.pop(rid, None)
+        self._exports.pop(rid, None)
+        self._imports.pop(rid, None)
+        self._tenants.pop(rid, None)
+        self._resumed.discard(rid)
         if uid is not None:
+            # engine flush settles any pinned migration state itself
+            # (export_abort / abort_import) before releasing
             self.eng.flush(uid)
             self._sent.pop(rid, None)
 
@@ -265,13 +453,139 @@ class EngineBackend:
             self._sent[rid] += len(toks)
         for rid, uid in list(self._uids.items()):
             seq = self.eng.state.seqs.get(uid)
-            if seq is not None and seq.done \
+            if seq is not None and seq.done and not seq.frozen \
                     and not self.eng._uid_inflight(uid):
                 toks = [int(t) for t in self.eng.flush(uid)]
                 del self._uids[rid]
                 self._sent.pop(rid, None)
+                self._tenants.pop(rid, None)
+                self._resumed.discard(rid)
                 events.append((rid, "done", toks, 0))
         return events
+
+    # -- KV-page migration (disaggregated serving) -----------------------
+    def take_handoffs(self) -> list[tuple]:
+        """Freeze + bundle every sequence past the prefill->decode
+        boundary (first committed token). The export drains the async
+        pipeline for that uid, so the bundle may carry a couple more
+        committed tokens than were streamed — the catchup chunk closes
+        that gap so the router's committed prefix stays continuous."""
+        out = []
+        for rid, uid in list(self._uids.items()):
+            if rid in self._exports or rid in self._resumed:
+                continue
+            seq = self.eng.state.seqs.get(uid)
+            if seq is None or seq.done or seq.frozen \
+                    or seq.n_generated < 1 or seq.pending_tokens != 1:
+                continue
+            try:
+                bundle = self.eng.export_migration(
+                    uid, trace_id=rid,
+                    tenant=self._tenants.get(rid, "default"))
+            except RuntimeError as e:
+                logger.warning(f"replica: export of {rid} refused: {e}")
+                continue
+            if self.eng.state.seqs[uid].done:
+                # the drain finished it — no handoff, the done-scan in
+                # the next step() surfaces it (abort unfreezes nothing
+                # here because migrate_out refuses done sequences)
+                continue
+            self._exports[rid] = uid
+            sent = self._sent.get(rid, 0)
+            catchup = [int(t)
+                       for t in bundle.tokens[len(bundle.tokens)
+                                              - bundle.n_generated
+                                              + sent:]]
+            self._sent[rid] = bundle.n_generated
+            out.append((rid, bundle, catchup, sent))
+        return out
+
+    def export_commit(self, rid: str) -> None:
+        uid = self._exports.pop(rid, None)
+        if uid is None:
+            return
+        self.eng.export_commit(uid)
+        self._uids.pop(rid, None)
+        self._sent.pop(rid, None)
+        self._tenants.pop(rid, None)
+        self.migrations_out += 1
+
+    def export_abort(self, rid: str, resume: bool) -> None:
+        uid = self._exports.pop(rid, None)
+        if resume and uid is not None:
+            self.eng.export_abort(uid)
+            self._resumed.add(rid)      # finish locally, no re-handoff
+        else:
+            self.cancel(rid)
+
+    def import_begin(self, rid: str, meta: dict) -> str | None:
+        from ..inference.migration import (BundleAssembler,
+                                           MigrationError, PageBundle)
+
+        if rid in self._uids:
+            return "duplicate"
+        shell = PageBundle.from_meta(meta)
+        if not self.eng.can_import(
+                len(shell.tokens),
+                shell.max_new_tokens - shell.n_generated):
+            return "capacity"
+        uid = self._next_uid
+        self._next_uid += 1
+        try:
+            self.eng.import_reserve(uid, meta)
+        except (MigrationError, RuntimeError, ValueError) as e:
+            logger.warning(f"replica: import of {rid} refused: {e}")
+            return "import_failed"
+        self._uids[rid] = uid
+        self._imports[rid] = BundleAssembler(meta)
+        # the exporter already streamed the bundle's generated prefix
+        self._sent[rid] = shell.n_generated
+        self._tenants[rid] = shell.tenant
+        return None
+
+    def import_chunk(self, rid: str, msg: dict) -> str | None:
+        from ..inference.migration import MigrationError
+
+        asm = self._imports.get(rid)
+        if asm is None:
+            return "import_failed"
+        try:
+            asm.add(msg)
+        except MigrationError:
+            return "import_failed"
+        return None
+
+    def import_eof(self, rid: str, total: int):
+        from ..inference.migration import MigrationError
+
+        asm = self._imports.get(rid)
+        if asm is None:
+            if rid in self._uids:
+                return ("ok", None)    # duplicate EOF after commit: re-ack
+            return ("fail", "import_failed")
+        asm.eof(total)
+        missing = asm.missing()
+        if missing:
+            return ("need", missing)
+        try:
+            bundle = asm.assemble()
+            self.eng.import_complete(self._uids[rid], bundle)
+        except (MigrationError, RuntimeError) as e:
+            logger.warning(f"replica: import of {rid} failed: {e}")
+            self.import_abort(rid)
+            return ("fail", "import_failed")
+        del self._imports[rid]
+        self.migrations_in += 1
+        return ("ok", None)
+
+    def import_abort(self, rid: str) -> None:
+        if rid in self._imports:
+            del self._imports[rid]
+            uid = self._uids.pop(rid, None)
+            if uid is not None:
+                self.eng.import_abort(uid)
+            self._sent.pop(rid, None)
+            self._tenants.pop(rid, None)
 
     def drain_done(self) -> bool:
         return not self.has_work()
@@ -296,8 +610,10 @@ def _build_backend(cfg: dict):
 
 
 def serve(cfg: dict, chan: LineChannel) -> int:
-    """The replica event loop. Returns a process exit code; raises only
-    on injected soft faults (the worker runs injection HARD, so in
+    """The replica event loop. Returns 0 on an explicit shutdown message
+    and 2 when the router went away (a ``--listen`` daemon then goes
+    back to accepting; the pipe-parent mode exits either way); raises
+    only on injected soft faults (the worker runs injection HARD, so in
     production shape a crash is an ``os._exit``)."""
     inj = FaultInjector(spec=cfg.get("faults") or {}, env="", hard=True)
     v = inj.fire("replica_slow_start_s")
@@ -315,9 +631,10 @@ def serve(cfg: dict, chan: LineChannel) -> int:
     hb_interval = float(cfg.get("hb_interval_s", 0.05))
     send_t = float(cfg.get("send_timeout_s", 2.0))
     digest_max = int(cfg.get("digest_max", 4096))
+    role = getattr(backend, "role", "mixed")
     chan.send({"t": "ready", "pid": os.getpid(),
                "block_size": backend.block_size,
-               "max_live": backend.max_live,
+               "max_live": backend.max_live, "role": role,
                "epoch": int(cfg.get("epoch", 0))}, timeout=send_t)
 
     draining = False
@@ -342,7 +659,7 @@ def serve(cfg: dict, chan: LineChannel) -> int:
             msg = chan.recv(timeout=0.001 if busy else
                             min(hb_interval, 0.05))
         except ChannelClosed:
-            return 0                     # router went away
+            return 2                     # router went away
         if msg is not None:
             t = msg.get("t")
             if t == "put":
@@ -370,6 +687,55 @@ def serve(cfg: dict, chan: LineChannel) -> int:
                             help="requests admitted by this replica").inc()
             elif t == "flush":
                 backend.cancel(str(msg["id"]))
+            elif t == "mig_begin":
+                # a migrated-in sequence is arriving (decode role): claim
+                # capacity BEFORE the first payload chunk
+                rid = str(msg["id"])
+                attempts[rid] = int(msg.get("a", 0))
+                reason = "draining" if draining \
+                    else backend.import_begin(rid, msg["meta"])
+                if reason:
+                    _stream({"t": "failed", "id": rid, "a": attempts[rid],
+                             "reason": reason})
+            elif t == "mig_chunk":
+                rid = str(msg["id"])
+                if inj.countdown("replica_crash_during_import"):
+                    inj.crash_now("replica_crash_during_import",
+                                  f"import of {rid}")
+                err = backend.import_chunk(rid, msg)
+                if err:
+                    backend.import_abort(rid)
+                    _stream({"t": "failed", "id": rid,
+                             "a": attempts.get(rid, 0), "reason": err})
+            elif t == "mig_eof":
+                rid = str(msg["id"])
+                status, aux = backend.import_eof(rid,
+                                                 int(msg["chunks"]))
+                a = attempts.get(rid, 0)
+                if status == "need":
+                    # resumable-per-chunk: name the gaps, the router
+                    # resends exactly those from its buffer
+                    _stream({"t": "mig_need", "id": rid, "a": a,
+                             "missing": aux})
+                elif status == "ok":
+                    _stream({"t": "mig_ack", "id": rid, "a": a})
+                    if telem is not None:
+                        telem.registry.counter(
+                            "serving_replica_migrations_in_total",
+                            help="page bundles imported by this "
+                                 "replica").inc()
+                else:
+                    _stream({"t": "failed", "id": rid, "a": a,
+                             "reason": str(aux)})
+            elif t == "mig_ack":
+                # the importer owns the stream: release our pinned pages
+                # (publishing the prefix into the local trie)
+                backend.export_commit(str(msg["id"]))
+            elif t == "mig_abort":
+                backend.export_abort(str(msg["id"]), resume=False)
+            elif t == "mig_resume":
+                # no decode-capable replica: keep serving it here
+                backend.export_abort(str(msg["id"]), resume=True)
             elif t == "drain":
                 draining = True
             elif t == "ping":
@@ -408,6 +774,37 @@ def serve(cfg: dict, chan: LineChannel) -> int:
                 _stream({"t": "failed", "id": rid, "a": a,
                          "reason": str(toks)})
 
+        if role == "prefill":
+            # sequences past the prefill->decode boundary: freeze, bundle
+            # and stream the page chunks to the router, which relays them
+            # to a decode replica. Pages stay pinned here until mig_ack /
+            # mig_abort / mig_resume comes back.
+            from ..inference.migration import iter_chunks
+
+            for rid, bundle, catchup, off in backend.take_handoffs():
+                a = attempts.get(rid, 0)
+                if catchup:
+                    # committed-but-unstreamed tokens the export drain
+                    # folded in: stream them so the router's committed
+                    # prefix stays gapless
+                    _stream({"t": "chunk", "id": rid, "a": a, "off": off,
+                             "toks": catchup})
+                chunks = iter_chunks(bundle)
+                _stream({"t": "handoff", "id": rid, "a": a,
+                         "meta": bundle.meta(), "chunks": len(chunks)})
+                for c in chunks:
+                    if inj.countdown("replica_crash_during_handoff"):
+                        inj.crash_now("replica_crash_during_handoff",
+                                      f"handoff of {rid}")
+                    _stream({"t": "mig_chunk", "id": rid, "a": a, **c})
+                _stream({"t": "mig_eof", "id": rid, "a": a,
+                         "chunks": len(chunks)})
+                if telem is not None:
+                    telem.registry.counter(
+                        "serving_replica_migrations_out_total",
+                        help="page bundles exported by this "
+                             "replica").inc()
+
         if stalled and time.monotonic() >= stall_until:
             # stall expired: deliver the queued stream late — the router
             # has usually reassigned by now and must drop these as stale
@@ -435,12 +832,45 @@ def serve(cfg: dict, chan: LineChannel) -> int:
 def main(argv: list[str]) -> int:
     import json
 
-    raw = argv[1] if len(argv) > 1 else os.environ.get(
+    args = list(argv[1:])
+    listen = None
+    if args and args[0] == "--listen":
+        # remote-transport daemon (serving/transport.py): accept one
+        # router at a time on a TCP/unix socket, go back to accepting
+        # when that router disappears, exit only on an explicit shutdown
+        # — role-split replicas need not share a pipe parent or a host
+        listen = args[1]
+        args = args[2:]
+    raw = args[0] if args else os.environ.get(
         "DS_TPU_REPLICA_CONFIG", "{}")
     if raw.startswith("@"):
         with open(raw[1:], encoding="utf-8") as f:
             raw = f.read()
     cfg = json.loads(raw)
+    if listen is not None:
+        from .transport import SocketListener
+
+        listener = SocketListener(listen)
+        logger.info(f"replica: listening on {listener.bound_address}")
+        try:
+            while True:
+                chan = listener.accept_channel(timeout=1.0)
+                if chan is None:
+                    continue
+                try:
+                    rc = serve(cfg, chan)
+                except (ChannelClosed, ChannelTimeout) as e:
+                    logger.warning(f"replica: router lost ({e}); "
+                                   f"accepting again")
+                    rc = None
+                finally:
+                    chan.close()
+                if rc == 0:
+                    return 0             # explicit shutdown message
+        except KeyboardInterrupt:
+            return 0
+        finally:
+            listener.close()
     # fd hygiene: the protocol owns a PRIVATE dup of stdout, and fd 1 is
     # pointed at stderr — any stray print()/C-level write to stdout lands
     # in the log instead of corrupting the message stream

@@ -47,6 +47,8 @@ from ..telemetry import LATENCY_BUCKETS_S, get_telemetry, configure as \
 from ..telemetry.reqtrace import (TENANT_CARDINALITY_CAP,
                                   TENANT_OVERFLOW_LABEL)
 from ..utils.logging import logger
+from .disagg import (DECODE_CAPABLE, MigrationState, PREFILL_CAPABLE,
+                     ScaleAdvisor, role_of)
 from .fleet import DRAINING, Fleet, FleetConfig, QUARANTINED, READY
 from .placement import StickyMap, chain_hashes, pick_replica
 from .protocol import ChannelClosed, RequestRecord, poll_channels
@@ -91,6 +93,13 @@ class RouterConfig:
     #: mismatch is counted either way; strict additionally fails the
     #:  request — determinism is a correctness property here)
     strict_replay: bool = False
+    #: disaggregated serving: how many ``mig_need`` resend rounds a
+    #: bundle transfer gets before the migration is abandoned and the
+    #: request replays from scratch
+    migration_resend_max: int = 3
+    #: autoscale hints (disagg.ScaleAdvisor): sustained-idle window for
+    #: the per-role scale-down signal
+    scale_idle_s: float = 10.0
     telemetry: bool = False
 
 
@@ -113,6 +122,10 @@ class _Req:
     last_activity_t: float = 0.0
     hit_pages: int = 0
     placed: list[int] = field(default_factory=list)   # slot per attempt
+    #: in-flight prefill->decode handoff (disagg.MigrationState)
+    mig: MigrationState | None = None
+    #: the request completed decode on a replica it migrated to
+    migrated: bool = False
 
 
 class Router:
@@ -137,9 +150,13 @@ class Router:
         self._draining = False
         self._tid_ctr = 0
         self._commits: deque[tuple[float, int]] = deque()  # (t, n) window
+        self._scale = ScaleAdvisor(slo_ttft_s=self.cfg.slo_ttft_s,
+                                   idle_s=self.cfg.scale_idle_s)
         self.double_commits = 0
         self.stale_msgs = 0
         self.replay_mismatches = 0
+        self.migrations = 0
+        self.migration_fallbacks = 0
 
     # -- lifecycle -------------------------------------------------------
     def start(self, min_ready: int = 1) -> None:
@@ -292,7 +309,15 @@ class Router:
                 h.last_msg_t = time.monotonic()
                 self._handle(h, msg)
         self._check_deadlines(time.monotonic())
-        self._dispatch(time.monotonic())
+        now = time.monotonic()
+        self._dispatch(now)
+        # per-role autoscale hints: signals only (gauges), no actuator
+        self._scale.update(
+            now, self.fleet.ready(),
+            sum(len(q) for q in self._queues.values()),
+            self._est_queue_wait_s(),
+            registry=self._telem.registry if self._telem.enabled
+            else None)
 
     def run(self, deadline_s: float = 60.0) -> dict:
         """Poll until every submitted request is terminal, or fail the
@@ -324,6 +349,9 @@ class Router:
                 h.digest = set(d) if d else None
         elif t in ("chunk", "done", "failed"):
             self._on_stream(h, msg)
+        elif t in ("handoff", "mig_chunk", "mig_eof", "mig_ack",
+                   "mig_need"):
+            self._on_migration(h, msg)
         elif t == "bye":
             h.state = DRAINING
 
@@ -375,6 +403,7 @@ class Router:
                 # to it and requeue WITHOUT burning a retry (the drain
                 # deadline bounds this, not the retry budget)
                 h.state = DRAINING
+                self._abort_migration(req, "target_draining")
                 self._unassign(req)
                 req.status = QUEUED
                 self._queues.setdefault(req.rec.priority,
@@ -450,6 +479,178 @@ class Router:
                 help="per-token time between tokens (router-observed, "
                      "amortized over the stream)").observe(tbt, n=n - 1)
 
+    # -- disaggregated prefill/decode: handoff relay ---------------------
+    # A prefill-role replica freezes each sequence after its first
+    # sampled token and streams a page bundle (meta + chunked KV payload)
+    # to the router; the router buffers it, picks a decode-capable target
+    # by residency digest against the bundle's chain hashes, relays the
+    # chunks (resumable: the importer names gaps, the router resends from
+    # its buffer), and moves the request's assignment to the target on
+    # its ack. The source keeps its pages pinned until that ack arrives
+    # back through the router. Failure anywhere composes with PR-8
+    # machinery: the request replays from scratch on a survivor — except
+    # "no decode-capable replica", where the router tells the source to
+    # simply keep decoding (role-split degrades to mixed).
+
+    def _on_migration(self, h, msg: dict) -> None:
+        t = msg["t"]
+        tid = str(msg.get("id"))
+        req = self._reqs.get(tid)
+        if self._stale(h, req, msg):
+            return
+        now = time.monotonic()
+        req.last_activity_t = now
+        mig = req.mig
+        if t == "handoff":
+            req.mig = MigrationState(meta=msg.get("meta") or {},
+                                     src_slot=h.slot, src_epoch=h.epoch,
+                                     started_t=now)
+            self.migrations += 1
+            if self._telem.enabled:
+                self._telem.registry.counter(
+                    "serving_router_migrations_total",
+                    help="prefill->decode page-bundle handoffs "
+                         "started").inc()
+        elif t == "mig_chunk":
+            if mig is not None and mig.phase == "recv":
+                mig.add_chunk(msg)
+        elif t == "mig_eof":
+            if mig is None or mig.phase != "recv":
+                return
+            mig.total = int(msg.get("chunks", 0))
+            if not mig.complete:
+                # the source leg is a lossless pipe: a gap means the
+                # source died mid-stream (maintain() reaps it next tick)
+                self._abort_migration(req, "torn_bundle")
+                self._retry_or_fail(req, "migration_torn")
+                return
+            self._relay_migration(req)
+        elif t == "mig_need":
+            if mig is None or mig.phase != "xfer" \
+                    or h.slot != req.assigned_slot:
+                return
+            mig.resends += 1
+            if mig.resends > self.cfg.migration_resend_max:
+                self._abort_migration(req, "resend_budget")
+                self._retry_or_fail(req, "migration_failed")
+                return
+            rep = self.fleet.replicas[h.slot]
+            for i in msg.get("missing", ()):
+                c = mig.chunks.get(int(i))
+                if c is not None:
+                    rep.send({**c, "id": tid, "a": req.attempt})
+            rep.send({"t": "mig_eof", "id": tid, "a": req.attempt,
+                      "chunks": mig.total})
+        elif t == "mig_ack":
+            if mig is None or mig.phase != "xfer" \
+                    or h.slot != req.assigned_slot:
+                return
+            # importer owns the stream now; tell the source to release
+            # its pinned pages (best effort — a source that died after
+            # the export costs nothing, the bundle already landed)
+            self._send_to_slot(mig.src_slot, mig.src_epoch,
+                               {"t": "mig_ack", "id": tid})
+            self._release_slot_count(mig.src_slot)
+            req.migrated = True
+            req.mig = None
+            if self._telem.enabled:
+                self._telem.registry.counter(
+                    "serving_router_migration_bytes_total",
+                    help="page-bundle payload bytes relayed "
+                         "prefill->decode").inc(mig.payload_bytes)
+                self._telem.registry.histogram(
+                    "serving_router_migration_stall_s",
+                    buckets=LATENCY_BUCKETS_S,
+                    help="handoff emitted -> importer ack (the decode "
+                         "hand-over stall a migrated request "
+                         "pays)").observe(now - mig.started_t)
+
+    def _relay_migration(self, req: _Req) -> None:
+        """Pick a decode-capable target and stream the buffered bundle
+        to it — or, with no target, tell the source to keep decoding."""
+        mig = req.mig
+        tid = req.rec.trace_id
+        cands = [r for r in self._candidates(DECODE_CAPABLE)
+                 if r.slot != mig.src_slot]
+        if not cands:
+            # degrade to mixed: cheaper than failing or re-prefilling,
+            # and the scale advisor turns this into a decode-up hint
+            self._scale.decode_starved = True
+            self.migration_fallbacks += 1
+            self._send_to_slot(mig.src_slot, mig.src_epoch,
+                               {"t": "mig_resume", "id": tid})
+            req.mig = None
+            if self._telem.enabled:
+                self._telem.registry.counter(
+                    "serving_router_migration_fallbacks_total",
+                    help="handoffs resumed on the source for lack of a "
+                         "decode-capable replica (role-split degraded "
+                         "to mixed)").inc()
+            return
+        chain = [int(x) for x in mig.meta.get("chain", ())]
+        rep, hit = pick_replica(cands, chain, self._sticky)
+        # the assignment moves to the target, but the SOURCE still holds
+        # the pinned export (a real slot there) until its ack/abort —
+        # deliberately NOT _unassign here: the source stays counted so
+        # dispatch can't overfill it with puts it would refuse
+        # "capacity" (_release_slot_count(src) runs at ack/abort)
+        req.attempt += 1
+        req.assigned_slot = rep.slot
+        req.assigned_epoch = rep.epoch
+        req.last_activity_t = time.monotonic()
+        req.placed.append(rep.slot)
+        self._assigned_n[rep.slot] = self._assigned_n.get(rep.slot, 0) + 1
+        self._sticky.note(chain, rep.slot)
+        mig.phase = "xfer"
+        mig.tgt_slot = rep.slot
+        ok = rep.send({"t": "mig_begin", "id": tid, "a": req.attempt,
+                       "meta": mig.meta})
+        for i in range(mig.total if ok else 0):
+            ok = rep.send({**mig.chunks[i], "id": tid, "a": req.attempt})
+            if not ok:
+                break
+        ok = ok and rep.send({"t": "mig_eof", "id": tid,
+                              "a": req.attempt, "chunks": mig.total})
+        if not ok:
+            self._abort_migration(req, "target_send_failed")
+            self._retry_or_fail(req, "send_failed")
+
+    def _abort_migration(self, req: _Req, reason: str) -> None:
+        """Settle a dead migration: the source flushes its pinned export,
+        an already-begun import gets flushed too, the buffer drops. Every
+        send is best-effort — a dead slot simply doesn't hear it."""
+        mig = req.mig
+        if mig is None:
+            return
+        req.mig = None
+        tid = req.rec.trace_id
+        self._send_to_slot(mig.src_slot, mig.src_epoch,
+                           {"t": "mig_abort", "id": tid})
+        if mig.phase == "xfer":
+            # the source stayed counted across the relay (see
+            # _relay_migration); its pinned export flushes on the abort
+            self._release_slot_count(mig.src_slot)
+        if mig.phase == "xfer" and mig.tgt_slot >= 0 \
+                and mig.tgt_slot != mig.src_slot:
+            self._send_to_slot(mig.tgt_slot, -1, {"t": "flush", "id": tid})
+        logger.warning(f"router: migration of {tid} aborted ({reason})")
+        if self._telem.enabled:
+            self._telem.registry.counter(
+                "serving_router_migration_aborts_total",
+                labels={"reason": sanitize_label_value(reason)},
+                help="handoffs abandoned, by structured reason").inc()
+
+    def _send_to_slot(self, slot: int, epoch: int, msg: dict) -> bool:
+        """Best-effort message to a slot's CURRENT incarnation (epoch -1
+        = whatever runs there now; a stale epoch means the incarnation we
+        meant is gone — nothing to say to its successor)."""
+        if not 0 <= slot < len(self.fleet.replicas):
+            return False
+        rep = self.fleet.replicas[slot]
+        if epoch >= 0 and rep.epoch != epoch:
+            return False
+        return rep.send(msg)
+
     # -- failover --------------------------------------------------------
     def _replay_orphans(self, slot: int, epoch: int, reason: str) -> None:
         for tid, req in list(self._reqs.items()):
@@ -459,6 +660,9 @@ class Router:
 
     def _retry_or_fail(self, req: _Req, reason: str) -> None:
         tid = req.rec.trace_id
+        # a replay restarts from scratch: settle any half-done handoff
+        # first (source unpins/flushes, target reservation flushes)
+        self._abort_migration(req, reason)
         self._unassign(req)
         if req.retries >= self.cfg.max_retries:
             self._terminate(tid, FAILED, reason)
@@ -490,13 +694,22 @@ class Router:
                 self._retry_or_fail(req, "timeout")
 
     # -- dispatch --------------------------------------------------------
-    def _candidates(self) -> list:
+    def _candidates(self, roles=None) -> list:
         return [r for r in self.fleet.ready()
-                if self._assigned_n.get(r.slot, 0) < max(r.max_live, 1)]
+                if self._assigned_n.get(r.slot, 0) < max(r.max_live, 1)
+                and (roles is None or role_of(r) in roles)]
 
     def _dispatch(self, now: float) -> None:
         while True:
-            cands = self._candidates()
+            # fresh prompts are prefill work: place them on
+            # prefill-capable replicas; an all-decode (or
+            # prefill-saturated) moment falls back to ANY ready slot —
+            # role is placement policy, not capability, and a decode
+            # replica serves a put end to end like a mixed one
+            cands = self._candidates(PREFILL_CAPABLE)
+            role_fallback = not cands
+            if role_fallback:
+                cands = self._candidates()
             if not cands:
                 return
             tid = None
@@ -506,6 +719,12 @@ class Router:
                     break
             if tid is None:
                 return
+            if role_fallback and self._telem.enabled:
+                # counted only when a request is actually placed off-role
+                self._telem.registry.counter(
+                    "serving_router_role_fallbacks_total",
+                    help="prompts placed on a decode-role replica for "
+                         "lack of a ready prefill-capable slot").inc()
             req = self._reqs[tid]
             rep, hit_pages = pick_replica(cands, req.chain, self._sticky)
             req.attempt += 1
@@ -546,10 +765,13 @@ class Router:
                     sum(len(q) for q in self._queues.values()))
 
     # -- bookkeeping -----------------------------------------------------
+    def _release_slot_count(self, slot: int) -> None:
+        if slot >= 0:
+            n = self._assigned_n.get(slot, 0)
+            self._assigned_n[slot] = max(n - 1, 0)
+
     def _unassign(self, req: _Req) -> None:
-        if req.assigned_slot >= 0:
-            n = self._assigned_n.get(req.assigned_slot, 0)
-            self._assigned_n[req.assigned_slot] = max(n - 1, 0)
+        self._release_slot_count(req.assigned_slot)
         req.assigned_slot = req.assigned_epoch = -1
 
     def _terminate(self, tid: str, status: str, reason: str | None) -> None:
@@ -561,6 +783,10 @@ class Router:
             logger.error(f"router: refusing double terminal transition "
                          f"for {tid} ({req.status} -> {status})")
             return
+        if status != DONE:
+            # a request failing/shedding mid-handoff must not leave the
+            # source's pages pinned forever
+            self._abort_migration(req, f"terminated_{status}")
         if req.status == QUEUED:
             for q in self._queues.values():
                 if tid in q:
@@ -620,7 +846,7 @@ class Router:
                 else list(req.committed),
                 "tenant": req.rec.tenant, "attempts": req.attempt,
                 "retries": req.retries, "placed": list(req.placed),
-                "hit_pages": req.hit_pages,
+                "hit_pages": req.hit_pages, "migrated": req.migrated,
                 "ttft_s": (req.first_tok_t - req.submit_t)
                 if req.first_tok_t else None}
 

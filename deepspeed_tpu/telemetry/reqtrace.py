@@ -65,6 +65,10 @@ LIFECYCLE_EVENTS = (
     "rewind",           # history reset / draft-mirror resync
     "commit",           # sampled tokens reached the committed view
     "release",          # slot + pages freed / published (ragged)
+    "migrate_out",      # page bundle exported, sequence pinned (ragged);
+    #                     carries the serving trace ID linking both sides
+    "migrate_in",       # page bundle imported + trie seeded (ragged);
+    #                     same serving trace ID as the exporter's event
 )
 
 #: hard cap on distinct tenant label values per process — the scrape's

@@ -53,6 +53,10 @@ HEAVY = [
     # serving fleet: the engine-backend failover test spawns TWO replica
     # subprocesses that each compile a tiny engine
     "test_serving.py",
+    # disaggregated serving: the engine-pair handoff matrix (bf16 + fp8
+    # pools, 3 engines each) plus a role-split engine fleet vs a mixed
+    # baseline (3 replica subprocesses compiling tiny engines)
+    "test_disagg.py",
 ]
 
 

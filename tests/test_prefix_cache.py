@@ -213,33 +213,51 @@ _TEMPLATES = [tuple(range(0, 40)), tuple(range(100, 140)),
 
 def _gen_ops(rng, n_ops):
     """Replayable op list; ops no-op gracefully when state doesn't allow
-    them, so removing any subset still yields a valid trace (shrinking)."""
+    them, so removing any subset still yields a valid trace (shrinking).
+    The trace drives TWO pools: plain ops hit pool A, ``("b", op)``
+    wraps one for pool B, and the migrate ops move a decode-ready
+    sequence A -> B through the refcounted export/import/abort API
+    (``migrate_out`` / ``migrate_in`` / ``abort_migration`` at either
+    stage), with the pinned-until-ack contract asserted inline."""
     ops = []
     for _ in range(n_ops):
         r = rng.random()
-        if r < 0.30:
+        if r < 0.28:
             base = _TEMPLATES[int(rng.integers(len(_TEMPLATES)))]
             cut = int(rng.integers(1, len(base) + 1))
             extra = [int(t) for t in
                      rng.integers(300, 310, int(rng.integers(0, 6)))]
-            ops.append(("admit", list(base[:cut]) + extra,
-                        int(rng.integers(0, 4))))
-        elif r < 0.55:
-            ops.append(("dispatch",
-                        "decode" if rng.random() < 0.4 else None))
-        elif r < 0.72:
-            ops.append(("commit", int(rng.integers(0, 50))))
-        elif r < 0.86:
-            ops.append(("flush", int(rng.integers(0, 8))))
-        elif r < 0.94:
+            op = ("admit", list(base[:cut]) + extra,
+                  int(rng.integers(0, 4)))
+        elif r < 0.50:
+            op = ("dispatch", "decode" if rng.random() < 0.4 else None)
+        elif r < 0.65:
+            op = ("commit", int(rng.integers(0, 50)))
+        elif r < 0.77:
+            op = ("flush", int(rng.integers(0, 8)))
+        elif r < 0.84:
             # speculative verify round (rejection-rollback interleavings):
             # provision n candidates on some decode-ready uid, then either
             # accept j of them (j <= n → a mid-tree rejection rolled back
             # by the commit) or roll the whole tree back
-            ops.append(("spec", int(rng.integers(0, 4)),
-                        int(rng.integers(1, 4)), int(rng.integers(0, 5))))
+            op = ("spec", int(rng.integers(0, 4)),
+                  int(rng.integers(1, 4)), int(rng.integers(0, 5)))
+        elif r < 0.89:
+            op = ("evict", int(rng.integers(1, 5)))
+        elif r < 0.96:
+            # KV-page migration A -> B: full handoff (export, import,
+            # trie seed, ack, release-publish on the source)
+            ops.append(("migrate", int(rng.integers(0, 6))))
+            continue
         else:
-            ops.append(("evict", int(rng.integers(1, 5))))
+            # aborted migration: stage 0 = after export (export_abort),
+            # stage 1 = after the importer reserved (abort_import too)
+            ops.append(("migrate_abort", int(rng.integers(0, 6)),
+                        int(rng.integers(0, 2))))
+            continue
+        if rng.random() < 0.30:
+            op = ("b", op)            # same op against the importer pool
+        ops.append(op)
     return ops
 
 
@@ -261,24 +279,32 @@ def _check_no_stale(st):
 
 
 def _run_trace(ops):
-    """Interpret a trace; returns None or the failure message. Mirrors the
-    engine contract: flush commits every outstanding plan referencing the
-    uid (FIFO) before release — dispatched-but-uncommitted steps pin
-    their pages by keeping their uids live."""
-    st = StateManager(num_blocks=24, block_size=4, max_seqs=4,
-                      max_blocks_per_seq=8)
-    st.attach_prefix_cache(PrefixCache(4))
-    sched = SplitFuseScheduler(st, chunk=8, pack=True)
-    inflight = []           # dispatched, uncommitted plans (FIFO)
+    """Interpret a trace over TWO pools (A = exporter, B = importer);
+    returns None or the failure message. Mirrors the engine contract:
+    flush commits every outstanding plan referencing the uid (FIFO)
+    before release — dispatched-but-uncommitted steps pin their pages by
+    keeping their uids live — and migrations drain the uid's in-flight
+    plans before ``migrate_out`` (the committed view IS the pool
+    content). Both pools run a FULL ``audit()`` + stale-page walk after
+    EVERY op, migration stages included."""
+    pools = []
+    for _ in range(2):
+        st = StateManager(num_blocks=24, block_size=4, max_seqs=4,
+                          max_blocks_per_seq=8)
+        st.attach_prefix_cache(PrefixCache(4))
+        pools.append({"st": st,
+                      "sched": SplitFuseScheduler(st, chunk=8, pack=True),
+                      "inflight": []})
     next_uid = [1]
 
-    def commit_oldest(tok):
-        plan = inflight.pop(0)
+    def commit_oldest(P, tok):
+        plan = P["inflight"].pop(0)
         sampled = {u: tok for s, u in enumerate(plan.uids)
-                   if u >= 0 and plan.do_sample[s] and u in st.seqs}
-        sched.commit(plan, sampled)
+                   if u >= 0 and plan.do_sample[s] and u in P["st"].seqs}
+        P["sched"].commit(plan, sampled)
 
-    def apply(op):
+    def apply(P, op):
+        st, sched, inflight = P["st"], P["sched"], P["inflight"]
         kind = op[0]
         if kind == "admit":
             _, toks, gen = op
@@ -292,13 +318,13 @@ def _run_trace(ops):
                 inflight.append(plan)
         elif kind == "commit":
             if inflight:
-                commit_oldest(op[1])
+                commit_oldest(P, op[1])
         elif kind == "flush":
             live = sorted(st.seqs)
             if live:
                 uid = live[op[1] % len(live)]
                 while any(uid in p.uids for p in inflight):
-                    commit_oldest(0)
+                    commit_oldest(P, 0)
                 st.release(uid)
         elif kind == "spec":
             # mirrors the engine contract: spec rounds run on a drained
@@ -307,7 +333,8 @@ def _run_trace(ops):
             # back before anything else runs
             _, pick, n, accept = op
             cands = [u for u, s in sorted(st.seqs.items())
-                     if not s.done and s.pending_tokens == 1
+                     if not s.done and not s.frozen
+                     and s.pending_tokens == 1
                      and s.max_new_tokens - s.n_generated > 1
                      and not any(u in p.uids for p in inflight)]
             if cands:
@@ -332,23 +359,85 @@ def _run_trace(ops):
             if n > 0:
                 st.allocator.free(st._alloc(n))
 
+    def migrate(op):
+        """A -> B handoff through the refcounted migration API, audited
+        at every stage, pinned-until-ack asserted inline. ``op[2]``
+        (abort variant) picks the rollback point."""
+        A, B = pools
+        stA, stB = A["st"], B["st"]
+        abort_stage = op[2] if op[0] == "migrate_abort" else None
+        cands = [u for u, s in sorted(stA.seqs.items())
+                 if not s.done and not s.frozen and s.pending_tokens == 1]
+        if not cands:
+            return
+        uid = cands[op[1] % len(cands)]
+        # the engine contract: drain in-flight plans referencing the uid
+        while any(uid in p.uids for p in A["inflight"]):
+            commit_oldest(A, 0)
+        seq = stA.seqs.get(uid)
+        if seq is None or seq.done or seq.frozen \
+                or seq.pending_tokens != 1:
+            return                      # the drain finished/changed it
+        snap = stA.migrate_out(uid)
+        stA.audit()
+        # pinned-until-ack: release must refuse, the scheduler must not
+        # see the frozen sequence as work
+        try:
+            stA.release(uid)
+            raise AssertionError(
+                f"release of pinned export uid {uid} succeeded")
+        except RuntimeError:
+            pass
+        assert stA.seqs[uid].sched_done, "frozen sequence still plans"
+        if abort_stage == 0:
+            stA.export_abort(uid)
+            return
+        try:
+            nseq = stB.migrate_in_begin(
+                next_uid[0], snap["tokens"], snap["n_computed"],
+                snap["n_generated"], snap["max_new_tokens"],
+                eos_id=snap["eos_id"])
+        except RuntimeError:
+            stA.export_abort(uid)       # importer full: graceful no-op
+            return
+        next_uid[0] += 1
+        stB.audit()
+        if abort_stage is not None:
+            stB.abort_import(nseq.uid)
+            stB.audit()
+            stA.export_abort(uid)
+            return
+        stB.import_commit(nseq.uid)
+        stB.audit()
+        stA.export_ack(uid)
+        stA.release(uid)                # publishes the prefix locally
+
     for i, op in enumerate(ops):
         try:
-            apply(op)
-            st.audit()
-            _check_no_stale(st)
+            if op[0] == "b":
+                apply(pools[1], op[1])
+            elif op[0] in ("migrate", "migrate_abort"):
+                migrate(op)
+            else:
+                apply(pools[0], op)
+            for P in pools:
+                P["st"].audit()
+                _check_no_stale(P["st"])
         except AssertionError as e:
             return f"op {i} {op!r}: {e}"
-    # drain + release everything; the pool must reconcile exactly
+    # drain + release everything; BOTH pools must reconcile exactly
     try:
-        while inflight:
-            commit_oldest(0)
-        for uid in sorted(st.seqs):
-            st.release(uid)
-        st.audit()
-        assert st.allocator.free_blocks + st.prefix_cache.cached_blocks \
-            == st.allocator.num_blocks - 1, "pool failed to reconcile"
-        _check_no_stale(st)
+        for P in pools:
+            while P["inflight"]:
+                commit_oldest(P, 0)
+            for uid in sorted(P["st"].seqs):
+                P["st"].release(uid)
+            P["st"].audit()
+            assert P["st"].allocator.free_blocks \
+                + P["st"].prefix_cache.cached_blocks \
+                == P["st"].allocator.num_blocks - 1, \
+                "pool failed to reconcile"
+            _check_no_stale(P["st"])
     except AssertionError as e:
         return f"final drain: {e}"
     return None
@@ -392,11 +481,15 @@ def test_interleaving_property_fast():
 @pytest.mark.slow
 def test_interleaving_property_500_plus():
     """The acceptance-criteria run: 600 seeded interleavings x 90 ops of
-    admit/dispatch/commit/flush/evict/spec (speculative provision →
-    accept-or-rollback rounds, mid-tree rejections included); every op is
-    followed by a full-pool ownership audit and a stale-page walk,
-    dispatched-but-uncommitted plans pin their pages (flush drains FIFO
-    first), and each trace must reconcile the pool exactly at the end."""
+    admit/dispatch/commit/flush/evict/spec/migrate over TWO pools
+    (speculative provision → accept-or-rollback rounds, mid-tree
+    rejections included; migrate_out/migrate_in/abort_migration at both
+    rollback stages, pinned-until-ack asserted inline); every op is
+    followed by a full-pool ownership audit and a stale-page walk on
+    BOTH pools, dispatched-but-uncommitted plans pin their pages (flush
+    drains FIFO first, migrate_out drains its uid first), and each trace
+    must reconcile both pools exactly at the end — no leaked or
+    double-owned block anywhere."""
     _property(600, ops_per_trace=90, seed0=10_000)
 
 
