@@ -1368,10 +1368,13 @@ def router_main():
 
 
 def _router_scenario(name, trace, fleet_kw, router_kw, kill_at=None,
-                     deadline_s=600.0):
+                     deadline_s=600.0, warmup=None):
     """Shared scenario driver for the router-backed modes: run ``trace``
     through a fresh Router, return the scorecard (goodput, latency
-    percentiles, migration/placement counters, per-tenant block)."""
+    percentiles, migration/placement counters, per-tenant block).
+    ``warmup`` records run to completion first, outside the measured
+    window — they seed the replicas' radix tries and residency digests
+    (the kv_pull scenario needs warm peers to pull FROM)."""
     from deepspeed_tpu.serving import (AdmissionError, FleetConfig, Router,
                                        RouterConfig)
     from deepspeed_tpu.telemetry import ROUTER_RUN_PREFIXES, get_telemetry
@@ -1389,6 +1392,16 @@ def _router_scenario(name, trace, fleet_kw, router_kw, kill_at=None,
     router = Router(cfg)
     try:
         router.start(min_ready=cfg.fleet.n_replicas)
+        if warmup:
+            for rec in warmup:
+                router.submit(rec.prompt, tenant=rec.tenant,
+                              max_new_tokens=rec.max_new_tokens,
+                              trace_id=f"warm-{rec.trace_id}")
+                router.poll()
+            router.run(deadline_s=deadline_s)
+            for _ in range(20):          # let the digests heartbeat in
+                router.poll()
+            telem.reset_metrics(prefix=ROUTER_RUN_PREFIXES)
         t1 = time.perf_counter()
         for i, rec in enumerate(trace):
             try:
@@ -1403,7 +1416,8 @@ def _router_scenario(name, trace, fleet_kw, router_kw, kill_at=None,
                     router.poll()
                 router.fleet.kill_replica(0)
             router.poll()
-        res = router.run(deadline_s=deadline_s)
+        res = {t: v for t, v in router.run(deadline_s=deadline_s).items()
+               if not t.startswith("warm-")}
         wall = time.perf_counter() - t1
         done = {t: v for t, v in res.items() if v["status"] == "done"}
         met = [v for v in done.values()
@@ -1445,6 +1459,19 @@ def _router_scenario(name, trace, fleet_kw, router_kw, kill_at=None,
             "migration_bytes": int(
                 _ctr("serving_router_migration_bytes_total")),
             "migration_stall": slo.get("serving_router_migration_stall_s"),
+            # fleet-wide KV reuse: placement-time radix pulls + the
+            # hot-replica rebalance actuator
+            "kv_pulls": router.kv_pulls,
+            "kv_pull_fallbacks": router.kv_pull_fallbacks,
+            "kv_pull_tokens": int(
+                _ctr("serving_router_kv_pull_tokens_total")),
+            "kv_pull_bytes": int(
+                _ctr("serving_router_kv_pull_bytes_total")),
+            "pulled_done": sum(1 for v in done.values()
+                               if v.get("pulled_pages", 0) > 0),
+            "rebalances": router.rebalances,
+            "rebalanced_done": sum(1 for v in done.values()
+                                   if v.get("rebalanced")),
             "retries": int(_ctr("serving_router_retries_total")),
             "double_commits": router.double_commits,
             "replay_mismatches": router.replay_mismatches,
@@ -1560,6 +1587,43 @@ def disagg_main():
         "disagg_split", trace,
         fleet_kw={**fkw, "replica": dict(replica),
                   "roles": ["prefill", "decode"]}, router_kw=rkw)
+
+    # kv_pull scenario: fleet-wide KV reuse vs recompute-only on a
+    # spillover-heavy shape — small per-replica capacity + long shared
+    # tenant prefixes, so same-tenant requests overflow their home
+    # replica and placement ships the chain (pull) instead of paying the
+    # prefill again (recompute). Same seeded trace both runs; shm rings
+    # enabled (the intra-host fast path).
+    pull_replica = dict(replica)
+    if backend != "engine":
+        # prefill costs real (simulated) device time here — that is the
+        # compute a pulled chain skips; chunk 16 = one page per step
+        pull_replica.update({"max_live": 4, "decode_delay_s": 0.002,
+                             "prefill_chunk": 16,
+                             "prefill_delay_s": 0.008})
+    pull_replica["shm_bytes"] = 1 << 20
+    pull_trace = synth_trace(TraceConfig(
+        n_requests=n_req, n_tenants=min(n_ten, 2),
+        prefix_len=max(prefix, 64), max_new_tokens=gen,
+        vocab=1024 if backend == "toy" else 255, seed=11))
+    pull_kw = {**rkw, "kv_pull": True, "kv_pull_min_pages": 1,
+               "rebalance": False}
+    # one warm request per tenant seeds its home replica's radix +
+    # residency digest; the measured burst then overflows tenants onto
+    # the OTHER replica — pull vs recompute is exactly that spillover
+    seen, pull_warm = set(), []
+    for rec in pull_trace:
+        if rec.tenant not in seen:
+            seen.add(rec.tenant)
+            pull_warm.append(rec)
+    pull_on = _router_scenario(
+        "disagg_pull", pull_trace,
+        fleet_kw={**fkw, "replica": dict(pull_replica)},
+        router_kw=pull_kw, warmup=pull_warm)
+    pull_off = _router_scenario(
+        "disagg_pull_off", pull_trace,
+        fleet_kw={**fkw, "replica": dict(pull_replica)},
+        router_kw={**pull_kw, "kv_pull": False}, warmup=pull_warm)
     print(json.dumps({
         "metric": f"{backend}-replica disagg: 1 prefill + 1 decode vs "
                   f"2 mixed, {n_req} reqs / {n_ten} tenants "
@@ -1571,6 +1635,19 @@ def disagg_main():
         "detail": {
             "mixed": mixed,
             "role_split": split,
+            "kv_pull": {
+                "pull_enabled": pull_on,
+                "recompute_only": pull_off,
+                "goodput_gain": round(
+                    pull_on["goodput_tok_s"]
+                    / max(pull_off["goodput_tok_s"], 1e-9), 3),
+                "note": "2 mixed replicas, per-replica capacity 4, "
+                        "same seeded spillover trace both runs; "
+                        "pull_enabled ships overflowed tenants' prefix "
+                        "chains cross-replica (kv_pull_tokens = prefill "
+                        "tokens NOT recomputed), recompute_only pays "
+                        "the prefill again",
+            },
             "baseline_note": "same seeded trace both scenarios; "
                              "vs_baseline = role-split goodput over "
                              "2-mixed goodput; role_split carries "

@@ -18,6 +18,13 @@ structurally (the check_import_time_devices.py shape):
   ``.communicate()`` with no positional arguments must carry a
   ``timeout=`` keyword (``d.get(key)``, ``path.join(a, b)`` and other
   argful calls are a different method entirely and stay legal);
+- ``.acquire(...)`` WITH positional arguments is held to the same rule
+  (``lock.acquire(True)`` blocks forever and used to slip past the
+  bare-call check) unless the first positional is the literal ``False``
+  (a non-blocking try-acquire) or a timeout is passed positionally as
+  the second argument. The shared-memory page ring (serving/shm.py) is
+  deliberately lock-free, and this rule keeps any future shm-ring
+  synchronization deadline-bounded;
 - ``.recv()`` / ``.recv_into()`` / ``.recvfrom()`` must carry a
   ``timeout=`` keyword — ``socket.recv`` cannot accept one, so raw
   socket reads are structurally banned and bounded reads go through
@@ -123,6 +130,19 @@ class _Visitor(ast.NodeVisitor):
                 and not has_timeout_kw:
             self._flag(node, f"bare .{name}() blocks forever — pass an "
                              f"explicit timeout=")
+        elif name == "acquire" and not has_timeout_kw \
+                and len(node.args) == 1 \
+                and isinstance(node.args[0], ast.Constant) \
+                and node.args[0].value is True:
+            # lock.acquire(True) blocks forever exactly like a bare
+            # acquire() but used to slip past the no-args check; pass
+            # timeout= (positional second arg also satisfies the lock
+            # API) or use a non-blocking acquire(False). Non-lock
+            # acquires (the prefix trie's acquire(nodes)) pass a
+            # non-literal first argument and stay legal.
+            self._flag(node, ".acquire(True) without a timeout blocks "
+                             "forever — pass timeout= or use a "
+                             "non-blocking acquire(False)")
         elif name in SELECT_MIN_ARGS and not has_timeout_kw \
                 and len(node.args) < SELECT_MIN_ARGS[name]:
             self._flag(node, f"{name}() without a timeout argument "

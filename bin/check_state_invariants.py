@@ -50,8 +50,14 @@ STATE_FILE = "deepspeed_tpu/inference/ragged.py"
 #: (rule, function name) pairs allowed inside STATE_FILE
 ALLOWED = {
     "allocator": {"_alloc", "release", "migrate_in_begin",
-                  "import_commit", "abort_import"},
-    "prefix_cache": {"admit", "release", "_alloc", "import_commit"},
+                  "import_commit", "abort_import", "adopt_prefix"},
+    #: snapshot_prefix/release_prefix/adopt_prefix are the cross-replica
+    #: radix-pull surface (placement-time distributed cache): the export
+    #: leg's gather-scoped pin and the import leg's unreferenced adopt
+    #: both mutate trie ownership and so must live behind the same
+    #: refcounted API as admit/release
+    "prefix_cache": {"admit", "release", "_alloc", "import_commit",
+                     "snapshot_prefix", "release_prefix", "adopt_prefix"},
     "blocks": {"admit", "migrate_in_begin", "import_commit",
                "abort_import"},
     "n_provisional": {"provision", "commit_speculative",

@@ -123,6 +123,27 @@ def feature_report() -> list[tuple[str, bool, str]]:
         feats.append(("serving: disaggregated prefill/decode", False,
                       str(e)))
 
+    # fleet-wide KV reuse (serving/shm.py + router kv_pull/rebalance):
+    # the shm ring needs a working POSIX shared-memory mount, so probe
+    # one for real — relay-only hosts still serve, just slower intra-host
+    try:
+        from .serving import shm as _shm
+        ring = _shm.open_ring(_shm.MIN_RING_BYTES)
+        have_shm = ring is not None
+        if ring is not None:
+            ring.close()
+        feats.append((
+            "serving: distributed prefix cache", True,
+            "placement-time cross-replica radix pulls (RouterConfig."
+            "kv_pull, cost-model gated, recompute-safe) + hot-replica "
+            "rebalancing; intra-host shm page ring "
+            + ("available" if have_shm else
+               "UNAVAILABLE (router relay only)")
+            + "; BENCH_MODE=disagg kv_pull scenario"))
+    except Exception as e:  # pragma: no cover — import breakage only
+        feats.append(("serving: distributed prefix cache", False,
+                      str(e)))
+
     # telemetry / monitor backends (telemetry/ + monitor/): which push
     # backends can actually activate, and where the pull endpoint +
     # flight recorder would land for this process

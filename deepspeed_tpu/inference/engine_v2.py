@@ -2703,6 +2703,90 @@ class InferenceEngineV2:
         self._results.pop(uid, None)
         self._rt.drop(uid)
 
+    # ------------------------------------------------------------------
+    # placement-time radix pulls (cross-replica distributed cache): a
+    # request placed on a replica without its prefix can pull the page
+    # chain from the peer that holds it instead of recomputing it. Same
+    # host-bounce wire form as migration (kind="prefix" PageBundle), no
+    # sequence involved: the export pin is gather-scoped and the import
+    # adopts unreferenced trie pages the arriving admit then hits.
+    # ------------------------------------------------------------------
+    def export_prefix(self, tokens, trace_id: str = "") -> "PageBundle":
+        """Bundle the longest cached chain prefixing ``tokens`` — or
+        raise if nothing is cached (the router counts it a pull
+        fallback and the puller recomputes)."""
+        from .migration import MigrationError, PageBundle
+
+        if self._prefix_cache is None or self._ring_tokens:
+            raise MigrationError("no shareable prefix cache on this pool")
+        snap = self.state.snapshot_prefix(tokens, trace=trace_id or None)
+        if snap is None:
+            raise MigrationError("prefix chain not cached")
+        try:
+            bs = self.config.block_size
+            with self._telem.span("kv_pull_export",
+                                  pages=len(snap["blocks"])):
+                pages_h = np.asarray(self.kv_pool[:, :, :, np.asarray(
+                    snap["blocks"], np.int32)])
+            blobs = [pages_h[:, :, :, j].tobytes()
+                     for j in range(len(snap["blocks"]))]
+        finally:
+            self.state.release_prefix(snap["handle"])
+        m = self.mcfg
+        page_bytes = (m.num_layers * 2 * m.kv_heads * bs * m.head_dim
+                      * np.dtype(self._kv_dtype).itemsize)
+        bundle = PageBundle.prefix(
+            trace_id, [int(t) for t in tokens[:snap["n_tokens"]]], bs,
+            np.dtype(self._kv_dtype).name, page_bytes, blobs)
+        bundle.validate()
+        self.stats["kv_pull_bytes_out"] = self.stats.get(
+            "kv_pull_bytes_out", 0) + bundle.payload_bytes
+        return bundle
+
+    def import_prefix(self, bundle: "PageBundle") -> int:
+        """Adopt a pulled chain into the local trie: allocate-and-adopt
+        through the refcounted API, then scatter the pulled payload into
+        exactly the freshly-inserted blocks (dedup'd pages keep the
+        cached copy — their device content is already correct). Returns
+        the pages now cache-resident; raises (and adopts nothing) on a
+        geometry/dtype mismatch or a pool too full to hold the chain."""
+        from .migration import MigrationError
+
+        bundle.validate()
+        if bundle.kind != "prefix":
+            raise MigrationError(f"not a prefix bundle ({bundle.kind})")
+        if self._prefix_cache is None or self._ring_tokens:
+            raise MigrationError("no shareable prefix cache on this pool")
+        if bundle.block_size != self.config.block_size:
+            raise MigrationError(
+                f"block_size mismatch: bundle {bundle.block_size}, "
+                f"pool {self.config.block_size}")
+        if bundle.kv_dtype != np.dtype(self._kv_dtype).name:
+            raise MigrationError(
+                f"kv dtype mismatch: bundle {bundle.kv_dtype}, pool "
+                f"{np.dtype(self._kv_dtype).name}")
+        m = self.mcfg
+        bs = self.config.block_size
+        want = (m.num_layers * 2 * m.kv_heads * bs * m.head_dim
+                * np.dtype(self._kv_dtype).itemsize)
+        if bundle.page_bytes != want:
+            raise MigrationError(
+                f"page geometry mismatch: bundle pages are "
+                f"{bundle.page_bytes}B, this pool's are {want}B")
+        fresh = self.state.adopt_prefix(bundle.tokens, bundle.n_computed,
+                                        trace=bundle.trace_id or None)
+        page_shape = (m.num_layers, 2, m.kv_heads, bs, m.head_dim)
+        dt = np.dtype(self._kv_dtype)
+        fn = self._import_page_fn()
+        with self._telem.span("kv_pull_import", pages=len(fresh)):
+            for j, block in fresh:
+                page = np.frombuffer(bundle.pages[j],
+                                     dtype=dt).reshape(page_shape)
+                self.kv_pool = fn(self.kv_pool, np.int32(block), page)
+        self.stats["kv_pull_bytes_in"] = self.stats.get(
+            "kv_pull_bytes_in", 0) + bundle.payload_bytes
+        return bundle.n_full
+
     def _record_dispatch_telemetry(self, kind: str, useful: int,
                                    budget: int, uids) -> None:
         """Dispatch-side SLO instruments: queue wait (admission → first

@@ -73,6 +73,12 @@ class PageBundle:
     page_bytes: int                     # serialized size of one full page
     tail_rows: int
     tail_bytes: int
+    #: "seq" = a live sequence's migratable state (disaggregated
+    #: handoff / rebalance: resumes decoding on the importer); "prefix" =
+    #: a bare cached page chain (placement-time radix pull: the importer
+    #: seeds its trie and the arriving request prefills from it — no
+    #: sequence exists, so every token is computed and page-aligned)
+    kind: str = "seq"
     chain: list[int] = field(default_factory=list)
     #: per-page quant-scale sidecar. The engine's fp8-KV pool is
     #: scale-free (e4m3 covers K/V activations), so this is None there;
@@ -92,11 +98,23 @@ class PageBundle:
     def validate(self) -> None:
         if not self.tokens:
             raise MigrationError("empty token chain")
-        if not 0 <= self.n_computed <= len(self.tokens) - 1:
+        if self.kind == "prefix":
+            # a pulled chain is exactly N cached full pages: no tail, no
+            # generation state, every token's KV present
+            if self.n_computed != len(self.tokens) \
+                    or self.n_computed % self.block_size \
+                    or self.tail_rows or self.n_generated:
+                raise MigrationError(
+                    f"prefix bundle must be whole full pages "
+                    f"(n_computed {self.n_computed}, tokens "
+                    f"{len(self.tokens)}, tail {self.tail_rows}, "
+                    f"generated {self.n_generated})")
+        elif not 0 <= self.n_computed <= len(self.tokens) - 1:
             raise MigrationError(
                 f"n_computed {self.n_computed} outside "
                 f"[0, {len(self.tokens) - 1}]")
-        if self.n_generated != len(self.tokens) - self.prompt_len:
+        if self.kind != "prefix" \
+                and self.n_generated != len(self.tokens) - self.prompt_len:
             raise MigrationError(
                 f"token chain of {len(self.tokens)} disagrees with "
                 f"prompt {self.prompt_len} + generated {self.n_generated}")
@@ -124,6 +142,7 @@ class PageBundle:
                 "bs": self.block_size, "dtype": self.kv_dtype,
                 "page_bytes": self.page_bytes,
                 "tail_rows": self.tail_rows, "tail_bytes": self.tail_bytes,
+                "kind": self.kind,
                 "chain": list(self.chain), "scales": self.scales}
 
     @classmethod
@@ -143,17 +162,44 @@ class PageBundle:
                    page_bytes=int(meta["page_bytes"]),
                    tail_rows=int(meta["tail_rows"]),
                    tail_bytes=int(meta["tail_bytes"]),
+                   kind=str(meta.get("kind", "seq")),
                    chain=[int(h) for h in meta["chain"]],
                    scales=meta.get("scales"))
 
+    @classmethod
+    def prefix(cls, trace_id: str, tokens: list[int], block_size: int,
+               kv_dtype: str, page_bytes: int,
+               pages: list[bytes]) -> "PageBundle":
+        """A bare cached-chain bundle (placement-time radix pull):
+        ``tokens`` must be exactly ``len(pages)`` full pages of prompt
+        prefix; the importer adopts the pages into its trie unreferenced
+        and the pulling request prefills from the cached boundary."""
+        chain = chain_hashes(tokens, block_size)
+        if len(chain) != len(pages) \
+                or len(tokens) != len(pages) * block_size:
+            raise MigrationError(
+                f"prefix bundle geometry: {len(tokens)} tokens, "
+                f"{len(pages)} pages of {block_size}")
+        return cls(trace_id=trace_id, tokens=list(tokens),
+                   prompt_len=len(tokens), n_computed=len(tokens),
+                   n_generated=0, max_new_tokens=0, eos_id=None,
+                   tenant="", block_size=block_size, kv_dtype=kv_dtype,
+                   page_bytes=page_bytes, tail_rows=0, tail_bytes=0,
+                   kind="prefix", chain=chain, scales=None,
+                   pages=list(pages), tail=None)
 
-def iter_chunks(bundle: PageBundle,
-                max_bytes: int = CHUNK_BYTES) -> list[dict]:
+
+def iter_chunks(bundle: PageBundle, max_bytes: int = CHUNK_BYTES,
+                encode: bool = True) -> list[dict]:
     """Slice a bundle's payload into self-describing wire chunks:
     ``{"i": chunk id, "p": page index (-1 = tail), "o": offset within the
     page, "n": raw bytes, "crc": crc32, "data": base64}``. Chunk ids are
     dense ``0..len-1`` — the EOF message carries the count and a receiver
-    names gaps by id."""
+    names gaps by id. ``encode=False`` carries the payload as ``"raw"``
+    bytes instead of base64 ``"data"`` (NOT wire-ready): the shm
+    transport writes the raw bytes straight into its ring and only
+    base64s the chunks that fall back to inline, skipping a pointless
+    encode+decode pass over every transferred byte."""
     out: list[dict] = []
     payloads = [(j, p) for j, p in enumerate(bundle.pages)]
     if bundle.tail:
@@ -162,9 +208,13 @@ def iter_chunks(bundle: PageBundle,
     for p, blob in payloads:
         for o in range(0, len(blob), max_bytes):
             raw = blob[o:o + max_bytes]
-            out.append({"i": i, "p": p, "o": o, "n": len(raw),
-                        "crc": zlib.crc32(raw),
-                        "data": base64.b64encode(raw).decode("ascii")})
+            c = {"i": i, "p": p, "o": o, "n": len(raw),
+                 "crc": zlib.crc32(raw)}
+            if encode:
+                c["data"] = base64.b64encode(raw).decode("ascii")
+            else:
+                c["raw"] = raw
+            out.append(c)
             i += 1
     return out
 
@@ -182,7 +232,13 @@ class BundleAssembler:
         self.bytes_received = 0
 
     def add(self, msg: dict) -> None:
-        raw = base64.b64decode(msg["data"])
+        self.add_raw(msg, base64.b64decode(msg["data"]))
+
+    def add_raw(self, msg: dict, raw: bytes) -> None:
+        """Ingest a chunk whose payload arrived OUT of band (the
+        shared-memory transport: the descriptor rode the line protocol,
+        ``raw`` was copied from the exporter's ring). Same crc gate as
+        the in-band path — a lapped ring extent can never be adopted."""
         if len(raw) != int(msg["n"]) or zlib.crc32(raw) != int(msg["crc"]):
             raise MigrationError(
                 f"chunk {msg.get('i')} failed its crc — torn transfer")
@@ -266,6 +322,21 @@ def toy_bundle(trace_id: str, prompt: list[int], generated: list[int],
         tail_bytes=len(tail or b""),
         chain=chain, scales=None,
         pages=[toy_page_payload(h) for h in chain], tail=tail)
+
+
+def toy_prefix_bundle(trace_id: str, tokens: list[int],
+                      block_size: int) -> PageBundle | None:
+    """Prefix-pull export for the toy backend: bundle the full pages of
+    ``tokens`` (already truncated to the cached extent by the caller)
+    with chain-derived payloads the importer verifies."""
+    n_full = len(tokens) // block_size
+    if n_full == 0:
+        return None
+    aligned = tokens[:n_full * block_size]
+    chain = chain_hashes(aligned, block_size)
+    return PageBundle.prefix(trace_id, aligned, block_size, "toy",
+                             TOY_PAGE_BYTES,
+                             [toy_page_payload(h) for h in chain])
 
 
 def toy_verify(bundle: PageBundle) -> None:

@@ -215,6 +215,11 @@ class StateManager:
         # serving-tier trace IDs of in-flight imports (uid -> trace),
         # emitted on the migrate_in lifecycle event at import_commit
         self._mig_trace: dict[int, str | None] = {}
+        # cross-replica radix pulls: node chains pinned by an in-flight
+        # prefix export (handle -> list[PageNode]; snapshot_prefix /
+        # release_prefix), counted by audit() alongside sequence shares
+        self._pull_pins: dict[int, list] = {}
+        self._pull_ctr = 0
 
     def attach_prefix_cache(self, cache) -> None:
         """Enable shared-prefix serving (engine init, linear tables only —
@@ -691,6 +696,76 @@ class StateManager:
             self._free_slots.append(seq.slot)
             self._free_slots.sort()
 
+    # --- cross-replica radix pulls (placement-time distributed cache) ----
+    # A request placed on a replica WITHOUT its prefix can pull the page
+    # chain from the peer that holds it instead of recomputing it
+    # (serving/router.py decides pull-vs-recompute; the wire form is a
+    # kind="prefix" PageBundle). These three methods are the refcounted
+    # surface for both legs — bin/check_state_invariants.py pins every
+    # trie/allocator mutation they need to exactly these sites.
+
+    def snapshot_prefix(self, tokens, trace: str | None = None) -> dict | None:
+        """Export leg: match + PIN the longest cached chain prefixing
+        ``tokens`` so the caller can read the page payloads while nothing
+        evicts them. Returns ``{"handle", "blocks", "n_tokens"}`` or None
+        on a miss; the caller MUST ``release_prefix(handle)`` once the
+        payload is copied out (the pin is gather-scoped, not
+        pinned-until-ack: the importer adopts a COPY — the source keeps
+        and keeps serving its own pages)."""
+        if self.prefix_cache is None:
+            return None
+        nodes = self.prefix_cache.match(tokens)
+        if not nodes:
+            return None
+        self.prefix_cache.acquire(nodes)
+        self._pull_ctr += 1
+        handle = self._pull_ctr
+        self._pull_pins[handle] = nodes
+        rt = self.reqtrace
+        if rt is not None and rt.enabled:
+            rt.event(-1, "kv_pull", dir="out", pages=len(nodes),
+                     trace=trace)
+        return {"handle": handle, "blocks": [n.block for n in nodes],
+                "n_tokens": len(nodes) * self.block_size}
+
+    def release_prefix(self, handle: int) -> None:
+        """Drop a prefix export's pins (pages stay cached, LRU-able)."""
+        nodes = self._pull_pins.pop(handle, None)
+        if nodes:
+            self.prefix_cache.release(nodes)
+
+    def adopt_prefix(self, tokens, n_tokens: int,
+                     trace: str | None = None) -> list[tuple[int, int]]:
+        """Import leg: allocate a block per full page of
+        ``tokens[:n_tokens]`` and insert the chain into the trie
+        UNREFERENCED (no sequence owns a pull — the pages are ordinary
+        LRU-evictable cache entries the arriving request's admit will
+        pin through the normal match path). Pages another sequence
+        already published dedup: their fresh blocks go straight back to
+        the allocator and the cached copy serves. Returns ``(page index,
+        block)`` for the freshly-inserted pages — the engine scatters the
+        pulled payload into exactly those blocks before anything else can
+        schedule against them (same host operation). Raises RuntimeError
+        when the pool cannot fit the chain (caller falls back to
+        recompute)."""
+        bs = self.block_size
+        n_full = min(n_tokens, len(tokens)) // bs
+        if self.prefix_cache is None or n_full == 0:
+            return []
+        blocks = self._alloc(n_full)
+        nodes, dups = self.prefix_cache.adopt(tokens, blocks,
+                                              n_full * bs)
+        self.prefix_cache.release(nodes)
+        if dups:
+            self.allocator.free(dups)
+        fresh = [(j, nodes[j].block) for j in range(n_full)
+                 if nodes[j].block == blocks[j]]
+        rt = self.reqtrace
+        if rt is not None and rt.enabled:
+            rt.event(-1, "kv_pull", dir="in", pages=n_full,
+                     fresh=len(fresh), trace=trace)
+        return fresh
+
     def audit(self) -> None:
         """Debug-mode FULL-POOL audit: every non-trash block is owned by
         exactly one of {free list, prefix trie, one sequence's owned
@@ -756,6 +831,16 @@ class StateManager:
                         f"block {b} owned by uid {uid} AND {owners[b]}")
                 else:
                     owners[b] = f"uid {uid}"
+        # an in-flight prefix export (snapshot_prefix) pins its chain like
+        # a sequence does — gather-scoped, but the refcounts must balance
+        # at any instant the caller audits
+        for nodes in self._pull_pins.values():
+            for node in nodes:
+                if node.block not in trie_blocks:
+                    raise AssertionError(
+                        f"pull pin on block {node.block} the trie no "
+                        f"longer owns")
+                ref_counts[node.block] = ref_counts.get(node.block, 0) + 1
         if self.prefix_cache is not None:
             for node in self.prefix_cache._nodes():
                 expect = ref_counts.get(node.block, 0)

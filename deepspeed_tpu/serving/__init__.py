@@ -20,20 +20,24 @@ seeded multi-tenant traces the bench and chaos suites replay.
 See README.md "Serving fleet" / "Disaggregated serving" for topology,
 knobs, and runbooks.
 """
-from .disagg import MigrationState, ROLES, ScaleAdvisor
+from .disagg import MigrationState, RebalancePolicy, ROLES, ScaleAdvisor
 from .fleet import Fleet, FleetConfig
-from .placement import StickyMap, chain_hashes, match_pages, pick_replica
+from .placement import (StickyMap, best_digest_peer, chain_hashes,
+                        match_pages, pick_replica, pull_beats_recompute)
 from .protocol import (ChannelClosed, ChannelTimeout, LineChannel,
                        RequestRecord, poll_channels)
 from .router import AdmissionError, Router, RouterConfig
+from .shm import ShmReader, ShmRing, attach_ring, open_ring
 from .transport import SocketChannel, SocketListener, connect_channel
 from .workload import TraceConfig, synth_trace
 
 __all__ = [
     "AdmissionError", "ChannelClosed", "ChannelTimeout", "Fleet",
     "FleetConfig", "LineChannel", "MigrationState", "ROLES",
-    "RequestRecord", "Router", "RouterConfig", "ScaleAdvisor",
-    "SocketChannel", "SocketListener", "StickyMap", "TraceConfig",
-    "chain_hashes", "connect_channel", "match_pages", "pick_replica",
-    "poll_channels", "synth_trace",
+    "RebalancePolicy", "RequestRecord", "Router", "RouterConfig",
+    "ScaleAdvisor", "ShmReader", "ShmRing", "SocketChannel",
+    "SocketListener", "StickyMap", "TraceConfig", "attach_ring",
+    "best_digest_peer", "chain_hashes", "connect_channel", "match_pages",
+    "open_ring", "pick_replica", "poll_channels", "pull_beats_recompute",
+    "synth_trace",
 ]

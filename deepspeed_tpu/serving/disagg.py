@@ -45,14 +45,24 @@ DECODE_CAPABLE = (ROLE_DECODE, ROLE_MIXED)
 
 @dataclass
 class MigrationState:
-    """Router-side bookkeeping for one in-flight handoff. The router
+    """Router-side bookkeeping for one in-flight transfer. The router
     buffers the source's chunks verbatim (re-tagged with the target's
     attempt nonce on relay), which is what makes the target leg
-    resumable — and a target failure cheap to retry."""
+    resumable — and a target failure cheap to retry. Shared-memory
+    chunks are descriptors (``ref`` instead of ``data``): the buffer is
+    then bytes-light and the payload lives in the source's ring until
+    the importer copies it out (a lapped extent fails its crc and the
+    importer asks for a relay resend; ``relayed`` remembers the fallback
+    engaged, for the ack-time transport label)."""
     meta: dict
     src_slot: int
     src_epoch: int
     started_t: float
+    #: "handoff" (prefill->decode role split) | "rebalance" (router
+    #: pulled a mid-decode victim off a hot replica — aborts RESUME the
+    #: source instead of replaying) | "pull" (placement-time radix pull;
+    #: failure just means the puller recomputes)
+    kind: str = "handoff"
     #: chunk id -> wire message (as received from the source)
     chunks: dict[int, dict] = field(default_factory=dict)
     total: int | None = None
@@ -61,12 +71,30 @@ class MigrationState:
     tgt_slot: int = -1
     resends: int = 0
     payload_bytes: int = 0
+    #: the source's attempt nonce before the relay bumped it — a
+    #: rebalance abort restores the request to this (slot, nonce) so the
+    #: resumed source stream is not dropped as stale
+    src_attempt: int = 0
+    #: the source ring's segment name (shm transport), passed through to
+    #: the target so it can attach; None = base64 relay chunks
+    shm: str | None = None
+    #: the shm relay fallback engaged at least once (the ack-time
+    #: transport label — a transfer that needed inline bytes was NOT an
+    #: shm transfer)
+    relayed: bool = False
 
     def add_chunk(self, msg: dict) -> None:
         i = int(msg["i"])
         if i not in self.chunks:
             self.payload_bytes += int(msg.get("n", 0))
         self.chunks[i] = msg
+
+    @property
+    def buffered_bytes(self) -> int:
+        """Router-held buffer weight (the GC gauge): inline payload is
+        ~4/3 its raw size on the wire; descriptors are a few dozen bytes."""
+        return sum(len(c.get("data", "")) or 64
+                   for c in self.chunks.values())
 
     @property
     def complete(self) -> bool:
@@ -175,3 +203,68 @@ class ScaleAdvisor:
                          "scale-down on sustained idle — signals only, "
                          "no actuator").set(v)
         return hints
+
+
+class RebalancePolicy:
+    """Hot-replica rebalancing: WHEN to migrate a mid-decode sequence off
+    a saturated replica, and where. The mechanism is PR-9's migration
+    primitive (the router asks the hot replica to hand a victim off, the
+    normal handoff relay moves it); this class is only the trigger, so
+    every anti-flap control lives in one place:
+
+    - **sustain**: a slot is hot only after its decode-capable occupancy
+      (heartbeat ``live`` over capacity) stays >= ``hot_util`` for
+      ``sustain_s`` straight — a one-tick spike never migrates anything.
+    - **hysteresis band**: the destination must sit at or below
+      ``idle_util`` (well under ``hot_util``), so a migration can never
+      make the target hot enough to migrate straight back.
+    - **rate limit**: at most one victim per ``min_interval_s``
+      fleet-wide; the router additionally rebalances any given request
+      at most once (its ``rebalanced`` flag), so a sequence can never
+      ping-pong.
+
+    ``pick(now, handles)`` returns ``(hot_handle, peer_handle)`` or None;
+    the caller (router) chooses the victim — the YOUNGEST mid-decode
+    sequence, because it has the least KV to ship and the most decode
+    left to amortize the move — and checks digest compatibility."""
+
+    def __init__(self, hot_util: float = 0.85, idle_util: float = 0.5,
+                 sustain_s: float = 2.0, min_interval_s: float = 1.0):
+        self.hot_util = hot_util
+        self.idle_util = idle_util
+        self.sustain_s = sustain_s
+        self.min_interval_s = min_interval_s
+        self._hot_since: dict[int, float] = {}
+        self._last_t = 0.0
+
+    @staticmethod
+    def _util(h) -> float:
+        cap = max(getattr(h, "max_live", 1), 1)
+        return float((h.load or {}).get("live", 0)) / cap
+
+    def pick(self, now: float, handles) -> tuple | None:
+        """``handles``: READY decode-capable replica handles. Updates the
+        sustain clocks every call; returns a (hot, idle-peer) pair only
+        when every anti-flap gate passes."""
+        hot_cand = None
+        for h in handles:
+            if self._util(h) >= self.hot_util:
+                self._hot_since.setdefault(h.slot, now)
+                if now - self._hot_since[h.slot] >= self.sustain_s and (
+                        hot_cand is None
+                        or self._util(h) > self._util(hot_cand)):
+                    hot_cand = h
+            else:
+                self._hot_since.pop(h.slot, None)
+        if hot_cand is None or now - self._last_t < self.min_interval_s:
+            return None
+        peers = [h for h in handles if h.slot != hot_cand.slot
+                 and self._util(h) <= self.idle_util]
+        if not peers:
+            return None
+        peer = min(peers, key=lambda h: (self._util(h), h.slot))
+        self._last_t = now
+        return hot_cand, peer
+
+    def note_slot_died(self, slot: int) -> None:
+        self._hot_since.pop(slot, None)

@@ -79,6 +79,9 @@ class ReplicaHandle:
         self.last_msg_t = 0.0
         self.load: dict | None = None
         self.digest: set[int] | None = None
+        #: the replica's shared-memory page ring segment name (shm
+        #: transport, serving/shm.py); None = relay-only peer
+        self.shm: str | None = None
         self.max_live = 0
         self.block_size = 0
         cfg = self._config()
@@ -123,7 +126,7 @@ class ReplicaHandle:
             from .transport import connect_channel
 
             self.state = SPAWNING
-            self.load = self.digest = None
+            self.load = self.digest = self.shm = None
             self.last_msg_t = time.monotonic()
             try:
                 self.chan = connect_channel(
@@ -163,7 +166,7 @@ class ReplicaHandle:
         self.chan = LineChannel(self.proc.stdout.fileno(),
                                 self.proc.stdin.fileno(), own_fds=False)
         self.state = SPAWNING
-        self.load = self.digest = None
+        self.load = self.digest = self.shm = None
         self.last_msg_t = time.monotonic()
         logger.info(f"fleet: slot {self.slot} spawned epoch {self.epoch} "
                     f"(pid {self.proc.pid})")
@@ -327,6 +330,7 @@ class Fleet:
         r.state = READY
         r.max_live = int(msg.get("max_live", 1))
         r.block_size = int(msg.get("block_size", 0))
+        r.shm = msg.get("shm") or None
         # the worker's own view of its role wins (a remote daemon's
         # config lives with the daemon, not the fleet)
         r.role = str(msg.get("role", r.role))

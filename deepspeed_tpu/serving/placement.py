@@ -83,6 +83,46 @@ class StickyMap:
             del self._m[h]
 
 
+def best_digest_peer(chain: list[int], handles,
+                     exclude_slot: int = -1) -> tuple[object | None, int]:
+    """Deepest residency-digest match for ``chain`` across ``handles``,
+    excluding one slot (the replica the request was just placed on).
+    Returns ``(handle, matched_pages)`` — the pull-source candidate for
+    placement-time radix pulls. Ties break toward the lower slot
+    (determinism: chaos tests replay placement). Only the DIGEST counts
+    here, never the sticky map: a pull ships real pages, so the source
+    must actually hold them."""
+    best, pages = None, 0
+    for h in handles:
+        if h.slot == exclude_slot:
+            continue
+        m = match_pages(chain, h.digest)
+        if m > pages or (m == pages and m > 0 and best is not None
+                         and h.slot < best.slot):
+            best, pages = h, m
+    return best, pages
+
+
+def pull_beats_recompute(extra_tokens: int, page_bytes: int,
+                         block_size: int, prefill_tok_s: float,
+                         xfer_bytes_s: float,
+                         overhead_s: float = 0.0) -> bool:
+    """The pull-vs-recompute cost model: ship the chain only when the
+    estimated transfer time (pages over the transport's byte rate, plus
+    a fixed per-transfer overhead for the control round-trips) beats the
+    estimated prefill time (tokens over the replica's prefill rate).
+    Recompute is the always-safe fallback, so every estimate errs toward
+    recompute: unknown page geometry (``page_bytes`` 0 — no bundle seen
+    yet) assumes the transfer is cheap only for the decision's FIRST leg
+    and lets the deadline machinery bound the real cost."""
+    if extra_tokens <= 0:
+        return False
+    prefill_s = extra_tokens / max(prefill_tok_s, 1e-9)
+    pages = -(-extra_tokens // max(block_size, 1))
+    xfer_s = overhead_s + pages * page_bytes / max(xfer_bytes_s, 1e-9)
+    return xfer_s < prefill_s
+
+
 def pick_replica(candidates: list, chain: list[int],
                  sticky: StickyMap | None = None) -> tuple[object, int]:
     """Choose a replica for a request whose prompt chain is ``chain``.

@@ -31,8 +31,29 @@ Message vocabulary (``t`` is the type tag)::
                                             the pinned export
     {"t":"mig_abort","id":str}              migration dead: drop the
                                             pinned export entirely
-    {"t":"mig_resume","id":str}             no decode-capable replica:
-                                            unfreeze and keep decoding
+    {"t":"mig_resume","id":str}             no decode-capable replica (or
+                                            a rebalance died): unfreeze
+                                            and keep decoding
+    {"t":"mig_request","id":str}            rebalancing: freeze + hand
+                                            this mid-decode sequence off
+    {"t":"mig_relay","id":str,"missing":[int]}  the importer could not
+                                            read the source's shm ring:
+                                            resend those chunks inline
+    {"t":"kv_req","id":str,"a":int,"tok":[int]}  placement-time radix
+                                            pull: export your cached
+                                            chain prefixing these tokens
+    {"t":"kv_relay","id":str,"missing":[int]}    inline resend for a
+                                            pull whose shm leg failed
+    {"t":"kv_bundle","id":str,"a":int,"meta":{...},"chunks":int,
+     "shm":str|null}                        a pulled chain is arriving
+                                            (router -> puller relay; the
+                                            same shape travels peer ->
+                                            router on the export leg)
+    {"t":"kv_chunk",...}/{"t":"kv_eof",...} pull payload (mig_chunk
+                                            shape; "ref" replaces "data"
+                                            on the shm transport)
+    {"t":"kv_fail","id":str}                pull dead: admit the held
+                                            request and recompute
 
   replica -> router
     {"t":"ready","pid":int,"block_size":int,"max_live":int,"epoch":int,
@@ -53,9 +74,20 @@ Message vocabulary (``t`` is the type tag)::
     {"t":"mig_ack","id":str,"a":int}        import committed (decode
                                             role): the stream continues
                                             here
-    {"t":"mig_need","id":str,"a":int,"missing":[int]}  gaps after EOF —
-                                            resend exactly these chunk
-                                            ids (resumable transfer)
+    {"t":"mig_need","id":str,"a":int,"missing":[int],"relay":bool}
+                                            gaps after EOF — resend
+                                            exactly these chunk ids
+                                            (resumable transfer); relay
+                                            additionally asks the SOURCE
+                                            for inline payload (the shm
+                                            ring was unreadable here)
+    {"t":"kv_need","id":str,"a":int,"missing":[int],"relay":bool}
+                                            same, for a pulled chain
+    {"t":"kv_ack","id":str,"a":int,"pages":int,"bytes":int}  pull
+                                            settled: pages adopted (0 =
+                                            recompute fallback engaged)
+    {"t":"kv_none","id":str,"a":int}        chain not cached here (pull
+                                            export miss)
     {"t":"bye"}                             clean shutdown ack
 
 Deadlines are LAW here (bin/check_deadlines.py lints this package): every

@@ -69,6 +69,9 @@ class ToyBackend:
         self.prefill_chunk = int(cfg.get("prefill_chunk", 64))
         self.tokens_per_step = int(cfg.get("tokens_per_step", 4))
         self.decode_delay_s = float(cfg.get("decode_delay_s", 0.0))
+        #: simulated per-prefill-step device time: what a cache hit (or
+        #: a pulled chain) SKIPS — the kv_pull bench's compute model
+        self.prefill_delay_s = float(cfg.get("prefill_delay_s", 0.0))
         #: disaggregated serving role (serving/disagg.py): "prefill"
         #: freezes each sequence after its first sampled token and hands
         #: it off; "decode"/"mixed" serve to completion (a decode replica
@@ -89,6 +92,7 @@ class ToyBackend:
         self._imports: dict[str, object] = {}   # rid -> BundleAssembler
         self.migrations_out = 0
         self.migrations_in = 0
+        self.pulled_pages = 0              # radix pages adopted via pulls
 
     def has_work(self) -> bool:
         return bool(self.seqs)
@@ -156,6 +160,8 @@ class ToyBackend:
                 if inj.countdown("replica_crash_during_prefill"):
                     inj.crash_now("replica_crash_during_prefill",
                                   f"prefill of {rid}")
+                if self.prefill_delay_s:
+                    time.sleep(self.prefill_delay_s)
                 seq["prefill_left"] -= min(self.prefill_chunk,
                                            seq["prefill_left"])
                 continue
@@ -199,26 +205,92 @@ class ToyBackend:
         return events
 
     # -- KV-page migration (disaggregated serving) -----------------------
+    def request_handoff(self, rid: str) -> bool:
+        """Rebalancing (router-initiated): freeze a mid-decode sequence
+        for export at the next step boundary. Refused (False) when the
+        sequence is gone, still prefilling, already migrating, or has
+        nothing generated yet — the router's view lags and a stale
+        request must be a no-op."""
+        seq = self.seqs.get(rid)
+        if seq is None or rid not in self.order or rid in self._exports \
+                or seq.get("importing") or seq["prefill_left"] > 0 \
+                or not seq["generated"]:
+            return False
+        self.order.remove(rid)
+        self._handoff.append(rid)
+        return True
+
+    def _bundle_of(self, rid: str):
+        from ..inference.migration import toy_bundle
+
+        seq = self.seqs[rid]
+        rec = seq["rec"]
+        return toy_bundle(rid, list(rec.prompt), list(seq["generated"]),
+                          rec.max_new_tokens, rec.eos_token_id,
+                          rec.tenant, self.block_size)
+
     def take_handoffs(self) -> list[tuple]:
-        """Bundle every sequence that crossed the prefill->decode
-        boundary this step: ``(rid, PageBundle, catchup, off)`` — catchup
+        """Bundle every sequence frozen for transfer this step — prefill
+        sequences that crossed the decode boundary plus router-requested
+        rebalance victims: ``(rid, PageBundle, catchup, off)`` — catchup
         is always empty for the toy (every generated token was streamed
         as a chunk already). Pages are synthetic chain-derived payloads
         (migration.toy_page_payload) the importer VERIFIES, so the chaos
         suite proves transfer integrity, not just bookkeeping."""
-        from ..inference.migration import toy_bundle
-
         out = []
         for rid in self._handoff:
-            seq = self.seqs[rid]
-            rec = seq["rec"]
-            self._exports[rid] = seq
-            out.append((rid, toy_bundle(
-                rid, list(rec.prompt), list(seq["generated"]),
-                rec.max_new_tokens, rec.eos_token_id, rec.tenant,
-                self.block_size), [], 0))
+            self._exports[rid] = self.seqs[rid]
+            out.append((rid, self._bundle_of(rid), [], 0))
         self._handoff = []
         return out
+
+    def export_chunks(self, rid: str, max_bytes: int | None = None):
+        """Re-chunk a pinned export WITH inline payload (the shm-relay
+        fallback: the importer could not read the ring, the source owes
+        the bytes). The frozen sequence regenerates the identical bundle
+        — toy payloads are pure functions of the chain."""
+        from ..inference.migration import CHUNK_BYTES, iter_chunks
+
+        if rid not in self._exports:
+            return None
+        return iter_chunks(self._bundle_of(rid),
+                           max_bytes or CHUNK_BYTES)
+
+    # -- placement-time radix pulls (distributed prefix cache) -----------
+    def kv_export(self, tokens: list[int]):
+        """Export the longest locally-cached chain prefixing ``tokens``
+        as a kind="prefix" bundle (or None on a miss). No pin outlives
+        this call: payloads are chain-derived, the importer adopts a
+        copy."""
+        from ..inference.migration import toy_prefix_bundle
+
+        nodes = self.radix.match(tokens)
+        if not nodes:
+            return None
+        return toy_prefix_bundle(
+            "", tokens[:len(nodes) * self.block_size], self.block_size)
+
+    def adopt_prefix(self, bundle) -> int:
+        """Seed the local radix from a pulled chain (verifying payload
+        integrity first); the pulling request's admit then hits these
+        pages through the normal match path. Returns pages adopted, 0 on
+        a corrupt bundle (caller recomputes)."""
+        from ..inference.migration import MigrationError, toy_verify
+
+        try:
+            toy_verify(bundle)
+        except MigrationError:
+            return 0
+        nodes, _ = self.radix.adopt(
+            bundle.tokens,
+            [self._fresh_block() for _ in range(bundle.n_full)],
+            bundle.n_full * self.block_size)
+        self.radix.release(nodes)
+        self.pulled_pages += bundle.n_full
+        over = len(self.radix) - self.cache_pages
+        if over > 0:
+            self.radix.evict(over)
+        return bundle.n_full
 
     def export_commit(self, rid: str) -> None:
         """Importer acked: publish the computed pages into the local trie
@@ -267,14 +339,18 @@ class ToyBackend:
                           "generated": [], "prefill_left": 0, "seed": 0}
         return None
 
-    def import_chunk(self, rid: str, msg: dict) -> str | None:
+    def import_chunk(self, rid: str, msg: dict,
+                     raw: bytes | None = None) -> str | None:
         from ..inference.migration import MigrationError
 
         asm = self._imports.get(rid)
         if asm is None:
             return "import_failed"
         try:
-            asm.add(msg)
+            if raw is not None:
+                asm.add_raw(msg, raw)    # shm payload, crc still gates
+            else:
+                asm.add(msg)
         except MigrationError:
             return "import_failed"
         return None
@@ -393,10 +469,13 @@ class EngineBackend:
         self._sent: dict[str, int] = {}          # rid -> tokens streamed
         self._tenants: dict[str, str] = {}       # rid -> tenant label
         self._exports: dict[str, int] = {}       # rid -> frozen uid
+        self._export_bundles: dict[str, object] = {}  # rid -> PageBundle
         self._imports: dict[str, object] = {}    # rid -> BundleAssembler
         self._resumed: set[str] = set()          # mig_resume'd: serve local
+        self._handoff_req: set[str] = set()      # rebalance victims
         self.migrations_out = 0
         self.migrations_in = 0
+        self.pulled_pages = 0
 
     def has_work(self) -> bool:
         return bool(self._uids) or bool(self.eng._inflight)
@@ -422,9 +501,11 @@ class EngineBackend:
     def cancel(self, rid: str) -> None:
         uid = self._uids.pop(rid, None)
         self._exports.pop(rid, None)
+        self._export_bundles.pop(rid, None)
         self._imports.pop(rid, None)
         self._tenants.pop(rid, None)
         self._resumed.discard(rid)
+        self._handoff_req.discard(rid)
         if uid is not None:
             # engine flush settles any pinned migration state itself
             # (export_abort / abort_import) before releasing
@@ -464,19 +545,38 @@ class EngineBackend:
         return events
 
     # -- KV-page migration (disaggregated serving) -----------------------
+    def request_handoff(self, rid: str) -> bool:
+        """Rebalancing: flag a mid-decode sequence for export at the
+        next exportable step boundary (the pipeline may need a step or
+        two to drain). Stale requests no-op."""
+        uid = self._uids.get(rid)
+        if uid is None or rid in self._exports or rid in self._imports:
+            return False
+        seq = self.eng.state.seqs.get(uid)
+        if seq is None or seq.done or seq.frozen or seq.n_generated < 1:
+            return False
+        self._handoff_req.add(rid)
+        return True
+
     def take_handoffs(self) -> list[tuple]:
-        """Freeze + bundle every sequence past the prefill->decode
-        boundary (first committed token). The export drains the async
-        pipeline for that uid, so the bundle may carry a couple more
-        committed tokens than were streamed — the catchup chunk closes
-        that gap so the router's committed prefix stays continuous."""
+        """Freeze + bundle every exportable sequence: past the
+        prefill->decode boundary (first committed token) for a
+        prefill-role replica, router-requested rebalance victims on any
+        role. The export drains the async pipeline for that uid, so the
+        bundle may carry a couple more committed tokens than were
+        streamed — the catchup chunk closes that gap so the router's
+        committed prefix stays continuous."""
         out = []
         for rid, uid in list(self._uids.items()):
+            if self.role != "prefill" and rid not in self._handoff_req:
+                continue
             if rid in self._exports or rid in self._resumed:
                 continue
             seq = self.eng.state.seqs.get(uid)
             if seq is None or seq.done or seq.frozen \
                     or seq.n_generated < 1 or seq.pending_tokens != 1:
+                if seq is None or seq.done:
+                    self._handoff_req.discard(rid)
                 continue
             try:
                 bundle = self.eng.export_migration(
@@ -484,13 +584,21 @@ class EngineBackend:
                     tenant=self._tenants.get(rid, "default"))
             except RuntimeError as e:
                 logger.warning(f"replica: export of {rid} refused: {e}")
+                # a refused rebalance victim is refused for good (ring
+                # pools, provisional trees): drop the request, don't
+                # retry-and-log every event-loop step — the router's ask
+                # TTL re-marks the victim so it is never picked again
+                self._handoff_req.discard(rid)
                 continue
             if self.eng.state.seqs[uid].done:
                 # the drain finished it — no handoff, the done-scan in
                 # the next step() surfaces it (abort unfreezes nothing
                 # here because migrate_out refuses done sequences)
+                self._handoff_req.discard(rid)
                 continue
             self._exports[rid] = uid
+            self._export_bundles[rid] = bundle
+            self._handoff_req.discard(rid)
             sent = self._sent.get(rid, 0)
             catchup = [int(t)
                        for t in bundle.tokens[len(bundle.tokens)
@@ -500,8 +608,20 @@ class EngineBackend:
             out.append((rid, bundle, catchup, sent))
         return out
 
+    def export_chunks(self, rid: str, max_bytes: int | None = None):
+        """Inline-payload re-chunk of a pinned export (shm-relay
+        fallback): the bundle built at freeze time is retained — frozen
+        pages are bit-stable — so this is pure host work."""
+        from ..inference.migration import CHUNK_BYTES, iter_chunks
+
+        bundle = self._export_bundles.get(rid)
+        if bundle is None:
+            return None
+        return iter_chunks(bundle, max_bytes or CHUNK_BYTES)
+
     def export_commit(self, rid: str) -> None:
         uid = self._exports.pop(rid, None)
+        self._export_bundles.pop(rid, None)
         if uid is None:
             return
         self.eng.export_commit(uid)
@@ -512,11 +632,37 @@ class EngineBackend:
 
     def export_abort(self, rid: str, resume: bool) -> None:
         uid = self._exports.pop(rid, None)
+        self._export_bundles.pop(rid, None)
         if resume and uid is not None:
             self.eng.export_abort(uid)
             self._resumed.add(rid)      # finish locally, no re-handoff
         else:
             self.cancel(rid)
+
+    # -- placement-time radix pulls (distributed prefix cache) -----------
+    def kv_export(self, tokens: list[int]):
+        """Longest locally-cached chain prefixing ``tokens`` as a
+        kind="prefix" bundle (device gather under a gather-scoped pin);
+        None on a miss."""
+        from ..inference.migration import MigrationError
+
+        try:
+            return self.eng.export_prefix([int(t) for t in tokens])
+        except (MigrationError, RuntimeError):
+            return None
+
+    def adopt_prefix(self, bundle) -> int:
+        """Scatter a pulled chain into the pool + trie through the
+        refcounted adopt API; 0 on any refusal (caller recomputes)."""
+        from ..inference.migration import MigrationError
+
+        try:
+            pages = self.eng.import_prefix(bundle)
+        except (MigrationError, RuntimeError) as e:
+            logger.warning(f"replica: prefix adopt refused: {e}")
+            return 0
+        self.pulled_pages += pages
+        return pages
 
     def import_begin(self, rid: str, meta: dict) -> str | None:
         from ..inference.migration import (BundleAssembler,
@@ -543,14 +689,18 @@ class EngineBackend:
         self._tenants[rid] = shell.tenant
         return None
 
-    def import_chunk(self, rid: str, msg: dict) -> str | None:
+    def import_chunk(self, rid: str, msg: dict,
+                     raw: bytes | None = None) -> str | None:
         from ..inference.migration import MigrationError
 
         asm = self._imports.get(rid)
         if asm is None:
             return "import_failed"
         try:
-            asm.add(msg)
+            if raw is not None:
+                asm.add_raw(msg, raw)
+            else:
+                asm.add(msg)
         except MigrationError:
             return "import_failed"
         return None
@@ -609,6 +759,17 @@ def _build_backend(cfg: dict):
     raise ValueError(f"unknown replica backend {kind!r}")
 
 
+def _cleanup_shm(ring, readers: dict) -> None:
+    """Unlink our ring and drop borrowed views on clean exits (a HARD
+    crash leaks the segment to the resource tracker, which reaps it)."""
+    if ring is not None:
+        ring.close()
+    for r in readers.values():
+        if r is not None:
+            r.close()
+    readers.clear()
+
+
 def serve(cfg: dict, chan: LineChannel) -> int:
     """The replica event loop. Returns 0 on an explicit shutdown message
     and 2 when the router went away (a ``--listen`` daemon then goes
@@ -632,9 +793,14 @@ def serve(cfg: dict, chan: LineChannel) -> int:
     send_t = float(cfg.get("send_timeout_s", 2.0))
     digest_max = int(cfg.get("digest_max", 4096))
     role = getattr(backend, "role", "mixed")
+    # intra-host fast path (serving/shm.py): payload rides this replica's
+    # shared ring, descriptors ride the line protocol; 0 = relay-only
+    from .shm import attach_ring, open_ring
+    ring = open_ring(int(cfg.get("shm_bytes", 0) or 0))
     chan.send({"t": "ready", "pid": os.getpid(),
                "block_size": backend.block_size,
                "max_live": backend.max_live, "role": role,
+               "shm": ring.name if ring is not None else None,
                "epoch": int(cfg.get("epoch", 0))}, timeout=send_t)
 
     draining = False
@@ -643,6 +809,20 @@ def serve(cfg: dict, chan: LineChannel) -> int:
     digest_ver_sent = -1                 # first heartbeat always ships it
     stall_until = 0.0
     stalled: list[dict] = []             # stream msgs queued during a stall
+    # placement-time radix pulls (puller side): puts held back while
+    # their pulled chain is in flight — {"put", "deadline", "asm",
+    # "shm", "relay"}; admitted (recompute fallback) at the deadline NO
+    # MATTER WHAT the fleet does
+    pulls: dict[str, dict] = {}
+    # peer exports retained for shm-relay resends (bounded FIFO)
+    pull_exports: dict[str, tuple] = {}
+    # import leg: source ring name per in-flight migration, and rids
+    # whose shm reads failed (EOF then asks for an inline relay resend)
+    mig_shm: dict[str, str | None] = {}
+    mig_relay_need: set[str] = set()
+    # per-peer-ring attach results (the transport negotiation cache):
+    # name -> ShmReader | None (None = attach failed, relay forever)
+    readers: dict[str, object] = {}
 
     def _stream(msg: dict) -> None:
         """Send a chunk/done/failed message, honoring an active
@@ -653,40 +833,130 @@ def serve(cfg: dict, chan: LineChannel) -> int:
             return
         chan.send(msg, timeout=send_t)
 
+    def _reader(name: str | None):
+        """Attach a peer's ring once; cache the verdict per pair. The
+        cache is bounded: a crashed-and-respawned peer publishes a NEW
+        ring name, so old entries would otherwise pin their (unlinked)
+        segments' memory for the life of this process."""
+        if not name:
+            return None
+        if name not in readers:
+            while len(readers) >= 8:
+                old = readers.pop(next(iter(readers)))   # oldest first
+                if old is not None:
+                    old.close()
+            if inj.countdown("replica_shm_attach_fail"):
+                readers[name] = None     # injected map failure
+            else:
+                readers[name] = attach_ring(name)
+        return readers[name]
+
+    def _chunk_payload(msg: dict, shm_name: str | None):
+        """Resolve one incoming chunk's payload: ``(raw, ok)``. Inline
+        chunks pass through (raw None, assembler decodes); shm
+        descriptors are copied out of the peer's ring — a failed attach
+        or lapped/corrupt extent returns ok=False and the caller asks
+        for a relay resend."""
+        if "ref" not in msg:
+            return None, True
+        rd = _reader(shm_name)
+        if rd is None:
+            return None, False
+        raw = rd.read(int(msg["ref"]), int(msg["n"]), int(msg["crc"]))
+        return raw, raw is not None
+
+    def _wire_chunks(bundle) -> tuple[list[dict], bool]:
+        """Chunk a bundle for the wire: payloads go to this replica's
+        ring when it has one (descriptor chunks with ``ref``), inline
+        base64 otherwise — mixed per chunk if the ring can't take a
+        blob. A bundle that would fill more than half the ring goes
+        inline wholesale: the importer only reads AFTER the router
+        relays the buffered descriptors, so an oversized bundle would
+        lap its own early chunks and pay ring writes + failed reads + a
+        relay round-trip on top of the inline bytes it ends up sending
+        anyway. Returns (chunks, used_shm)."""
+        import base64 as _b64
+
+        from ..inference.migration import iter_chunks
+
+        if ring is None or bundle.payload_bytes > ring.size // 2:
+            return iter_chunks(bundle), False
+        out, used = [], False
+        for c in iter_chunks(bundle, encode=False):
+            raw = c.pop("raw")
+            off = ring.write(raw)
+            if off is None:              # oversized blob: inline
+                c["data"] = _b64.b64encode(raw).decode("ascii")
+            else:
+                used = True
+                c["ref"] = off
+            out.append(c)
+        return out, used
+
+    def _admit_put(msg: dict) -> None:
+        """Admit a (possibly pull-deferred) put into the backend."""
+        rid = str(msg["id"])
+        if draining:
+            _stream({"t": "failed", "id": rid,
+                     "a": attempts.get(rid, 0), "reason": "draining"})
+            return
+        # a replayed put for a request this replica already runs
+        # (router presumed us dead, then re-picked us): restart from
+        # scratch — the attempt nonce already invalidates the old
+        # stream's messages
+        backend.cancel(rid)
+        reason = backend.put(RequestRecord.from_wire(msg))
+        if reason:
+            _stream({"t": "failed", "id": rid,
+                     "a": attempts.get(rid, 0), "reason": reason})
+        elif telem is not None:
+            telem.registry.counter(
+                "serving_replica_requests_total",
+                help="requests admitted by this replica").inc()
+
+    def _settle_pull(rid: str, pages: int, nbytes: int = 0) -> None:
+        """A pull resolved (adopted, failed, or timed out): admit the
+        deferred put and tell the router how it went (pages=0 = the
+        recompute fallback engaged)."""
+        entry = pulls.pop(rid, None)
+        if entry is None:
+            return
+        _stream({"t": "kv_ack", "id": rid, "a": attempts.get(rid, 0),
+                 "pages": pages, "bytes": nbytes})
+        _admit_put(entry["put"])
+
     while True:
         busy = backend.has_work()
         try:
             msg = chan.recv(timeout=0.001 if busy else
                             min(hb_interval, 0.05))
         except ChannelClosed:
+            _cleanup_shm(ring, readers)
             return 2                     # router went away
         if msg is not None:
             t = msg.get("t")
             if t == "put":
                 rid = str(msg["id"])
                 attempts[rid] = int(msg.get("a", 0))
-                if draining:
-                    _stream({"t": "failed", "id": rid, "a": attempts[rid],
-                             "reason": "draining"})
+                if not draining and inj.countdown("replica_crash_on_put"):
+                    inj.crash_now("replica_crash_on_put",
+                                  f"admit of {rid}")
+                if msg.get("pull") and not draining:
+                    # a wanted-chain hint rode the record: hold admission
+                    # while the peer's pages are in flight (bounded by
+                    # the pull deadline — recompute is always safe)
+                    pulls[rid] = {
+                        "put": msg, "asm": None, "shm": None,
+                        "relay": False,
+                        "deadline": time.monotonic() + float(
+                            msg["pull"].get("deadline_s", 5.0))}
                 else:
-                    if inj.countdown("replica_crash_on_put"):
-                        inj.crash_now("replica_crash_on_put",
-                                      f"admit of {rid}")
-                    # a replayed put for a request this replica already
-                    # runs (router presumed us dead, then re-picked us):
-                    # restart from scratch — the attempt nonce above
-                    # already invalidates the old stream's messages
-                    backend.cancel(rid)
-                    reason = backend.put(RequestRecord.from_wire(msg))
-                    if reason:
-                        _stream({"t": "failed", "id": rid,
-                                 "a": attempts[rid], "reason": reason})
-                    elif telem is not None:
-                        telem.registry.counter(
-                            "serving_replica_requests_total",
-                            help="requests admitted by this replica").inc()
+                    _admit_put(msg)
             elif t == "flush":
-                backend.cancel(str(msg["id"]))
+                rid = str(msg["id"])
+                pulls.pop(rid, None)
+                pull_exports.pop(rid, None)
+                backend.cancel(rid)
             elif t == "mig_begin":
                 # a migrated-in sequence is arriving (decode role): claim
                 # capacity BEFORE the first payload chunk
@@ -697,16 +967,28 @@ def serve(cfg: dict, chan: LineChannel) -> int:
                 if reason:
                     _stream({"t": "failed", "id": rid, "a": attempts[rid],
                              "reason": reason})
+                else:
+                    mig_shm[rid] = msg.get("shm")
             elif t == "mig_chunk":
                 rid = str(msg["id"])
                 if inj.countdown("replica_crash_during_import"):
                     inj.crash_now("replica_crash_during_import",
                                   f"import of {rid}")
-                err = backend.import_chunk(rid, msg)
-                if err:
-                    backend.import_abort(rid)
-                    _stream({"t": "failed", "id": rid,
-                             "a": attempts.get(rid, 0), "reason": err})
+                raw, ok = _chunk_payload(msg, mig_shm.get(rid))
+                if not ok:
+                    # ring unreadable (attach failed / extent lapped):
+                    # leave the chunk missing — EOF asks for a relay
+                    # resend with inline payload, silently
+                    mig_relay_need.add(rid)
+                else:
+                    err = backend.import_chunk(rid, msg, raw)
+                    if err:
+                        backend.import_abort(rid)
+                        mig_shm.pop(rid, None)
+                        mig_relay_need.discard(rid)
+                        _stream({"t": "failed", "id": rid,
+                                 "a": attempts.get(rid, 0),
+                                 "reason": err})
             elif t == "mig_eof":
                 rid = str(msg["id"])
                 status, aux = backend.import_eof(rid,
@@ -714,10 +996,16 @@ def serve(cfg: dict, chan: LineChannel) -> int:
                 a = attempts.get(rid, 0)
                 if status == "need":
                     # resumable-per-chunk: name the gaps, the router
-                    # resends exactly those from its buffer
+                    # resends exactly those from its buffer — relay=True
+                    # additionally asks the SOURCE to re-emit them with
+                    # inline payload (the shm fast path failed here)
                     _stream({"t": "mig_need", "id": rid, "a": a,
-                             "missing": aux})
+                             "missing": aux,
+                             "relay": rid in mig_relay_need})
+                    mig_relay_need.discard(rid)
                 elif status == "ok":
+                    mig_shm.pop(rid, None)
+                    mig_relay_need.discard(rid)
                     _stream({"t": "mig_ack", "id": rid, "a": a})
                     if telem is not None:
                         telem.registry.counter(
@@ -725,6 +1013,8 @@ def serve(cfg: dict, chan: LineChannel) -> int:
                             help="page bundles imported by this "
                                  "replica").inc()
                 else:
+                    mig_shm.pop(rid, None)
+                    mig_relay_need.discard(rid)
                     _stream({"t": "failed", "id": rid, "a": a,
                              "reason": str(aux)})
             elif t == "mig_ack":
@@ -736,6 +1026,125 @@ def serve(cfg: dict, chan: LineChannel) -> int:
             elif t == "mig_resume":
                 # no decode-capable replica: keep serving it here
                 backend.export_abort(str(msg["id"]), resume=True)
+            elif t == "mig_request":
+                # hot-replica rebalancing: the router asked us to hand
+                # this mid-decode sequence off; stale requests no-op
+                backend.request_handoff(str(msg["id"]))
+            elif t == "mig_relay":
+                # the importer could not read our ring: resend the named
+                # chunks with inline payload (pinned pages re-chunk
+                # bit-identically), then a fresh EOF
+                rid = str(msg["id"])
+                a = attempts.get(rid, 0)
+                chunks = backend.export_chunks(rid)
+                if chunks is not None:
+                    want = {int(i) for i in msg.get("missing", ())}
+                    for c in chunks:
+                        if c["i"] in want:
+                            _stream({"t": "mig_chunk", "id": rid,
+                                     "a": a, **c})
+                    _stream({"t": "mig_eof", "id": rid, "a": a,
+                             "chunks": len(chunks)})
+            elif t == "kv_req":
+                # placement-time radix pull, export leg: a peer replica
+                # was placed a request whose prefix WE hold — bundle the
+                # cached chain (pages only, no sequence)
+                rid = str(msg["id"])
+                a = int(msg.get("a", 0))
+                if inj.countdown("replica_crash_during_kv_export"):
+                    inj.crash_now("replica_crash_during_kv_export",
+                                  f"kv export for {rid}")
+                bundle = backend.kv_export([int(x) for x in msg["tok"]])
+                if bundle is None:
+                    _stream({"t": "kv_none", "id": rid, "a": a})
+                else:
+                    while len(pull_exports) >= 8:   # bounded retention
+                        pull_exports.pop(next(iter(pull_exports)))
+                    pull_exports[rid] = (bundle, a)
+                    chunks, used = _wire_chunks(bundle)
+                    _stream({"t": "kv_bundle", "id": rid, "a": a,
+                             "meta": bundle.meta(),
+                             "chunks": len(chunks),
+                             "shm": ring.name if used else None})
+                    for c in chunks:
+                        _stream({"t": "kv_chunk", "id": rid, "a": a,
+                                 **c})
+                    _stream({"t": "kv_eof", "id": rid, "a": a,
+                             "chunks": len(chunks)})
+            elif t == "kv_relay":
+                # inline-payload resend for a pull whose shm leg failed
+                rid = str(msg["id"])
+                exp = pull_exports.get(rid)
+                if exp is None:
+                    _stream({"t": "kv_none", "id": rid,
+                             "a": int(msg.get("a", 0))})
+                else:
+                    from ..inference.migration import iter_chunks
+
+                    bundle, a = exp
+                    want = {int(i) for i in msg.get("missing", ())}
+                    chunks = iter_chunks(bundle)
+                    for c in chunks:
+                        if c["i"] in want:
+                            _stream({"t": "kv_chunk", "id": rid,
+                                     "a": a, **c})
+                    _stream({"t": "kv_eof", "id": rid, "a": a,
+                             "chunks": len(chunks)})
+            elif t == "kv_bundle":
+                # pull import leg: the chain we asked the router for
+                rid = str(msg["id"])
+                entry = pulls.get(rid)
+                if entry is not None:
+                    from ..inference.migration import BundleAssembler
+
+                    entry["asm"] = BundleAssembler(msg["meta"])
+                    entry["shm"] = msg.get("shm")
+                    entry["relay"] = False
+            elif t == "kv_chunk":
+                rid = str(msg["id"])
+                entry = pulls.get(rid)
+                if entry is not None and entry["asm"] is not None:
+                    from ..inference.migration import MigrationError
+
+                    raw, ok = _chunk_payload(msg, entry["shm"])
+                    if not ok:
+                        entry["relay"] = True
+                    else:
+                        try:
+                            if raw is not None:
+                                entry["asm"].add_raw(msg, raw)
+                            else:
+                                entry["asm"].add(msg)
+                        except MigrationError:
+                            entry["relay"] = True
+            elif t == "kv_eof":
+                rid = str(msg["id"])
+                entry = pulls.get(rid)
+                if entry is not None and entry["asm"] is not None:
+                    from ..inference.migration import MigrationError
+
+                    asm = entry["asm"]
+                    asm.eof(int(msg["chunks"]))
+                    missing = asm.missing()
+                    if missing:
+                        _stream({"t": "kv_need", "id": rid,
+                                 "a": attempts.get(rid, 0),
+                                 "missing": missing,
+                                 "relay": bool(entry["relay"])})
+                        entry["relay"] = False
+                    else:
+                        try:
+                            bundle = asm.assemble()
+                        except MigrationError:
+                            bundle = None
+                        pages = backend.adopt_prefix(bundle) \
+                            if bundle is not None else 0
+                        _settle_pull(rid, pages,
+                                     asm.bytes_received if pages else 0)
+            elif t == "kv_fail":
+                # the pull died somewhere (peer gone, chain evicted,
+                # router gave up): recompute — the always-safe fallback
+                _settle_pull(str(msg["id"]), 0)
             elif t == "drain":
                 draining = True
             elif t == "ping":
@@ -745,6 +1154,7 @@ def serve(cfg: dict, chan: LineChannel) -> int:
                     chan.send({"t": "bye"}, timeout=1.0)
                 except (ChannelClosed, ChannelTimeout):
                     pass                 # router already gone: exit anyway
+                _cleanup_shm(ring, readers)
                 return 0
 
         for rid, kind, toks, off in backend.step(inj):
@@ -774,36 +1184,43 @@ def serve(cfg: dict, chan: LineChannel) -> int:
                 _stream({"t": "failed", "id": rid, "a": a,
                          "reason": str(toks)})
 
-        if role == "prefill":
-            # sequences past the prefill->decode boundary: freeze, bundle
-            # and stream the page chunks to the router, which relays them
-            # to a decode replica. Pages stay pinned here until mig_ack /
-            # mig_abort / mig_resume comes back.
-            from ..inference.migration import iter_chunks
+        # sequences frozen for transfer — a prefill role's boundary
+        # crossings plus any router-requested rebalance victims: bundle
+        # and stream the page chunks (ring descriptors on the shm fast
+        # path) to the router, which relays them to the target. Pages
+        # stay pinned here until mig_ack / mig_abort / mig_resume.
+        for rid, bundle, catchup, off in backend.take_handoffs():
+            a = attempts.get(rid, 0)
+            if catchup:
+                # committed-but-unstreamed tokens the export drain
+                # folded in: stream them so the router's committed
+                # prefix stays gapless
+                _stream({"t": "chunk", "id": rid, "a": a, "off": off,
+                         "toks": catchup})
+            chunks, used = _wire_chunks(bundle)
+            _stream({"t": "handoff", "id": rid, "a": a,
+                     "meta": bundle.meta(), "chunks": len(chunks),
+                     "shm": ring.name if used else None})
+            for c in chunks:
+                if inj.countdown("replica_crash_during_handoff"):
+                    inj.crash_now("replica_crash_during_handoff",
+                                  f"handoff of {rid}")
+                _stream({"t": "mig_chunk", "id": rid, "a": a, **c})
+            _stream({"t": "mig_eof", "id": rid, "a": a,
+                     "chunks": len(chunks)})
+            if telem is not None:
+                telem.registry.counter(
+                    "serving_replica_migrations_out_total",
+                    help="page bundles exported by this "
+                         "replica").inc()
 
-            for rid, bundle, catchup, off in backend.take_handoffs():
-                a = attempts.get(rid, 0)
-                if catchup:
-                    # committed-but-unstreamed tokens the export drain
-                    # folded in: stream them so the router's committed
-                    # prefix stays gapless
-                    _stream({"t": "chunk", "id": rid, "a": a, "off": off,
-                             "toks": catchup})
-                chunks = iter_chunks(bundle)
-                _stream({"t": "handoff", "id": rid, "a": a,
-                         "meta": bundle.meta(), "chunks": len(chunks)})
-                for c in chunks:
-                    if inj.countdown("replica_crash_during_handoff"):
-                        inj.crash_now("replica_crash_during_handoff",
-                                      f"handoff of {rid}")
-                    _stream({"t": "mig_chunk", "id": rid, "a": a, **c})
-                _stream({"t": "mig_eof", "id": rid, "a": a,
-                         "chunks": len(chunks)})
-                if telem is not None:
-                    telem.registry.counter(
-                        "serving_replica_migrations_out_total",
-                        help="page bundles exported by this "
-                             "replica").inc()
+        if pulls:
+            # pull deadlines are LOCAL law: a dead router/peer can delay
+            # a held-back put at most this long before it recomputes
+            now_p = time.monotonic()
+            for rid in [r for r, e in list(pulls.items())
+                        if now_p >= e["deadline"]]:
+                _settle_pull(rid, 0)
 
         if stalled and time.monotonic() >= stall_until:
             # stall expired: deliver the queued stream late — the router

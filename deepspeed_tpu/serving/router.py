@@ -48,9 +48,10 @@ from ..telemetry.reqtrace import (TENANT_CARDINALITY_CAP,
                                   TENANT_OVERFLOW_LABEL)
 from ..utils.logging import logger
 from .disagg import (DECODE_CAPABLE, MigrationState, PREFILL_CAPABLE,
-                     ScaleAdvisor, role_of)
+                     RebalancePolicy, ScaleAdvisor, role_of)
 from .fleet import DRAINING, Fleet, FleetConfig, QUARANTINED, READY
-from .placement import StickyMap, chain_hashes, pick_replica
+from .placement import (StickyMap, best_digest_peer, chain_hashes,
+                        pick_replica, pull_beats_recompute)
 from .protocol import ChannelClosed, RequestRecord, poll_channels
 
 #: terminal request states
@@ -100,6 +101,33 @@ class RouterConfig:
     #: autoscale hints (disagg.ScaleAdvisor): sustained-idle window for
     #: the per-role scale-down signal
     scale_idle_s: float = 10.0
+    #: placement-time cross-replica radix pulls (distributed prefix
+    #: cache): when the deepest digest match is NOT the placed replica,
+    #: ship a wanted-chain hint and have the placed replica pull the
+    #: chain from the peer instead of recomputing it
+    kv_pull: bool = True
+    #: the peer must beat the placed replica's own match by at least
+    #: this many pages to bother
+    kv_pull_min_pages: int = 2
+    #: puller-side recompute deadline AND the router's pull-state TTL
+    kv_pull_timeout_s: float = 5.0
+    #: cost-model rates (pull engages only when est transfer time beats
+    #: est prefill time; recompute is the always-safe fallback)
+    kv_pull_prefill_tok_s: float = 2000.0
+    kv_pull_relay_bytes_s: float = 64e6
+    kv_pull_shm_bytes_s: float = 2e9
+    kv_pull_overhead_s: float = 0.02
+    #: transfer-buffer GC: a buffered bundle/pull whose importer never
+    #: settles is dropped (and the migration failed) after this long
+    migration_buffer_ttl_s: float = 60.0
+    #: hot-replica rebalancing (disagg.RebalancePolicy): migrate the
+    #: youngest mid-decode sequence off a saturated decode-capable
+    #: replica onto an idle digest-compatible peer
+    rebalance: bool = True
+    rebalance_hot_util: float = 0.85
+    rebalance_idle_util: float = 0.5
+    rebalance_sustain_s: float = 2.0
+    rebalance_min_interval_s: float = 1.0
     telemetry: bool = False
 
 
@@ -126,6 +154,15 @@ class _Req:
     mig: MigrationState | None = None
     #: the request completed decode on a replica it migrated to
     migrated: bool = False
+    #: pages shipped by a placement-time radix pull (0 = none/fell back)
+    pulled_pages: int = 0
+    #: a rebalance mig_request is out for this request (the next handoff
+    #: from its replica is the victim's — tagged kind="rebalance")
+    rebalance_asked: bool = False
+    rebalance_ask_t: float = 0.0
+    #: this request was rebalanced once already (or a rebalance for it
+    #: aborted): never pick it again — the anti-ping-pong hysteresis
+    rebalanced: bool = False
 
 
 class Router:
@@ -152,11 +189,26 @@ class Router:
         self._commits: deque[tuple[float, int]] = deque()  # (t, n) window
         self._scale = ScaleAdvisor(slo_ttft_s=self.cfg.slo_ttft_s,
                                    idle_s=self.cfg.scale_idle_s)
+        self._rebal = RebalancePolicy(
+            hot_util=self.cfg.rebalance_hot_util,
+            idle_util=self.cfg.rebalance_idle_util,
+            sustain_s=self.cfg.rebalance_sustain_s,
+            min_interval_s=self.cfg.rebalance_min_interval_s)
+        #: in-flight placement-time radix pulls (trace -> MigrationState
+        #: kind="pull"; separate from _Req.mig — a pulled request can
+        #: later hand off or rebalance like any other)
+        self._pulls: dict[str, MigrationState] = {}
+        #: page geometry learned from the last bundle meta seen (the
+        #: pull cost model's bytes-per-page term; 0 until known)
+        self._page_bytes = 0
         self.double_commits = 0
         self.stale_msgs = 0
         self.replay_mismatches = 0
         self.migrations = 0
         self.migration_fallbacks = 0
+        self.kv_pulls = 0
+        self.kv_pull_fallbacks = 0
+        self.rebalances = 0
 
     # -- lifecycle -------------------------------------------------------
     def start(self, min_ready: int = 1) -> None:
@@ -292,6 +344,8 @@ class Router:
         now = time.monotonic()
         for r in self.fleet.maintain(now):
             self._sticky.forget_slot(r.slot)
+            self._rebal.note_slot_died(r.slot)
+            self._fail_pulls_from(r.slot, r.epoch)
             self._replay_orphans(r.slot, r.epoch, "replica_lost")
         for ch in poll_channels(
                 self.fleet.channels(),
@@ -310,6 +364,7 @@ class Router:
                 self._handle(h, msg)
         self._check_deadlines(time.monotonic())
         now = time.monotonic()
+        self._sweep_transfers(now)
         self._dispatch(now)
         # per-role autoscale hints: signals only (gauges), no actuator
         self._scale.update(
@@ -318,6 +373,11 @@ class Router:
             self._est_queue_wait_s(),
             registry=self._telem.registry if self._telem.enabled
             else None)
+        # hot-replica rebalancing consumes those same saturation signals
+        # — this is the one actuator, and it is rate-limited + hysteretic
+        # (disagg.RebalancePolicy) so it can never flap
+        if self.cfg.rebalance:
+            self._maybe_rebalance(now)
 
     def run(self, deadline_s: float = 60.0) -> dict:
         """Poll until every submitted request is terminal, or fail the
@@ -352,6 +412,9 @@ class Router:
         elif t in ("handoff", "mig_chunk", "mig_eof", "mig_ack",
                    "mig_need"):
             self._on_migration(h, msg)
+        elif t in ("kv_bundle", "kv_chunk", "kv_eof", "kv_none",
+                   "kv_need", "kv_ack"):
+            self._on_pull(h, msg)
         elif t == "bye":
             h.state = DRAINING
 
@@ -496,26 +559,60 @@ class Router:
         t = msg["t"]
         tid = str(msg.get("id"))
         req = self._reqs.get(tid)
-        if self._stale(h, req, msg):
+        mig = req.mig if req is not None else None
+        # source-leg messages during the xfer phase are the shm-relay
+        # fallback resend (the request is assigned to the TARGET then, so
+        # the normal (slot, epoch, attempt) guard would drop them): gate
+        # them on the migration's own source identity instead
+        src_leg = (t in ("mig_chunk", "mig_eof") and mig is not None
+                   and mig.phase == "xfer" and h.slot == mig.src_slot
+                   and h.epoch == mig.src_epoch
+                   and int(msg.get("a", -1)) == mig.src_attempt)
+        if not src_leg and self._stale(h, req, msg):
             return
         now = time.monotonic()
         req.last_activity_t = now
-        mig = req.mig
         if t == "handoff":
+            # a rebalance victim's handoff aborts back to the source on
+            # any failure (the sequence keeps decoding there); a
+            # prefill-role boundary handoff replays from scratch
+            kind = "rebalance" if req.rebalance_asked else "handoff"
+            req.rebalance_asked = False
             req.mig = MigrationState(meta=msg.get("meta") or {},
                                      src_slot=h.slot, src_epoch=h.epoch,
-                                     started_t=now)
+                                     started_t=now, kind=kind,
+                                     src_attempt=req.attempt,
+                                     shm=msg.get("shm"))
+            self._page_bytes = int((msg.get("meta") or {}).get(
+                "page_bytes", self._page_bytes) or self._page_bytes)
             self.migrations += 1
             if self._telem.enabled:
                 self._telem.registry.counter(
                     "serving_router_migrations_total",
-                    help="prefill->decode page-bundle handoffs "
-                         "started").inc()
+                    labels={"kind": kind},
+                    help="page-bundle transfers started (prefill->decode "
+                         "handoffs and rebalance evacuations)").inc()
         elif t == "mig_chunk":
-            if mig is not None and mig.phase == "recv":
+            if mig is None:
+                return
+            if mig.phase == "recv":
                 mig.add_chunk(msg)
+            elif src_leg:
+                # relay resend: buffer (future gap-resends serve from
+                # here) and forward to the target with ITS nonce
+                mig.add_chunk(msg)
+                self._send_to_slot(
+                    mig.tgt_slot, req.assigned_epoch,
+                    {**msg, "id": tid, "a": req.attempt})
         elif t == "mig_eof":
-            if mig is None or mig.phase != "recv":
+            if mig is None:
+                return
+            if mig.phase == "xfer":
+                if src_leg:              # relay resend complete
+                    self._send_to_slot(
+                        mig.tgt_slot, req.assigned_epoch,
+                        {"t": "mig_eof", "id": tid, "a": req.attempt,
+                         "chunks": mig.total})
                 return
             mig.total = int(msg.get("chunks", 0))
             if not mig.complete:
@@ -531,12 +628,24 @@ class Router:
                 return
             mig.resends += 1
             if mig.resends > self.cfg.migration_resend_max:
-                self._abort_migration(req, "resend_budget")
-                self._retry_or_fail(req, "migration_failed")
+                self._settle_failed_migration(req, "resend_budget")
+                return
+            missing = [int(i) for i in msg.get("missing", ())]
+            if msg.get("relay"):
+                # the target could not read the source's ring: ask the
+                # source for those chunks WITH inline payload (the
+                # pinned pages re-chunk bit-identically); its resend
+                # flows through the src_leg branches above
+                mig.relayed = True
+                if not self._send_to_slot(
+                        mig.src_slot, mig.src_epoch,
+                        {"t": "mig_relay", "id": tid,
+                         "missing": missing}):
+                    self._settle_failed_migration(req, "relay_source_lost")
                 return
             rep = self.fleet.replicas[h.slot]
-            for i in msg.get("missing", ()):
-                c = mig.chunks.get(int(i))
+            for i in missing:
+                c = mig.chunks.get(i)
                 if c is not None:
                     rep.send({**c, "id": tid, "a": req.attempt})
             rep.send({"t": "mig_eof", "id": tid, "a": req.attempt,
@@ -552,12 +661,19 @@ class Router:
                                {"t": "mig_ack", "id": tid})
             self._release_slot_count(mig.src_slot)
             req.migrated = True
+            if mig.kind == "rebalance":
+                req.rebalanced = True
             req.mig = None
             if self._telem.enabled:
+                transport = "shm" if mig.shm and not mig.relayed \
+                    else "relay"
                 self._telem.registry.counter(
                     "serving_router_migration_bytes_total",
-                    help="page-bundle payload bytes relayed "
-                         "prefill->decode").inc(mig.payload_bytes)
+                    labels={"transport": transport},
+                    help="page-bundle payload bytes transferred, by "
+                         "transport (relay = base64 through the router, "
+                         "shm = intra-host shared-memory ring)").inc(
+                    mig.payload_bytes)
                 self._telem.registry.histogram(
                     "serving_router_migration_stall_s",
                     buckets=LATENCY_BUCKETS_S,
@@ -575,7 +691,12 @@ class Router:
         if not cands:
             # degrade to mixed: cheaper than failing or re-prefilling,
             # and the scale advisor turns this into a decode-up hint
-            self._scale.decode_starved = True
+            # (a rebalance victim just resumes — the hot replica keeps
+            # it, and the hysteresis flag stops us re-picking it)
+            if mig.kind != "rebalance":
+                self._scale.decode_starved = True
+            else:
+                req.rebalanced = True
             self.migration_fallbacks += 1
             self._send_to_slot(mig.src_slot, mig.src_epoch,
                                {"t": "mig_resume", "id": tid})
@@ -604,7 +725,7 @@ class Router:
         mig.phase = "xfer"
         mig.tgt_slot = rep.slot
         ok = rep.send({"t": "mig_begin", "id": tid, "a": req.attempt,
-                       "meta": mig.meta})
+                       "meta": mig.meta, "shm": mig.shm})
         for i in range(mig.total if ok else 0):
             ok = rep.send({**mig.chunks[i], "id": tid, "a": req.attempt})
             if not ok:
@@ -612,8 +733,7 @@ class Router:
         ok = ok and rep.send({"t": "mig_eof", "id": tid,
                               "a": req.attempt, "chunks": mig.total})
         if not ok:
-            self._abort_migration(req, "target_send_failed")
-            self._retry_or_fail(req, "send_failed")
+            self._settle_failed_migration(req, "target_send_failed")
 
     def _abort_migration(self, req: _Req, reason: str) -> None:
         """Settle a dead migration: the source flushes its pinned export,
@@ -640,6 +760,55 @@ class Router:
                 labels={"reason": sanitize_label_value(reason)},
                 help="handoffs abandoned, by structured reason").inc()
 
+    def _slot_alive(self, slot: int, epoch: int) -> bool:
+        if not 0 <= slot < len(self.fleet.replicas):
+            return False
+        rep = self.fleet.replicas[slot]
+        return rep.epoch == epoch and rep.state == READY
+
+    def _abort_rebalance(self, req: _Req, reason: str) -> None:
+        """A rebalance transfer died but the SOURCE still holds the
+        frozen sequence: resume it there instead of replaying — zero
+        work is lost, zero blocks change hands. The request's assignment
+        (and nonce) roll back to the source so its resumed stream passes
+        the staleness guard."""
+        mig = req.mig
+        req.mig = None
+        tid = req.rec.trace_id
+        if mig.phase == "xfer":
+            # the relay moved the assignment to the target: undo it and
+            # flush the target's half-import
+            self._release_slot_count(mig.tgt_slot)
+            if mig.tgt_slot >= 0 and mig.tgt_slot != mig.src_slot:
+                self._send_to_slot(mig.tgt_slot, -1,
+                                   {"t": "flush", "id": tid})
+        self._send_to_slot(mig.src_slot, mig.src_epoch,
+                           {"t": "mig_resume", "id": tid})
+        req.assigned_slot = mig.src_slot
+        req.assigned_epoch = mig.src_epoch
+        req.attempt = mig.src_attempt
+        req.last_activity_t = time.monotonic()
+        req.rebalanced = True            # hysteresis: one shot per request
+        logger.warning(f"router: rebalance of {tid} aborted ({reason}); "
+                       f"resumed on slot {mig.src_slot}")
+        if self._telem.enabled:
+            self._telem.registry.counter(
+                "serving_router_migration_aborts_total",
+                labels={"reason": sanitize_label_value(reason)},
+                help="handoffs abandoned, by structured reason").inc()
+
+    def _settle_failed_migration(self, req: _Req, reason: str) -> None:
+        """One settlement path for every mid-transfer failure: a
+        rebalance victim whose source is still alive resumes there (no
+        retry burned); anything else aborts and replays from scratch."""
+        mig = req.mig
+        if mig is not None and mig.kind == "rebalance" \
+                and self._slot_alive(mig.src_slot, mig.src_epoch):
+            self._abort_rebalance(req, reason)
+            return
+        self._abort_migration(req, reason)
+        self._retry_or_fail(req, reason)
+
     def _send_to_slot(self, slot: int, epoch: int, msg: dict) -> bool:
         """Best-effort message to a slot's CURRENT incarnation (epoch -1
         = whatever runs there now; a stale epoch means the incarnation we
@@ -660,9 +829,19 @@ class Router:
 
     def _retry_or_fail(self, req: _Req, reason: str) -> None:
         tid = req.rec.trace_id
+        mig = req.mig
+        if mig is not None and mig.kind == "rebalance" \
+                and self._slot_alive(mig.src_slot, mig.src_epoch):
+            # a rebalance victim's transfer failed but its source still
+            # runs: resume there — no retry burned, no work lost
+            self._abort_rebalance(req, reason)
+            return
         # a replay restarts from scratch: settle any half-done handoff
-        # first (source unpins/flushes, target reservation flushes)
+        # and pull first (source unpins/flushes, target reservation
+        # flushes; a replayed attempt may re-pull on its new replica)
         self._abort_migration(req, reason)
+        self._pulls.pop(tid, None)
+        req.rebalance_asked = False
         self._unassign(req)
         if req.retries >= self.cfg.max_retries:
             self._terminate(tid, FAILED, reason)
@@ -737,13 +916,26 @@ class Router:
             self._assigned_n[rep.slot] = \
                 self._assigned_n.get(rep.slot, 0) + 1
             self._sticky.note(req.chain, rep.slot)
+            pull_peer, peer_pages = (None, 0)
+            if self.cfg.kv_pull and req.chain \
+                    and tid not in self._pulls:
+                pull_peer, peer_pages = self._maybe_pull(req, rep,
+                                                         hit_pages)
             wire = req.rec.to_wire()
             wire["a"] = req.attempt
+            if pull_peer is not None:
+                # wanted-chain hint: the replica holds admission until
+                # the pulled pages land (or its own deadline fires and
+                # it recomputes — the always-safe fallback)
+                wire["pull"] = {"pages": peer_pages,
+                                "deadline_s": self.cfg.kv_pull_timeout_s}
             if not rep.send(wire):
                 # send failed: the slot is toast; requeue and let
                 # maintain() reap it next tick
                 self._retry_or_fail(req, "send_failed")
                 return
+            if pull_peer is not None:
+                self._start_pull(req, rep, pull_peer, peer_pages, now)
             if self._telem.enabled:
                 bs = rep.block_size or self._fleet_block_size() or 1
                 self._telem.registry.counter(
@@ -763,6 +955,303 @@ class Router:
                     "serving_router_queue_depth",
                     help="requests queued at the router").set(
                     sum(len(q) for q in self._queues.values()))
+
+    # -- placement-time radix pulls (distributed prefix cache) -----------
+    # The router chain-hashes every prompt and holds per-replica
+    # residency digests already; when the deepest match is NOT the
+    # placed replica, the request ships with a wanted-chain hint and the
+    # placed replica PULLS the page chain from the peer through the same
+    # bundle/chunk protocol migration uses (kind="prefix" bundles, no
+    # sequence, no pinned-until-ack — the importer adopts a copy).
+    # Pull-vs-recompute is a cost model (placement.pull_beats_recompute)
+    # and recompute is the always-safe fallback: the puller admits the
+    # held-back request the moment the pull fails, times out, or the
+    # router says kv_fail.
+
+    def _maybe_pull(self, req: _Req, rep, hit_pages: int):
+        peer, pages = best_digest_peer(req.chain, self.fleet.ready(),
+                                       exclude_slot=rep.slot)
+        extra = pages - hit_pages
+        if peer is None or extra < self.cfg.kv_pull_min_pages:
+            return None, 0
+        bs = rep.block_size or self._fleet_block_size() or 1
+        shm_ok = bool(peer.shm) and not rep.address and not peer.address
+        rate = self.cfg.kv_pull_shm_bytes_s if shm_ok \
+            else self.cfg.kv_pull_relay_bytes_s
+        if not pull_beats_recompute(
+                extra * bs, self._page_bytes, bs,
+                self.cfg.kv_pull_prefill_tok_s, rate,
+                self.cfg.kv_pull_overhead_s):
+            return None, 0
+        return peer, pages
+
+    def _start_pull(self, req: _Req, rep, peer, pages: int,
+                    now: float) -> None:
+        tid = req.rec.trace_id
+        bs = rep.block_size or self._fleet_block_size() or 1
+        if not self._send_to_slot(
+                peer.slot, peer.epoch,
+                {"t": "kv_req", "id": tid, "a": req.attempt,
+                 "tok": [int(x) for x in req.rec.prompt[:pages * bs]]}):
+            # peer unreachable: tell the puller to recompute right away
+            self._fail_pull_notify(req, "peer_send_failed")
+            return
+        self._pulls[tid] = MigrationState(
+            meta={}, src_slot=peer.slot, src_epoch=peer.epoch,
+            started_t=now, kind="pull", tgt_slot=rep.slot,
+            src_attempt=req.attempt)
+        self.kv_pulls += 1
+        if self._telem.enabled:
+            self._telem.registry.counter(
+                "serving_router_kv_pulls_total",
+                help="placement-time cross-replica radix pulls "
+                     "started").inc()
+
+    def _fail_pull_notify(self, req: _Req, reason: str) -> None:
+        """Count a fallback and release the puller to recompute."""
+        self._fail_pull_count_only(reason)
+        if req.status == ASSIGNED:
+            self._send_to_slot(req.assigned_slot, req.assigned_epoch,
+                               {"t": "kv_fail",
+                                "id": req.rec.trace_id})
+
+    def _fail_pull(self, tid: str, reason: str) -> None:
+        self._pulls.pop(tid, None)
+        req = self._reqs.get(tid)
+        if req is not None:
+            self._fail_pull_notify(req, reason)
+
+    def _fail_pulls_from(self, slot: int, epoch: int) -> None:
+        """A replica died: every pull it was exporting falls back."""
+        for tid in [t for t, p in self._pulls.items()
+                    if p.src_slot == slot and p.src_epoch <= epoch]:
+            self._fail_pull(tid, "peer_lost")
+
+    def _on_pull(self, h, msg: dict) -> None:
+        t = msg["t"]
+        tid = str(msg.get("id"))
+        pull = self._pulls.get(tid)
+        req = self._reqs.get(tid)
+        if pull is None or req is None:
+            self.stale_msgs += 1
+            return
+        src_ok = (h.slot == pull.src_slot and h.epoch == pull.src_epoch
+                  and int(msg.get("a", -1)) == pull.src_attempt)
+        tgt_ok = (req.status == ASSIGNED
+                  and h.slot == req.assigned_slot == pull.tgt_slot
+                  and h.epoch == req.assigned_epoch
+                  and int(msg.get("a", -1)) == req.attempt)
+        now = time.monotonic()
+        if t == "kv_none":
+            if src_ok:
+                self._fail_pull(tid, "peer_miss")
+        elif t == "kv_bundle":
+            if src_ok and pull.phase == "recv":
+                pull.meta = msg.get("meta") or {}
+                pull.shm = msg.get("shm")
+                self._page_bytes = int(pull.meta.get(
+                    "page_bytes", self._page_bytes) or self._page_bytes)
+        elif t == "kv_chunk":
+            if not src_ok:
+                return
+            pull.add_chunk(msg)
+            if pull.phase == "xfer":     # relay resend: forward along
+                self._send_to_slot(pull.tgt_slot, req.assigned_epoch,
+                                   {**msg, "id": tid, "a": req.attempt})
+        elif t == "kv_eof":
+            if not src_ok:
+                return
+            if pull.phase == "xfer":     # relay resend complete
+                self._send_to_slot(pull.tgt_slot, req.assigned_epoch,
+                                   {"t": "kv_eof", "id": tid,
+                                    "a": req.attempt,
+                                    "chunks": pull.total})
+                return
+            pull.total = int(msg.get("chunks", 0))
+            if not pull.complete or req.status != ASSIGNED \
+                    or req.assigned_slot != pull.tgt_slot:
+                # torn source leg, or the request moved on (replayed
+                # elsewhere) while the chain was in flight
+                self._fail_pull(tid, "torn_or_moved")
+                return
+            pull.phase = "xfer"
+            ok = self._send_to_slot(
+                pull.tgt_slot, req.assigned_epoch,
+                {"t": "kv_bundle", "id": tid, "a": req.attempt,
+                 "meta": pull.meta, "chunks": pull.total,
+                 "shm": pull.shm})
+            for i in range(pull.total if ok else 0):
+                ok = self._send_to_slot(
+                    pull.tgt_slot, req.assigned_epoch,
+                    {**pull.chunks[i], "id": tid, "a": req.attempt})
+                if not ok:
+                    break
+            if ok:
+                self._send_to_slot(
+                    pull.tgt_slot, req.assigned_epoch,
+                    {"t": "kv_eof", "id": tid, "a": req.attempt,
+                     "chunks": pull.total})
+            else:
+                self._pulls.pop(tid, None)   # target gone: replay path
+        elif t == "kv_need":
+            if not tgt_ok or pull.phase != "xfer":
+                return
+            pull.resends += 1
+            if pull.resends > self.cfg.migration_resend_max:
+                self._fail_pull(tid, "resend_budget")
+                return
+            missing = [int(i) for i in msg.get("missing", ())]
+            if msg.get("relay"):
+                pull.relayed = True
+                if not self._send_to_slot(
+                        pull.src_slot, pull.src_epoch,
+                        {"t": "kv_relay", "id": tid,
+                         "missing": missing}):
+                    self._fail_pull(tid, "relay_source_lost")
+                return
+            for i in missing:
+                c = pull.chunks.get(i)
+                if c is not None:
+                    self._send_to_slot(pull.tgt_slot, req.assigned_epoch,
+                                       {**c, "id": tid,
+                                        "a": req.attempt})
+            self._send_to_slot(pull.tgt_slot, req.assigned_epoch,
+                               {"t": "kv_eof", "id": tid,
+                                "a": req.attempt, "chunks": pull.total})
+        elif t == "kv_ack":
+            if not tgt_ok:
+                return
+            self._pulls.pop(tid, None)
+            req.last_activity_t = now
+            pages = int(msg.get("pages", 0))
+            if pages <= 0:
+                # the puller adopted nothing (corrupt bundle / pool
+                # refusal / its local deadline fired): it recomputed
+                self._fail_pull_count_only("adopt_failed")
+                return
+            req.pulled_pages = pages
+            bs = int(pull.meta.get("bs", 0)) \
+                or self._fleet_block_size() or 1
+            if self._telem.enabled:
+                transport = "shm" if pull.shm and not pull.relayed \
+                    else "relay"
+                self._telem.registry.counter(
+                    "serving_router_kv_pull_tokens_total",
+                    help="prompt tokens served from a peer's cache via "
+                         "placement-time pulls (prefill compute "
+                         "skipped)").inc(pages * bs)
+                self._telem.registry.counter(
+                    "serving_router_kv_pull_bytes_total",
+                    labels={"transport": transport},
+                    help="pulled page-chain payload bytes, by "
+                         "transport").inc(pull.payload_bytes)
+
+    def _fail_pull_count_only(self, reason: str) -> None:
+        self.kv_pull_fallbacks += 1
+        if self._telem.enabled:
+            self._telem.registry.counter(
+                "serving_router_kv_pull_fallbacks_total",
+                labels={"reason": sanitize_label_value(reason)},
+                help="pulls that fell back to local recompute, by "
+                     "structured reason").inc()
+
+    # -- transfer-buffer GC + hot-replica rebalancing --------------------
+    def _sweep_transfers(self, now: float) -> None:
+        """Bound the router's transfer buffers: a bundle whose importer
+        never settles (dies without acking, wedges, or its request went
+        terminal) is dropped after ``migration_buffer_ttl_s`` — and the
+        migration settled — instead of being retained forever. Pulls ride
+        their own (shorter) deadline. The buffered total is a gauge."""
+        buffered = 0
+        ttl = self.cfg.migration_buffer_ttl_s
+        for tid, req in list(self._reqs.items()):
+            if req.rebalance_asked and req.mig is None \
+                    and now - req.rebalance_ask_t > 5.0:
+                # the replica never handed the victim off (export
+                # refused, stale ask): stop reserving it and never pick
+                # it again — an un-exportable sequence stays un-exportable
+                req.rebalance_asked = False
+                req.rebalanced = True
+            mig = req.mig
+            if mig is None:
+                continue
+            if req.status in (DONE, FAILED, SHED):
+                req.mig = None           # terminal leftover: just drop
+                self._count_buffer_expired()
+                continue
+            if now - mig.started_t > ttl:
+                self._count_buffer_expired()
+                self._settle_failed_migration(req, "buffer_ttl")
+                continue
+            buffered += mig.buffered_bytes
+        for tid in list(self._pulls):
+            pull = self._pulls[tid]
+            req = self._reqs.get(tid)
+            if req is None or req.status in (DONE, FAILED, SHED):
+                self._pulls.pop(tid, None)
+                continue
+            if now - pull.started_t > self.cfg.kv_pull_timeout_s:
+                self._fail_pull(tid, "timeout")
+                continue
+            buffered += pull.buffered_bytes
+        if self._telem.enabled:
+            self._telem.registry.gauge(
+                "serving_router_migration_buffer_bytes",
+                help="bundle/pull chunks currently buffered in the "
+                     "router (the GC'd relay buffer)").set(buffered)
+
+    def _count_buffer_expired(self) -> None:
+        if self._telem.enabled:
+            self._telem.registry.counter(
+                "serving_router_migration_buffer_expired_total",
+                help="buffered transfers dropped by the TTL/orphan "
+                     "sweep (importer died or wedged before "
+                     "settling)").inc()
+
+    def _maybe_rebalance(self, now: float) -> None:
+        """The one hint-driven actuator: when a decode-capable replica
+        stays saturated (disagg.RebalancePolicy's sustain/hysteresis/
+        rate-limit gates) and an idle peer exists, migrate the YOUNGEST
+        mid-decode sequence off it — least KV to ship, most decode left
+        to amortize the move. The victim's replica exports it through
+        the ordinary handoff flow; the relay picks the actual target
+        digest-aware (capacity > affinity), and any failure resumes the
+        victim on its source."""
+        handles = [r for r in self.fleet.ready()
+                   if role_of(r) in DECODE_CAPABLE]
+        if len(handles) < 2:
+            return
+        pair = self._rebal.pick(now, handles)
+        if pair is None:
+            return
+        hot, _ = pair
+        victim = None
+        for tid, req in self._reqs.items():
+            if req.status != ASSIGNED or req.assigned_slot != hot.slot \
+                    or not req.committed or req.mig is not None \
+                    or req.rebalanced or req.rebalance_asked \
+                    or tid in self._pulls:
+                continue
+            if victim is None or req.assign_t > victim.assign_t:
+                victim = req
+        if victim is None:
+            return
+        victim.rebalance_asked = True
+        victim.rebalance_ask_t = now
+        victim.last_activity_t = now
+        if not self._send_to_slot(hot.slot, hot.epoch,
+                                  {"t": "mig_request",
+                                   "id": victim.rec.trace_id}):
+            victim.rebalance_asked = False
+            return
+        self.rebalances += 1
+        logger.info(f"router: rebalancing {victim.rec.trace_id} off hot "
+                    f"slot {hot.slot}")
+        if self._telem.enabled:
+            self._telem.registry.counter(
+                "serving_router_rebalances_total",
+                help="mid-decode sequences asked off a saturated "
+                     "replica by the rebalance policy").inc()
 
     # -- bookkeeping -----------------------------------------------------
     def _release_slot_count(self, slot: int) -> None:
@@ -787,6 +1276,7 @@ class Router:
             # a request failing/shedding mid-handoff must not leave the
             # source's pages pinned forever
             self._abort_migration(req, f"terminated_{status}")
+        self._pulls.pop(tid, None)       # a terminal request pulls nothing
         if req.status == QUEUED:
             for q in self._queues.values():
                 if tid in q:
@@ -847,6 +1337,8 @@ class Router:
                 "tenant": req.rec.tenant, "attempts": req.attempt,
                 "retries": req.retries, "placed": list(req.placed),
                 "hit_pages": req.hit_pages, "migrated": req.migrated,
+                "pulled_pages": req.pulled_pages,
+                "rebalanced": req.rebalanced,
                 "ttft_s": (req.first_tok_t - req.submit_t)
                 if req.first_tok_t else None}
 

@@ -69,6 +69,11 @@ LIFECYCLE_EVENTS = (
     #                     carries the serving trace ID linking both sides
     "migrate_in",       # page bundle imported + trie seeded (ragged);
     #                     same serving trace ID as the exporter's event
+    "kv_pull",          # placement-time radix pull (ragged): dir="out" =
+    #                     a peer's cached chain snapshotted for export,
+    #                     dir="in" = pulled pages adopted into the local
+    #                     trie; both carry the pulling request's serving
+    #                     trace ID, linking the two replicas' timelines
 )
 
 #: hard cap on distinct tenant label values per process — the scrape's

@@ -57,6 +57,9 @@ HEAVY = [
     # pools, 3 engines each) plus a role-split engine fleet vs a mixed
     # baseline (3 replica subprocesses compiling tiny engines)
     "test_disagg.py",
+    # distributed prefix cache: the engine-pair prefix-pull parity test
+    # compiles two tiny engines
+    "test_kv_pull.py",
 ]
 
 

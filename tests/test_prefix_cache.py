@@ -244,16 +244,23 @@ def _gen_ops(rng, n_ops):
                   int(rng.integers(1, 4)), int(rng.integers(0, 5)))
         elif r < 0.89:
             op = ("evict", int(rng.integers(1, 5)))
-        elif r < 0.96:
+        elif r < 0.94:
             # KV-page migration A -> B: full handoff (export, import,
             # trie seed, ack, release-publish on the source)
             ops.append(("migrate", int(rng.integers(0, 6))))
             continue
-        else:
+        elif r < 0.97:
             # aborted migration: stage 0 = after export (export_abort),
             # stage 1 = after the importer reserved (abort_import too)
             ops.append(("migrate_abort", int(rng.integers(0, 6)),
                         int(rng.integers(0, 2))))
+            continue
+        else:
+            # placement-time radix pull B <- A: snapshot_prefix pins A's
+            # cached chain (audited mid-pin), adopt_prefix inserts it
+            # unreferenced into B (dedup'd against B's own trie)
+            ops.append(("peer_pull", int(rng.integers(len(_TEMPLATES))),
+                        int(rng.integers(1, 11))))
             continue
         if rng.random() < 0.30:
             op = ("b", op)            # same op against the importer pool
@@ -412,10 +419,34 @@ def _run_trace(ops):
         stA.export_ack(uid)
         stA.release(uid)                # publishes the prefix locally
 
+    def peer_pull(op):
+        """B pulls a cached chain from A through the refcounted pull API
+        (the placement-time distributed-cache leg): the export pin is
+        audited while held, the adopt is audited after, and a full pool
+        on B degrades to a clean no-op (the recompute fallback)."""
+        A, B = pools
+        stA, stB = A["st"], B["st"]
+        _, tmpl, pages = op
+        tokens = list(_TEMPLATES[tmpl][:pages * 4])
+        snap = stA.snapshot_prefix(tokens)
+        if snap is None:
+            return
+        stA.audit()                     # pinned-chain refcounts balance
+        try:
+            stB.adopt_prefix(tokens, snap["n_tokens"])
+            stB.audit()
+        except RuntimeError:
+            pass                        # importer pool full: recompute
+        finally:
+            stA.release_prefix(snap["handle"])
+        stA.audit()
+
     for i, op in enumerate(ops):
         try:
             if op[0] == "b":
                 apply(pools[1], op[1])
+            elif op[0] == "peer_pull":
+                peer_pull(op)
             elif op[0] in ("migrate", "migrate_abort"):
                 migrate(op)
             else:
@@ -481,7 +512,8 @@ def test_interleaving_property_fast():
 @pytest.mark.slow
 def test_interleaving_property_500_plus():
     """The acceptance-criteria run: 600 seeded interleavings x 90 ops of
-    admit/dispatch/commit/flush/evict/spec/migrate over TWO pools
+    admit/dispatch/commit/flush/evict/spec/migrate/peer_pull over TWO
+    pools
     (speculative provision → accept-or-rollback rounds, mid-tree
     rejections included; migrate_out/migrate_in/abort_migration at both
     rollback stages, pinned-until-ack asserted inline); every op is

@@ -310,6 +310,49 @@ def test_deadline_detector_flags_bare_waits(tmp_path):
         assert any(frag in v for v in out), (frag, out)
 
 
+def test_deadline_detector_flags_blocking_acquire_forms(tmp_path):
+    """The shm-ring era rule: ``lock.acquire(True)`` blocks forever
+    exactly like a bare ``acquire()`` but used to slip past the no-args
+    check. Non-lock acquires (the prefix trie's ``acquire(nodes)``) pass
+    a non-literal argument and stay legal."""
+    serving = tmp_path / "deepspeed_tpu" / "serving"
+    serving.mkdir(parents=True)
+    bad = serving / "shmish.py"
+    bad.write_text(
+        "def f(lock, trie, nodes):\n"
+        "    lock.acquire()\n"                      # bare: flagged
+        "    lock.acquire(True)\n"                  # blocking: flagged
+        "    lock.acquire(False)\n"                 # non-blocking: ok
+        "    lock.acquire(True, 0.5)\n"             # positional timeout: ok
+        "    lock.acquire(timeout=1.0)\n"           # ok
+        "    trie.acquire(nodes)\n")                # not a lock: ok
+    out = deadline_lint.check_file(str(bad))
+    assert len(out) == 2, "\n".join(out)
+    assert ":2:" in out[0] and ":3:" in out[1]
+    assert "acquire(True)" in out[1]
+
+
+def test_state_invariant_detector_allows_the_pull_api(tmp_path):
+    """The cross-replica radix-pull surface (snapshot_prefix /
+    release_prefix / adopt_prefix) is part of the refcounted API; the
+    same trie calls anywhere else stay flagged."""
+    f = tmp_path / "deepspeed_tpu" / "inference" / "ragged.py"
+    f.parent.mkdir(parents=True)
+    f.write_text(
+        "class StateManager:\n"
+        "    def snapshot_prefix(self, tokens):\n"
+        "        nodes = self.prefix_cache.match(tokens)\n"
+        "        self.prefix_cache.acquire(nodes)\n"
+        "    def adopt_prefix(self, tokens, n):\n"
+        "        nodes, dups = self.prefix_cache.adopt(tokens, [], n)\n"
+        "        self.prefix_cache.release(nodes)\n"
+        "        self.allocator.free(dups)\n"
+        "    def rogue_pull(self):\n"
+        "        self.prefix_cache.adopt([], [], 0)\n")   # flagged
+    out = state_lint.check_file(str(f))
+    assert len(out) == 1 and ":10:" in out[0]
+
+
 def test_deadline_detector_honors_allowlist(tmp_path):
     """replica.py's serve() carries the fault-injected hang — THE
     unbounded sleep under test — and nothing else does."""
