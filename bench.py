@@ -1269,7 +1269,9 @@ def router_main():
                 log_dir=f"/tmp/ds_bench_router/{name}"),
             max_queue=max_queue,
             slo_ttft_s=slo_ttft if slo_shed else None,
-            request_timeout_s=60.0, max_retries=3, telemetry=True)
+            request_timeout_s=60.0, max_retries=3, telemetry=True,
+            fleet_trace=True, fleet_trace_slo_ttft_s=slo_ttft,
+            fleet_trace_dir=f"/tmp/ds_bench_router/{name}/blackbox")
         sheds: dict[str, int] = {}
         t0 = time.perf_counter()
         router = Router(cfg)
@@ -1337,6 +1339,9 @@ def router_main():
                 # per-tenant attribution block (the PR-7 format): router-
                 # observed TTFT + request/shed counts per tenant
                 "tenants": telem.tenant_summary(),
+                # fleet tracing: postmortem pointers for this scenario
+                "fleet_health": router.fleet_health(),
+                "blackbox_dumps": router.blackbox_dumps,
             }
             return out
         finally:
@@ -1382,7 +1387,13 @@ def _router_scenario(name, trace, fleet_kw, router_kw, kill_at=None,
     telem = get_telemetry()
     telem.reset_metrics(prefix=ROUTER_RUN_PREFIXES)
     slo_ttft = float(os.environ.get("BENCH_ROUTER_SLO_TTFT", "2.0"))
-    rkw = {"request_timeout_s": 60.0, "max_retries": 3, "telemetry": True}
+    # fleet tracing rides every router-backed scenario: the artifact
+    # then carries its own postmortem pointers (fleet-health rollup +
+    # black-box dump count against the TTFT SLO) — a bench regression
+    # names the replica/phase that caused it
+    rkw = {"request_timeout_s": 60.0, "max_retries": 3, "telemetry": True,
+           "fleet_trace": True, "fleet_trace_slo_ttft_s": slo_ttft,
+           "fleet_trace_dir": f"/tmp/ds_bench_router/{name}/blackbox"}
     rkw.update(router_kw)
     cfg = RouterConfig(
         fleet=FleetConfig(log_dir=f"/tmp/ds_bench_router/{name}",
@@ -1477,6 +1488,11 @@ def _router_scenario(name, trace, fleet_kw, router_kw, kill_at=None,
             "replay_mismatches": router.replay_mismatches,
             "replica_restarts": router.fleet.restarts_total,
             "tenants": telem.tenant_summary(),
+            # fleet tracing: the regression's own postmortem pointers
+            "fleet_health": router.fleet_health(),
+            "blackbox_dumps": router.blackbox_dumps,
+            "blackbox_dir": cfg.fleet_trace_dir
+            if router.blackbox_dumps else None,
         }
     finally:
         router.close()

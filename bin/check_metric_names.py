@@ -24,9 +24,17 @@ runtime cardinality bound: ``TENANT_CARDINALITY_CAP`` must exist in
 telemetry/reqtrace.py as an integer literal in [1, 64] — the constant
 that keeps an untrusted tenant population from exploding the scrape.
 
+Metric-family documentation (docs/METRICS.md): every ``serving_*`` /
+``telemetry_*`` family emitted with a literal name is collected
+(``collect_metric_families``) and must appear in the auto-generated
+reference — ``check_metrics_doc`` flags both undocumented emissions and
+stale doc entries, and ``--write-doc`` regenerates the file. The drift
+test lives in tests/test_repo_lint.py next to the tag lint.
+
 Usage: ``python bin/check_metric_names.py [root]`` — prints violations as
 ``path:line: message``, exits nonzero if any. Enforced from
-tests/test_repo_lint.py.
+tests/test_repo_lint.py. ``python bin/check_metric_names.py --write-doc
+[root]`` regenerates docs/METRICS.md.
 """
 from __future__ import annotations
 
@@ -192,8 +200,7 @@ def check_cardinality_cap(root: str) -> list[str]:
             f"cardinality bound is gone"]
 
 
-def check_repo(root: str) -> list[str]:
-    out: list[str] = []
+def _targets(root: str) -> list[str]:
     targets = []
     for dirpath, _, files in os.walk(os.path.join(root, "deepspeed_tpu")):
         targets += [os.path.join(dirpath, f) for f in files
@@ -202,20 +209,143 @@ def check_repo(root: str) -> list[str]:
         p = os.path.join(root, extra)
         if os.path.exists(p):
             targets.append(p)
-    for path in sorted(targets):
+    return sorted(targets)
+
+
+def check_repo(root: str) -> list[str]:
+    out: list[str] = []
+    for path in _targets(root):
         out += check_file(path)
     out += check_cardinality_cap(root)
     return out
 
 
+# --- metric-family documentation (docs/METRICS.md) --------------------------
+
+#: only user-facing scrape families are documented; internal monitor tag
+#: prefixes (Train/, Resilience/, ...) stay out of scope
+DOC_PREFIXES = ("serving_", "telemetry_")
+DOC_FILE = "docs/METRICS.md"
+#: method -> (name arg index, metric type, help arg index | None).
+#: counter/gauge/histogram are the registry emits; _tenant_inc and
+#: _observe_slo are reqtrace's forwarders whose literal family names
+#: would otherwise be invisible to a static scan.
+FAMILY_METHODS = {
+    "counter": (0, "counter", None),
+    "gauge": (0, "gauge", None),
+    "histogram": (0, "histogram", None),
+    "_tenant_inc": (0, "counter", 3),
+    "_observe_slo": (1, "histogram", 4),
+}
+
+
+def _str_arg(node: ast.Call, idx: int | None, kwarg: str | None = None):
+    if kwarg is not None:
+        for kw in node.keywords:
+            if kw.arg == kwarg and isinstance(kw.value, ast.Constant) \
+                    and isinstance(kw.value.value, str):
+                return kw.value.value
+    if idx is not None and len(node.args) > idx \
+            and isinstance(node.args[idx], ast.Constant) \
+            and isinstance(node.args[idx].value, str):
+        return node.args[idx].value
+    return None
+
+
+def collect_metric_families(root: str) -> dict[str, dict]:
+    """Every ``serving_*``/``telemetry_*`` family emitted with a literal
+    name anywhere in the package: {name: {type, help, file}}. Dynamic
+    names can't be collected statically — same caveat as the tag lint."""
+    fams: dict[str, dict] = {}
+    for path in _targets(root):
+        with open(path, encoding="utf-8") as f:
+            try:
+                tree = ast.parse(f.read(), filename=path)
+            except SyntaxError:
+                continue                 # check_file reports it
+        rel = os.path.relpath(path, root)
+        for node in ast.walk(tree):
+            if not (isinstance(node, ast.Call)
+                    and isinstance(node.func, ast.Attribute)
+                    and node.func.attr in FAMILY_METHODS):
+                continue
+            name_idx, mtype, help_idx = FAMILY_METHODS[node.func.attr]
+            name = _str_arg(node, name_idx)
+            if name is None or not name.startswith(DOC_PREFIXES):
+                continue
+            help_s = _str_arg(node, help_idx, kwarg="help") or ""
+            ent = fams.get(name)
+            if ent is None or (not ent["help"] and help_s):
+                fams[name] = {"type": mtype, "help": help_s, "file": rel}
+    return fams
+
+
+def render_metrics_doc(root: str) -> str:
+    fams = collect_metric_families(root)
+    lines = [
+        "# Metric-family reference (auto-generated)",
+        "",
+        "Every `serving_*` / `telemetry_*` family emitted with a literal",
+        "name in `deepspeed_tpu/` + `bench.py`. Regenerate with",
+        "`python bin/check_metric_names.py --write-doc`;",
+        "`tests/test_repo_lint.py` fails when an emitted family is",
+        "missing here (or a documented one is no longer emitted).",
+        "",
+        "| family | type | help | emitted in |",
+        "|---|---|---|---|",
+    ]
+    for name in sorted(fams):
+        e = fams[name]
+        help_s = " ".join(e["help"].split()).replace("|", "\\|")
+        lines.append(f"| `{name}` | {e['type']} | {help_s} "
+                     f"| {e['file']} |")
+    lines.append("")
+    return "\n".join(lines)
+
+
+def check_metrics_doc(root: str) -> list[str]:
+    """Drift test: every emitted family is documented, every documented
+    family is still emitted."""
+    doc_path = os.path.join(root, *DOC_FILE.split("/"))
+    fams = collect_metric_families(root)
+    if not os.path.exists(doc_path):
+        return [f"{doc_path}:0: metric reference missing — run "
+                f"bin/check_metric_names.py --write-doc"]
+    with open(doc_path, encoding="utf-8") as f:
+        doc = f.read()
+    documented = set(re.findall(
+        r"`((?:serving|telemetry)_[a-zA-Z0-9_:]+)`", doc))
+    out = []
+    for name in sorted(set(fams) - documented):
+        out.append(f"{fams[name]['file']}:0: metric family {name!r} is "
+                   f"emitted but not documented in {DOC_FILE} — run "
+                   f"bin/check_metric_names.py --write-doc")
+    for name in sorted(documented - set(fams)):
+        out.append(f"{doc_path}:0: documented family {name!r} is no "
+                   f"longer emitted anywhere — run "
+                   f"bin/check_metric_names.py --write-doc")
+    return out
+
+
 def main(argv: list[str]) -> int:
-    root = argv[1] if len(argv) > 1 else \
+    args = list(argv[1:])
+    write_doc = "--write-doc" in args
+    if write_doc:
+        args.remove("--write-doc")
+    root = args[0] if args else \
         os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
-    violations = check_repo(root)
+    if write_doc:
+        path = os.path.join(root, *DOC_FILE.split("/"))
+        os.makedirs(os.path.dirname(path), exist_ok=True)
+        with open(path, "w", encoding="utf-8") as f:
+            f.write(render_metrics_doc(root))
+        print(f"wrote {path}")
+        return 0
+    violations = check_repo(root) + check_metrics_doc(root)
     for v in violations:
         print(v)
     if violations:
-        print(f"{len(violations)} un-exposable metric/span tag(s) found")
+        print(f"{len(violations)} metric tag/doc violation(s) found")
     return 1 if violations else 0
 
 

@@ -170,6 +170,19 @@ def feature_report() -> list[tuple[str, bool, str]]:
         "SLO-breach auto-capture)" if rt_on
         else "disabled (engine_v2 reqtrace=True / telemetry.reqtrace / "
              "DS_TPU_REQTRACE=1)"))
+    # fleet tracing (telemetry/fleettrace.py over serving/): pure host
+    # logic on the line protocol — availability is an import check
+    try:
+        from .telemetry import fleettrace as _ft  # noqa: F401
+        feats.append((
+            "fleet tracing (cross-replica postmortems)", True,
+            "RouterConfig(fleet_trace=True) — router-minted trace IDs "
+            "adopted fleet-wide, heartbeat clock-offset estimation, "
+            "merged clock-aligned timelines, black-box dumps "
+            "(bin/ds_postmortem), straggler gauges"))
+    except Exception as e:  # pragma: no cover — import breakage only
+        feats.append(("fleet tracing (cross-replica postmortems)", False,
+                      str(e)))
     fr = os.environ.get("DS_TPU_FLIGHT_RECORDER")
     feats.append(("flight recorder", True,
                   f"dumps to {fr}" if fr

@@ -2344,13 +2344,17 @@ class InferenceEngineV2:
         return self.state.can_admit(prompt_len, max_new_tokens)
 
     def put(self, uid: int, prompt_tokens, max_new_tokens: int = 32,
-            eos_token_id: int | None = None, tenant: str | None = None) -> None:
+            eos_token_id: int | None = None, tenant: str | None = None,
+            trace_id: str | None = None) -> None:
         """Admit a request (reference ``put`` :107). Raises if the pool or
         slot budget is exhausted — callers gate on ``can_schedule``.
         ``eos_token_id`` stops the sequence early (truncated at the eos).
         ``tenant`` attributes the request's tokens / KV residency / SLO
         observations to a bounded-cardinality tenant label (reqtrace;
-        ignored when tracing is off)."""
+        ignored when tracing is off). ``trace_id`` adopts an externally
+        minted canonical trace ID for the reqtrace timeline (a serving
+        replica passes the router's — fleet trace assembly keys on it)
+        instead of minting a process-local one."""
         toks = [int(t) for t in prompt_tokens]
         if not toks:
             raise ValueError("empty prompt")
@@ -2362,7 +2366,8 @@ class InferenceEngineV2:
             # trace opens BEFORE admit so the admit event (prefix-hit
             # extent, pages pinned — emitted inside StateManager.admit)
             # lands on an existing timeline
-            self._rt.begin(uid, tenant=tenant, prompt=len(toks))
+            self._rt.begin(uid, tenant=tenant, prompt=len(toks),
+                           trace_id=trace_id)
         try:
             with self._telem.span("admit", prompt=len(toks)):
                 seq = self.state.admit(uid, toks, max_new_tokens,
@@ -2640,8 +2645,11 @@ class InferenceEngineV2:
                 f"page geometry mismatch: bundle pages are "
                 f"{shell.page_bytes}B, this pool's are {want}B")
         if self._rt.enabled:
+            # adopt the exporter's canonical (router-minted) trace ID so
+            # both halves of the migrated request share one timeline key
             self._rt.begin(uid, tenant=shell.tenant,
-                           prompt=shell.prompt_len)
+                           prompt=shell.prompt_len,
+                           trace_id=shell.trace_id or None)
         try:
             self.state.migrate_in_begin(
                 uid, shell.tokens, shell.n_computed, shell.n_generated,

@@ -58,6 +58,10 @@ class MigrationState:
     src_slot: int
     src_epoch: int
     started_t: float
+    #: when the source leg completed and the router began relaying to
+    #: the target (monotonic; 0 = still receiving) — fleet tracing
+    #: splits the handoff stall into recv vs relay phases with it
+    recv_done_t: float = 0.0
     #: "handoff" (prefill->decode role split) | "rebalance" (router
     #: pulled a mid-decode victim off a hot replica — aborts RESUME the
     #: source instead of replaying) | "pull" (placement-time radix pull;

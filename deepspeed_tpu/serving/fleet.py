@@ -82,6 +82,12 @@ class ReplicaHandle:
         #: the replica's shared-memory page ring segment name (shm
         #: transport, serving/shm.py); None = relay-only peer
         self.shm: str | None = None
+        #: fleet tracing (telemetry/fleettrace.py): the router's latest
+        #: heartbeat-RTT and clock-offset estimates for this incarnation
+        #: (None until a ping round-trips; reset on respawn — the new
+        #: process re-measures)
+        self.rtt_s: float | None = None
+        self.clock_offset_s: float | None = None
         self.max_live = 0
         self.block_size = 0
         cfg = self._config()
@@ -127,6 +133,7 @@ class ReplicaHandle:
 
             self.state = SPAWNING
             self.load = self.digest = self.shm = None
+            self.rtt_s = self.clock_offset_s = None
             self.last_msg_t = time.monotonic()
             try:
                 self.chan = connect_channel(
@@ -167,6 +174,7 @@ class ReplicaHandle:
                                 self.proc.stdin.fileno(), own_fds=False)
         self.state = SPAWNING
         self.load = self.digest = self.shm = None
+        self.rtt_s = self.clock_offset_s = None
         self.last_msg_t = time.monotonic()
         logger.info(f"fleet: slot {self.slot} spawned epoch {self.epoch} "
                     f"(pid {self.proc.pid})")

@@ -15,7 +15,16 @@ Message vocabulary (``t`` is the type tag)::
      "tenant":str}                          admit a request
     {"t":"flush","id":str}                  abandon/clean up a request
     {"t":"drain"}                           finish in-flight, refuse puts
-    {"t":"ping"}                            answer with a heartbeat now
+    {"t":"ping","ts":float?}                answer with a heartbeat now;
+                                            "ts" (router monotonic) is
+                                            echoed in that heartbeat —
+                                            the fleet-trace clock-sync
+                                            exchange (RTT midpoint ->
+                                            per-replica clock offset)
+    {"t":"trace_req","id":str}              fleet tracing: ship a live
+                                            (non-final) snapshot of this
+                                            request's timeline segment
+                                            now (breach sampling)
     {"t":"shutdown"}                        exit after "bye"
     {"t":"mig_begin","id":str,"a":int,"meta":{...}}  a page bundle is
                                             about to arrive (decode
@@ -66,7 +75,21 @@ Message vocabulary (``t`` is the type tag)::
                                             only serve streaming latency
     {"t":"failed","id":str,"reason":str}    structured per-request failure
     {"t":"hb","load":{...},"digest":[int]|null}  liveness + backlog +
-                                            prefix-cache residency digest
+                                            prefix-cache residency digest;
+                                            when answering a ping it also
+                                            carries "echo" (the ping's
+                                            ts), "mono" and "wall" (this
+                                            replica's clocks) — the
+                                            router's clock-offset sample
+    {"t":"trace","id":str,"a":int,"pid":int,"fin":bool,
+     "events":[[mono,wall,kind,fields]],"dropped":int}  fleet tracing:
+                                            one bounded, drop-counted
+                                            timeline segment for this
+                                            request (shipped at release/
+                                            handoff, or live on
+                                            trace_req); the router's
+                                            assembler merges it
+                                            clock-aligned
     {"t":"handoff","id":str,"a":int,"meta":{...},"chunks":int}  this
                                             sequence crossed the
                                             prefill->decode boundary;

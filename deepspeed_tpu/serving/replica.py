@@ -488,8 +488,12 @@ class EngineBackend:
         uid = self._next_uid
         self._next_uid += 1
         try:
+            # the router's trace ID is the canonical one fleet-wide: the
+            # engine's reqtrace timeline adopts it instead of minting its
+            # own, so one ID names the request in every process
             self.eng.put(uid, rec.prompt, rec.max_new_tokens,
-                         eos_token_id=rec.eos_token_id, tenant=rec.tenant)
+                         eos_token_id=rec.eos_token_id, tenant=rec.tenant,
+                         trace_id=rec.trace_id)
         except (RuntimeError, ValueError) as e:
             logger.warning(f"replica: admit of {rec.trace_id} failed: {e}")
             return "capacity"
@@ -809,6 +813,59 @@ def serve(cfg: dict, chan: LineChannel) -> int:
     digest_ver_sent = -1                 # first heartbeat always ships it
     stall_until = 0.0
     stalled: list[dict] = []             # stream msgs queued during a stall
+    # fleet tracing (telemetry/fleettrace.py): record per-request
+    # timeline segments (both clocks) and ship them to the router on the
+    # line protocol — bounded per request AND per process, drop-counted.
+    # Disabled (the default) records nothing and ships nothing: every
+    # entry point below is one `trace_on` check.
+    trace_on = bool(cfg.get("fleet_trace"))
+    trace_max = int(cfg.get("fleet_trace_max_events", 64))
+    rtrace: dict[str, dict] = {}         # rid -> {ev, sent, dropped}
+    # injected clock skew (chaos/tests): shifts every timestamp this
+    # replica reports — trace events AND the heartbeat echo clocks — so
+    # the router's offset estimator must actually correct it
+    skew = float(cfg.get("clock_skew_s", 0.0) or 0.0)
+    ping_echo: float | None = None       # ts of the ping to echo next hb
+
+    def _tnow() -> float:
+        return time.monotonic() + skew
+
+    def _trace_ev(rid: str, kind: str, **fields) -> None:
+        if not trace_on:
+            return
+        ent = rtrace.get(rid)
+        if ent is None:
+            while len(rtrace) >= 64:     # bounded live set, oldest out
+                rtrace.pop(next(iter(rtrace)))
+            ent = rtrace[rid] = {"ev": [], "sent": 0, "dropped": 0}
+        if len(ent["ev"]) < trace_max:
+            ent["ev"].append([round(_tnow(), 6),
+                              round(time.time() + skew, 6), kind,
+                              fields or None])
+        else:
+            ent["dropped"] += 1
+
+    def _trace_ship(rid: str, fin: bool = True) -> None:
+        """Ship this request's unsent timeline events to the router.
+        ``fin`` frees the buffer (request left this replica); a non-final
+        ship (breach sampling / handoff export) marks what was sent so
+        nothing is delivered twice."""
+        if not trace_on:
+            return
+        ent = rtrace.pop(rid, None) if fin else rtrace.get(rid)
+        if ent is None:
+            return
+        ev = ent["ev"][ent["sent"]:]
+        if not ev and not (fin and ent["dropped"]):
+            return
+        if not fin:
+            ent["sent"] = len(ent["ev"])
+        # the drop count rides only the FINAL segment (the assembler
+        # sums per-segment drops; an incremental resend must not double
+        # it)
+        _stream({"t": "trace", "id": rid, "a": attempts.get(rid, 0),
+                 "pid": os.getpid(), "fin": fin, "events": ev,
+                 "dropped": ent["dropped"] if fin else 0})
     # placement-time radix pulls (puller side): puts held back while
     # their pulled chain is in flight — {"put", "deadline", "asm",
     # "shm", "relay"}; admitted (recompute fallback) at the deadline NO
@@ -907,12 +964,16 @@ def serve(cfg: dict, chan: LineChannel) -> int:
         backend.cancel(rid)
         reason = backend.put(RequestRecord.from_wire(msg))
         if reason:
+            _trace_ev(rid, "reject", reason=reason)
+            _trace_ship(rid)
             _stream({"t": "failed", "id": rid,
                      "a": attempts.get(rid, 0), "reason": reason})
-        elif telem is not None:
-            telem.registry.counter(
-                "serving_replica_requests_total",
-                help="requests admitted by this replica").inc()
+        else:
+            _trace_ev(rid, "admit")
+            if telem is not None:
+                telem.registry.counter(
+                    "serving_replica_requests_total",
+                    help="requests admitted by this replica").inc()
 
     def _settle_pull(rid: str, pages: int, nbytes: int = 0) -> None:
         """A pull resolved (adopted, failed, or timed out): admit the
@@ -921,6 +982,7 @@ def serve(cfg: dict, chan: LineChannel) -> int:
         entry = pulls.pop(rid, None)
         if entry is None:
             return
+        _trace_ev(rid, "pull_settle", pages=pages)
         _stream({"t": "kv_ack", "id": rid, "a": attempts.get(rid, 0),
                  "pages": pages, "bytes": nbytes})
         _admit_put(entry["put"])
@@ -938,6 +1000,8 @@ def serve(cfg: dict, chan: LineChannel) -> int:
             if t == "put":
                 rid = str(msg["id"])
                 attempts[rid] = int(msg.get("a", 0))
+                _trace_ev(rid, "put", prompt=len(msg.get("prompt", ())),
+                          pull=bool(msg.get("pull")))
                 if not draining and inj.countdown("replica_crash_on_put"):
                     inj.crash_now("replica_crash_on_put",
                                   f"admit of {rid}")
@@ -956,6 +1020,8 @@ def serve(cfg: dict, chan: LineChannel) -> int:
                 rid = str(msg["id"])
                 pulls.pop(rid, None)
                 pull_exports.pop(rid, None)
+                _trace_ev(rid, "flush")
+                _trace_ship(rid)
                 backend.cancel(rid)
             elif t == "mig_begin":
                 # a migrated-in sequence is arriving (decode role): claim
@@ -968,6 +1034,7 @@ def serve(cfg: dict, chan: LineChannel) -> int:
                     _stream({"t": "failed", "id": rid, "a": attempts[rid],
                              "reason": reason})
                 else:
+                    _trace_ev(rid, "import_begin")
                     mig_shm[rid] = msg.get("shm")
             elif t == "mig_chunk":
                 rid = str(msg["id"])
@@ -1006,6 +1073,7 @@ def serve(cfg: dict, chan: LineChannel) -> int:
                 elif status == "ok":
                     mig_shm.pop(rid, None)
                     mig_relay_need.discard(rid)
+                    _trace_ev(rid, "import_ok")
                     _stream({"t": "mig_ack", "id": rid, "a": a})
                     if telem is not None:
                         telem.registry.counter(
@@ -1015,17 +1083,27 @@ def serve(cfg: dict, chan: LineChannel) -> int:
                 else:
                     mig_shm.pop(rid, None)
                     mig_relay_need.discard(rid)
+                    _trace_ev(rid, "import_failed", reason=str(aux))
                     _stream({"t": "failed", "id": rid, "a": a,
                              "reason": str(aux)})
+                    _trace_ship(rid)
             elif t == "mig_ack":
                 # the importer owns the stream: release our pinned pages
                 # (publishing the prefix into the local trie)
-                backend.export_commit(str(msg["id"]))
+                rid = str(msg["id"])
+                _trace_ev(rid, "export_commit")
+                _trace_ship(rid)
+                backend.export_commit(rid)
             elif t == "mig_abort":
-                backend.export_abort(str(msg["id"]), resume=False)
+                rid = str(msg["id"])
+                _trace_ev(rid, "export_abort")
+                _trace_ship(rid)
+                backend.export_abort(rid, resume=False)
             elif t == "mig_resume":
                 # no decode-capable replica: keep serving it here
-                backend.export_abort(str(msg["id"]), resume=True)
+                rid = str(msg["id"])
+                _trace_ev(rid, "resume_local")
+                backend.export_abort(rid, resume=True)
             elif t == "mig_request":
                 # hot-replica rebalancing: the router asked us to hand
                 # this mid-decode sequence off; stale requests no-op
@@ -1147,8 +1225,17 @@ def serve(cfg: dict, chan: LineChannel) -> int:
                 _settle_pull(str(msg["id"]), 0)
             elif t == "drain":
                 draining = True
+            elif t == "trace_req":
+                # breach sampling: the router wants this request's LIVE
+                # timeline segment now (fin=False — the rest ships at
+                # release)
+                _trace_ship(str(msg["id"]), fin=False)
             elif t == "ping":
                 last_hb = 0.0            # answer with an immediate hb
+                if "ts" in msg:
+                    # clock-sync exchange: echo the router's timestamp
+                    # (with our clocks) in that heartbeat
+                    ping_echo = msg["ts"]
             elif t == "shutdown":
                 try:
                     chan.send({"t": "bye"}, timeout=1.0)
@@ -1167,6 +1254,7 @@ def serve(cfg: dict, chan: LineChannel) -> int:
                 if inj.countdown("replica_stall_stream_after_chunks"):
                     stall_until = time.monotonic() + float(
                         inj.value("replica_stall_stream_s") or 1.0)
+                _trace_ev(rid, "chunk", n=len(toks), off=off)
                 _stream({"t": "chunk", "id": rid, "a": a, "off": off,
                          "toks": toks})
                 if telem is not None:
@@ -1178,11 +1266,15 @@ def serve(cfg: dict, chan: LineChannel) -> int:
                 attempts.pop(rid, None)
                 if inj.countdown("replica_drop_done"):
                     continue             # lost completion reply
+                _trace_ev(rid, "done", n=len(toks))
                 _stream({"t": "done", "id": rid, "a": a, "toks": toks})
+                _trace_ship(rid)
             else:
                 attempts.pop(rid, None)
+                _trace_ev(rid, "failed", reason=str(toks))
                 _stream({"t": "failed", "id": rid, "a": a,
                          "reason": str(toks)})
+                _trace_ship(rid)
 
         # sequences frozen for transfer — a prefill role's boundary
         # crossings plus any router-requested rebalance victims: bundle
@@ -1198,6 +1290,11 @@ def serve(cfg: dict, chan: LineChannel) -> int:
                 _stream({"t": "chunk", "id": rid, "a": a, "off": off,
                          "toks": catchup})
             chunks, used = _wire_chunks(bundle)
+            _trace_ev(rid, "handoff_export", chunks=len(chunks),
+                      bytes=bundle.payload_bytes)
+            # non-final ship: the export may still commit, abort or
+            # resume here — those events ride the final segment
+            _trace_ship(rid, fin=False)
             _stream({"t": "handoff", "id": rid, "a": a,
                      "meta": bundle.meta(), "chunks": len(chunks),
                      "shm": ring.name if used else None})
@@ -1233,6 +1330,13 @@ def serve(cfg: dict, chan: LineChannel) -> int:
         if now - last_hb >= hb_interval:
             last_hb = now
             hb: dict = {"t": "hb", "load": backend.load()}
+            if ping_echo is not None:
+                # clock-sync answer: the router computes rtt from its
+                # echoed timestamp and our offset from the RTT midpoint
+                hb["echo"] = ping_echo
+                hb["mono"] = round(_tnow(), 6)
+                hb["wall"] = round(time.time() + skew, 6)
+                ping_echo = None
             # the digest rides the heartbeat only when the trie actually
             # changed — at heartbeat cadence, recomputing and re-shipping
             # a warm cache's thousands of chain hashes every few dozen

@@ -129,6 +129,28 @@ class RouterConfig:
     rebalance_sustain_s: float = 2.0
     rebalance_min_interval_s: float = 1.0
     telemetry: bool = False
+    #: fleet-wide distributed tracing (telemetry/fleettrace.py): the
+    #: router records its own per-request events, replicas ship their
+    #: timeline segments back on the line protocol, heartbeat pings
+    #: estimate per-replica clock offsets, and the merged clock-aligned
+    #: timeline feeds black-box dumps + straggler gauges. Disabled (the
+    #: default) none of it exists: no assembler, no pings, no segment
+    #: shipping, zero buffer growth — the PR-4/7 zero-overhead property.
+    fleet_trace: bool = False
+    #: router-observed TTFT threshold that triggers a black-box dump
+    #: (falls back to ``slo_ttft_s``; None with no slo_ttft_s = breach
+    #: dumps off, death/breaker/migration triggers still fire)
+    fleet_trace_slo_ttft_s: float | None = None
+    #: rate limit between black-box dumps (the breach storm guard)
+    fleet_breach_interval_s: float = 60.0
+    #: directory for black-box dump files (fleet_blackbox_*.json);
+    #: None = the flight recorder's default path / log-only
+    fleet_trace_dir: str | None = None
+    #: clock-sync ping cadence per ready replica
+    clock_sync_interval_s: float = 0.25
+    #: robust z-score past which a replica's latency distributions mark
+    #: it degraded (straggler detection — signals only)
+    straggler_z: float = 3.0
 
 
 @dataclass
@@ -209,6 +231,29 @@ class Router:
         self.kv_pulls = 0
         self.kv_pull_fallbacks = 0
         self.rebalances = 0
+        # fleet-wide distributed tracing (telemetry/fleettrace.py):
+        # constructed ONLY when enabled — disabled is zero-overhead by
+        # absence, and replicas are told to record/ship segments via the
+        # config template so both sides gate on one knob
+        self._ftrace = None
+        self._straggler = None
+        self.blackbox_dumps = 0
+        self.trace_segments = 0
+        if self.cfg.fleet_trace:
+            from ..telemetry.fleettrace import (FleetTraceAssembler,
+                                                StragglerScorer)
+            self._ftrace = FleetTraceAssembler()
+            self._straggler = StragglerScorer(
+                z_threshold=self.cfg.straggler_z)
+            self.cfg.fleet.replica.setdefault("fleet_trace", True)
+        self._last_clock_ping = 0.0
+        self._last_bb_dump = 0.0
+        self._bb_dumped: set[str] = set()
+        #: breach dumps waiting for the live replica segment to land:
+        #: tid -> (deadline, trigger dict)
+        self._bb_pending: dict[str, tuple[float, dict]] = {}
+        self._seen_breaker_opens = 0
+        self._last_straggler_gauges = 0.0
 
     # -- lifecycle -------------------------------------------------------
     def start(self, min_ready: int = 1) -> None:
@@ -297,6 +342,8 @@ class Router:
         self._reqs[tid] = req
         self._queues.setdefault(rec.priority, deque()).append(tid)
         self._tenant_live[tenant] = self._tenant_live.get(tenant, 0) + 1
+        self._fev(tid, "enqueue", tenant=tenant, prompt=len(rec.prompt),
+                  priority=int(priority))
         if self._telem.enabled:
             self._telem.registry.counter(
                 "serving_router_requests_total",
@@ -345,8 +392,29 @@ class Router:
         for r in self.fleet.maintain(now):
             self._sticky.forget_slot(r.slot)
             self._rebal.note_slot_died(r.slot)
+            if self._ftrace is not None:
+                # black-box the death BEFORE replaying its orphans: the
+                # dump's timeline is one of the requests the death
+                # interrupted, assembled from router-side events plus
+                # whatever segments already shipped (the surviving
+                # replicas' halves)
+                orphan = next(
+                    (tid for tid, rq in self._reqs.items()
+                     if rq.status == ASSIGNED
+                     and rq.assigned_slot == r.slot
+                     and rq.assigned_epoch <= r.epoch), None)
+                self._blackbox({"kind": "replica_death", "slot": r.slot,
+                                "trace_id": orphan})
+                self._straggler.forget_slot(r.slot)
+                # the dead incarnation's clock samples are deliberately
+                # KEPT: its buffered trace segments still need alignment
+                # (ClockSync keys by (slot, epoch) and bounds retention)
             self._fail_pulls_from(r.slot, r.epoch)
             self._replay_orphans(r.slot, r.epoch, "replica_lost")
+        if self._ftrace is not None \
+                and self.fleet.breaker_opens_total > self._seen_breaker_opens:
+            self._seen_breaker_opens = self.fleet.breaker_opens_total
+            self._blackbox({"kind": "breaker_open"})
         for ch in poll_channels(
                 self.fleet.channels(),
                 self.cfg.poll_interval_s if budget_s is None else budget_s):
@@ -365,6 +433,19 @@ class Router:
         self._check_deadlines(time.monotonic())
         now = time.monotonic()
         self._sweep_transfers(now)
+        if self._ftrace is not None:
+            # clock-sync pings (the replicas echo next heartbeat), any
+            # breach dumps whose live segments landed, straggler gauges
+            if now - self._last_clock_ping \
+                    >= self.cfg.clock_sync_interval_s:
+                self._last_clock_ping = now
+                for rep in self.fleet.ready():
+                    rep.send({"t": "ping",
+                              "ts": round(time.monotonic(), 6)})
+            self._sweep_blackbox(now)
+            if now - self._last_straggler_gauges >= 1.0:
+                self._last_straggler_gauges = now
+                self._update_straggler_gauges()
         self._dispatch(now)
         # per-role autoscale hints: signals only (gauges), no actuator
         self._scale.update(
@@ -407,6 +488,10 @@ class Router:
                 # (replicas version it); the router keeps its copy
                 d = msg["digest"]
                 h.digest = set(d) if d else None
+            if self._ftrace is not None and "echo" in msg:
+                self._on_clock_sample(h, msg)
+        elif t == "trace":
+            self._on_trace(h, msg)
         elif t in ("chunk", "done", "failed"):
             self._on_stream(h, msg)
         elif t in ("handoff", "mig_chunk", "mig_eof", "mig_ack",
@@ -497,6 +582,13 @@ class Router:
             return
         if req.first_tok_t == 0.0:
             req.first_tok_t = now
+            if self._ftrace is not None:
+                ttft = now - req.submit_t
+                self._fev(req.rec.trace_id, "first_chunk",
+                          slot=req.assigned_slot,
+                          ttft_s=round(ttft, 6))
+                self._straggler.note(req.assigned_slot, "ttft", ttft)
+                self._maybe_breach(req, ttft)
             if self._telem.enabled:
                 self._telem.registry.histogram(
                     "serving_router_ttft_s", buckets=LATENCY_BUCKETS_S,
@@ -532,9 +624,16 @@ class Router:
             self._commits.append((now, n))
 
     def _observe_latency(self, req: _Req) -> None:
-        if not self._telem.enabled or req.result is None:
+        if req.result is None:
             return
         n = len(req.result)
+        if self._straggler is not None and n >= 2 and req.first_tok_t \
+                and req.assigned_slot >= 0:
+            self._straggler.note(
+                req.assigned_slot, "tbt",
+                (req.done_t - req.first_tok_t) / (n - 1))
+        if not self._telem.enabled:
+            return
         if n >= 2 and req.first_tok_t:
             tbt = (req.done_t - req.first_tok_t) / (n - 1)
             self._telem.registry.histogram(
@@ -585,6 +684,8 @@ class Router:
                                      shm=msg.get("shm"))
             self._page_bytes = int((msg.get("meta") or {}).get(
                 "page_bytes", self._page_bytes) or self._page_bytes)
+            self._fev(tid, "handoff_recv", slot=h.slot, mig_kind=kind,
+                      chunks=int(msg.get("chunks", 0)))
             self.migrations += 1
             if self._telem.enabled:
                 self._telem.registry.counter(
@@ -660,6 +761,13 @@ class Router:
             self._send_to_slot(mig.src_slot, mig.src_epoch,
                                {"t": "mig_ack", "id": tid})
             self._release_slot_count(mig.src_slot)
+            if self._ftrace is not None:
+                stall = now - mig.started_t
+                self._fev(tid, "handoff_ack", src_slot=mig.src_slot,
+                          tgt_slot=h.slot, stall_s=round(stall, 6),
+                          relay_s=round(now - mig.recv_done_t, 6)
+                          if mig.recv_done_t else None)
+                self._straggler.note(mig.src_slot, "handoff_stall", stall)
             req.migrated = True
             if mig.kind == "rebalance":
                 req.rebalanced = True
@@ -698,6 +806,7 @@ class Router:
             else:
                 req.rebalanced = True
             self.migration_fallbacks += 1
+            self._fev(tid, "mig_resume", slot=mig.src_slot)
             self._send_to_slot(mig.src_slot, mig.src_epoch,
                                {"t": "mig_resume", "id": tid})
             req.mig = None
@@ -724,6 +833,10 @@ class Router:
         self._sticky.note(chain, rep.slot)
         mig.phase = "xfer"
         mig.tgt_slot = rep.slot
+        mig.recv_done_t = time.monotonic()
+        self._fev(tid, "relay_begin", src_slot=mig.src_slot,
+                  tgt_slot=rep.slot, hit_pages=hit, chunks=mig.total,
+                  recv_s=round(mig.recv_done_t - mig.started_t, 6))
         ok = rep.send({"t": "mig_begin", "id": tid, "a": req.attempt,
                        "meta": mig.meta, "shm": mig.shm})
         for i in range(mig.total if ok else 0):
@@ -744,6 +857,8 @@ class Router:
             return
         req.mig = None
         tid = req.rec.trace_id
+        self._fev(tid, "migration_abort", reason=reason,
+                  src_slot=mig.src_slot)
         self._send_to_slot(mig.src_slot, mig.src_epoch,
                            {"t": "mig_abort", "id": tid})
         if mig.phase == "xfer":
@@ -806,6 +921,12 @@ class Router:
                 and self._slot_alive(mig.src_slot, mig.src_epoch):
             self._abort_rebalance(req, reason)
             return
+        if self._ftrace is not None and mig is not None:
+            # a genuinely failed transfer (not a benign settle) is a
+            # black-box trigger: the dump shows which leg died
+            self._blackbox({"kind": "migration_failed", "reason": reason,
+                            "trace_id": req.rec.trace_id,
+                            "slot": mig.src_slot})
         self._abort_migration(req, reason)
         self._retry_or_fail(req, reason)
 
@@ -848,6 +969,7 @@ class Router:
             return
         req.retries += 1
         req.status = QUEUED
+        self._fev(tid, "retry", reason=reason, retries=req.retries)
         # replay jumps the line: the request already waited its turn once
         self._queues.setdefault(req.rec.priority, deque()).appendleft(tid)
         if self._telem.enabled:
@@ -871,6 +993,219 @@ class Router:
                     self.fleet.replicas[slot].send(
                         {"t": "flush", "id": tid})
                 self._retry_or_fail(req, "timeout")
+
+    # -- fleet tracing: clock sync, assembly, black box, stragglers ------
+    # (telemetry/fleettrace.py; everything here is a no-op when
+    # cfg.fleet_trace is off — self._ftrace is None and no branch runs)
+
+    def _fev(self, tid: str, kind: str, **fields) -> None:
+        if self._ftrace is not None:
+            self._ftrace.router_event(tid, kind, **fields)
+
+    def _on_clock_sample(self, h, msg: dict) -> None:
+        """A heartbeat answered a clock-sync ping: RTT from the echoed
+        timestamp, offset from the RTT midpoint (replica clock minus
+        router clock; half-RTT is the uncertainty)."""
+        now = time.monotonic()
+        try:
+            echo = float(msg["echo"])
+            mono = float(msg["mono"])
+        except (TypeError, ValueError, KeyError):
+            return
+        rtt = max(now - echo, 0.0)
+        offset = mono - (echo + rtt / 2.0)
+        self._ftrace.clock.note(h.slot, rtt, offset, epoch=h.epoch)
+        h.rtt_s = self._ftrace.clock.rtt(h.slot, h.epoch)
+        h.clock_offset_s = self._ftrace.clock.offset(h.slot, h.epoch)[0]
+        if self._telem.enabled:
+            self._telem.registry.gauge(
+                "serving_router_replica_rtt_s",
+                labels={"replica": str(h.slot)},
+                help="best heartbeat round-trip time per replica in the "
+                     "clock-sync window").set(round(h.rtt_s, 6))
+            self._telem.registry.gauge(
+                "serving_router_replica_clock_offset_s",
+                labels={"replica": str(h.slot)},
+                help="estimated replica monotonic-clock offset vs the "
+                     "router (RTT-midpoint method); drift here is drift "
+                     "in every aligned timeline").set(
+                round(h.clock_offset_s, 6))
+
+    def _on_trace(self, h, msg: dict) -> None:
+        """A replica shipped a timeline segment. NOT nonce-guarded: a
+        source's final segment legitimately arrives after the request's
+        assignment moved to the handoff target — the assembler keys
+        segments by (slot, epoch) so stale incarnations stay separate."""
+        if self._ftrace is None:
+            return
+        self.trace_segments += 1
+        self._ftrace.add_segment(
+            str(msg.get("id")), h.slot, h.epoch,
+            int(msg.get("pid", 0)), msg.get("events") or [],
+            int(msg.get("dropped", 0)))
+        if self._telem.enabled:
+            self._telem.registry.counter(
+                "serving_router_trace_segments_total",
+                help="replica timeline segments shipped to the fleet "
+                     "trace assembler").inc()
+
+    def _maybe_breach(self, req: _Req, ttft_s: float) -> None:
+        """Router-observed TTFT crossed the fleet-trace threshold: count
+        it and schedule ONE rate-limited black-box dump — after asking
+        the assigned replica for its live timeline segment (breach
+        sampling), so the dump carries both sides."""
+        thr = self.cfg.fleet_trace_slo_ttft_s \
+            if self.cfg.fleet_trace_slo_ttft_s is not None \
+            else self.cfg.slo_ttft_s
+        if self._ftrace is None or thr is None or ttft_s <= thr:
+            return
+        if self._telem.enabled:
+            self._telem.registry.counter(
+                "serving_router_slo_breach_total", labels={"slo": "ttft"},
+                help="router-observed SLO threshold crossings (fleet "
+                     "tracing)").inc()
+        tid = req.rec.trace_id
+        now = time.monotonic()
+        if tid in self._bb_dumped \
+                or now - self._last_bb_dump \
+                < self.cfg.fleet_breach_interval_s:
+            return
+        self._last_bb_dump = now
+        self._bb_dumped.add(tid)
+        self._send_to_slot(req.assigned_slot, req.assigned_epoch,
+                           {"t": "trace_req", "id": tid})
+        self._bb_pending[tid] = (now + 1.0, {
+            "kind": "ttft_breach", "slo": "ttft", "trace_id": tid,
+            "value": round(ttft_s, 6), "threshold": thr})
+
+    def _sweep_blackbox(self, now: float) -> None:
+        """Flush pending breach dumps once their request went terminal
+        (the replica's final segment shipped with its done) or the wait
+        deadline passed — the dump is atomic and bounded either way."""
+        for tid in list(self._bb_pending):
+            deadline, trig = self._bb_pending[tid]
+            req = self._reqs.get(tid)
+            if req is None or req.status in (DONE, FAILED, SHED) \
+                    or now >= deadline:
+                del self._bb_pending[tid]
+                self._dump_blackbox(trig)
+
+    def _blackbox(self, trigger: dict) -> None:
+        """Rate-limited immediate black-box dump for non-breach triggers
+        (replica death, breaker open, failed migration)."""
+        now = time.monotonic()
+        if now - self._last_bb_dump < self.cfg.fleet_breach_interval_s:
+            return
+        self._last_bb_dump = now
+        tid = trigger.get("trace_id")
+        if tid:
+            self._bb_dumped.add(tid)
+        self._dump_blackbox(trigger)
+
+    def _fleet_state(self) -> dict:
+        """The dump's fleet snapshot: slot states, live assignments,
+        queue depths, transfer buffers, residency-digest summary."""
+        reps = {}
+        for r in self.fleet.replicas:
+            reps[str(r.slot)] = {
+                "state": r.state, "role": role_of(r), "epoch": r.epoch,
+                "live": (r.load or {}).get("live"),
+                "digest_entries": len(r.digest) if r.digest else 0,
+                "rtt_s": r.rtt_s, "clock_offset_s": r.clock_offset_s}
+        assignments = {
+            tid: {"status": rq.status, "slot": rq.assigned_slot,
+                  "attempt": rq.attempt, "retries": rq.retries,
+                  "migrating": rq.mig is not None}
+            for tid, rq in self._reqs.items()
+            if rq.status in (QUEUED, ASSIGNED)}
+        return {
+            "replicas": reps,
+            "assignments": assignments,
+            "queued": {str(p): len(q) for p, q in self._queues.items()
+                       if q},
+            "transfers": {
+                "migrations_in_flight": sum(
+                    1 for rq in self._reqs.values() if rq.mig is not None),
+                "pulls_in_flight": len(self._pulls)},
+            "quarantined": [r.slot for r in self.fleet.replicas
+                            if r.state == QUARANTINED]}
+
+    def _dump_blackbox(self, trigger: dict) -> None:
+        """One atomic flight-recorder dump: trigger + merged clock-
+        aligned timeline + clock table + fleet state + health rollup."""
+        tid = trigger.get("trace_id")
+        timeline = self._ftrace.assemble(tid) if tid else None
+        path = None
+        if self.cfg.fleet_trace_dir:
+            os.makedirs(self.cfg.fleet_trace_dir, exist_ok=True)
+            path = os.path.join(
+                self.cfg.fleet_trace_dir,
+                f"fleet_blackbox_{self.blackbox_dumps + 1}.json")
+        detail = trigger.get("kind", "fleet") + (
+            f" (trace {tid})" if tid else "")
+        self._telem.recorder.dump(
+            "fleet_blackbox", path=path, detail=detail,
+            extra={"fleet": {
+                "trigger": trigger,
+                "timeline": timeline,
+                "clock": self._ftrace.clock.to_dict(),
+                "fleet_state": self._fleet_state(),
+                "health": self.fleet_health()}})
+        self.blackbox_dumps += 1
+        if self._telem.enabled:
+            self._telem.registry.counter(
+                "serving_router_blackbox_dumps_total",
+                labels={"trigger": sanitize_label_value(
+                    trigger.get("kind", "unknown"))},
+                help="rate-limited fleet black-box dumps, by "
+                     "trigger").inc()
+
+    def _update_straggler_gauges(self) -> None:
+        if not self._telem.enabled:
+            return
+        degraded = self._straggler.degraded()
+        for r in self.fleet.replicas:
+            self._telem.registry.gauge(
+                "serving_router_replica_degraded",
+                labels={"replica": str(r.slot)},
+                help="1 when this replica's rolling TTFT/TBT/handoff "
+                     "latency medians score past the robust-z straggler "
+                     "threshold vs the fleet (signals only, no "
+                     "actuation)").set(int(degraded.get(r.slot, False)))
+
+    def fleet_health(self) -> dict:
+        """The fleet-health rollup: per-slot state/role/clock/straggler
+        scores plus fleet-trace counters. Cheap, JSON-serializable —
+        bench artifacts and postmortem dumps attach it verbatim.
+        Straggler fields appear only with ``fleet_trace`` on."""
+        scores = self._straggler.scores() if self._straggler else {}
+        degraded = self._straggler.degraded() if self._straggler else {}
+        reps = {}
+        for r in self.fleet.replicas:
+            e = {"state": r.state, "role": role_of(r), "epoch": r.epoch,
+                 "live": (r.load or {}).get("live")}
+            if self._ftrace is not None:
+                e["rtt_s"] = r.rtt_s
+                e["clock_offset_s"] = r.clock_offset_s
+                e["degraded"] = bool(degraded.get(r.slot, False))
+                if scores.get(r.slot):
+                    e["z"] = scores[r.slot]
+            reps[str(r.slot)] = e
+        return {"replicas": reps,
+                "degraded": sorted(s for s, d in degraded.items() if d),
+                "blackbox_dumps": self.blackbox_dumps,
+                "trace_segments": self.trace_segments,
+                "fleet_trace": self._ftrace is not None}
+
+    def export_fleet_chrome(self, path: str,
+                            tids: list[str] | None = None) -> str:
+        """Fleet-mode Chrome trace: one track per process (router + each
+        replica), replica events shifted onto the router's clock by the
+        heartbeat offset estimates. Requires ``fleet_trace=True``."""
+        if self._ftrace is None:
+            raise RuntimeError("fleet tracing is disabled "
+                               "(RouterConfig.fleet_trace)")
+        return self._ftrace.export_chrome_trace(path, tids)
 
     # -- dispatch --------------------------------------------------------
     def _candidates(self, roles=None) -> list:
@@ -929,6 +1264,11 @@ class Router:
                 # it recomputes — the always-safe fallback)
                 wire["pull"] = {"pages": peer_pages,
                                 "deadline_s": self.cfg.kv_pull_timeout_s}
+            self._fev(tid, "placed", slot=rep.slot, attempt=req.attempt,
+                      hit_pages=hit_pages, chain_pages=len(req.chain),
+                      role_fallback=role_fallback,
+                      pull_slot=pull_peer.slot
+                      if pull_peer is not None else None)
             if not rep.send(wire):
                 # send failed: the slot is toast; requeue and let
                 # maintain() reap it next tick
@@ -1000,6 +1340,8 @@ class Router:
             meta={}, src_slot=peer.slot, src_epoch=peer.epoch,
             started_t=now, kind="pull", tgt_slot=rep.slot,
             src_attempt=req.attempt)
+        self._fev(tid, "pull_start", src_slot=peer.slot,
+                  tgt_slot=rep.slot, pages=pages)
         self.kv_pulls += 1
         if self._telem.enabled:
             self._telem.registry.counter(
@@ -1285,6 +1627,9 @@ class Router:
         self._unassign(req)
         req.status = status
         req.reason = reason
+        self._fev(tid, status, reason=reason,
+                  tokens=len(req.result) if req.result is not None
+                  else len(req.committed))
         t = self._tenant_live.get(req.rec.tenant, 1) - 1
         self._tenant_live[req.rec.tenant] = max(t, 0)
         if self._telem.enabled:

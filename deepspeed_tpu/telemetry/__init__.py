@@ -26,6 +26,8 @@ from ..utils.logging import logger
 from .metrics import (LATENCY_BUCKETS_S, RATIO_BUCKETS, Counter, Gauge,
                       Histogram, MetricsRegistry, sanitize_label_value,
                       sanitize_metric_name)
+from .fleettrace import (ClockSync, FleetTraceAssembler, StragglerScorer,
+                         postmortem_report)
 from .mfu import MFUTracker, device_peak_flops, goodput, mfu
 from .recorder import FlightRecorder
 from .reqtrace import (LIFECYCLE_EVENTS, TENANT_CARDINALITY_CAP,
@@ -46,6 +48,8 @@ __all__ = [
     "SERVING_ROUTER_PREFIX", "ROUTER_RUN_PREFIXES",
     "SpanTracer", "MetricsRegistry", "Counter", "Gauge", "Histogram",
     "FlightRecorder", "TelemetryHTTPServer", "MFUTracker", "ReqTracer",
+    "ClockSync", "FleetTraceAssembler", "StragglerScorer",
+    "postmortem_report",
     "mfu", "goodput", "device_peak_flops", "sanitize_metric_name",
     "sanitize_label_value", "LIFECYCLE_EVENTS", "TENANT_CARDINALITY_CAP",
     "TENANT_OVERFLOW_LABEL",
@@ -180,7 +184,8 @@ class Telemetry:
         if self.server is None:
             server = TelemetryHTTPServer(self.registry,
                                          health_fn=self._health,
-                                         peer_glob=self.peer_snapshot_glob)
+                                         peer_glob=self.peer_snapshot_glob,
+                                         trace_fn=self._chrome_dict)
             if getattr(self, "_peer_staleness", None) is not None:
                 server.peer_staleness_s = self._peer_staleness
             server.start(port)      # raises on a busy port — don't keep a
@@ -243,17 +248,39 @@ class Telemetry:
                     detail: str | None = None) -> dict:
         return self.recorder.dump(reason, path=path, detail=detail)
 
-    def export_chrome_trace(self, path: str, last: int | None = None) -> str:
+    def _chrome_dict(self) -> dict:
+        """The live process timeline as a Chrome trace-event dict (host
+        spans + request lifecycles) — served at ``/trace`` so a fleet
+        postmortem can pull any process's view over HTTP."""
+        data = self.tracer.chrome_trace()
+        data["traceEvents"].extend(
+            self.reqtrace.chrome_events(self.tracer._epoch))
+        return data
+
+    def export_chrome_trace(self, path: str, last: int | None = None,
+                            fleet=None) -> str:
         """One Chrome/Perfetto trace carrying BOTH the host span timeline
         (pid 0, per-thread tracks) and the per-request lifecycle timelines
         (pid 1, one track per trace ID — reqtrace) on the same clock, so
         "which requests were in flight while dispatch stalled" is one
-        view."""
+        view.
+
+        **Fleet mode**: pass the router's
+        :class:`~.fleettrace.FleetTraceAssembler` as ``fleet`` and the
+        merged cross-replica request timelines render as additional
+        ALIGNED tracks — one pid per process (router + every replica),
+        replica events shifted onto the router's clock by the heartbeat
+        clock-offset estimates. perf_counter and monotonic are both
+        CLOCK_MONOTONIC on CPython/Linux, so the span tracks and fleet
+        tracks share a timebase."""
         import json as _json
 
         data = self.tracer.chrome_trace(last=last)
         data["traceEvents"].extend(
             self.reqtrace.chrome_events(self.tracer._epoch))
+        if fleet is not None:
+            data["traceEvents"].extend(
+                fleet.chrome_events(epoch=self.tracer._epoch))
         with open(path, "w") as f:
             _json.dump(data, f)
         return path

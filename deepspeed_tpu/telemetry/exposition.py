@@ -53,13 +53,19 @@ class TelemetryHTTPServer:
     (``telemetry_stale_peers_skipped``) and every peer's snapshot age is
     exposed (``telemetry_peer_snapshot_age_s{peer=...}``) so the scrape
     itself says which host went quiet. 0/None disables the cutoff.
+    ``trace_fn`` (optional) returns a Chrome trace-event dict served at
+    ``/trace`` — the live process timeline (host spans + request
+    lifecycles) fetched over HTTP instead of a file, so a fleet
+    postmortem can pull a process's view without filesystem access.
     """
 
     def __init__(self, registry, health_fn=None, host: str = "127.0.0.1",
                  peer_glob: str | None = None,
-                 peer_staleness_s: float | None = 300.0):
+                 peer_staleness_s: float | None = 300.0,
+                 trace_fn=None):
         self.registry = registry
         self.health_fn = health_fn
+        self.trace_fn = trace_fn
         self.host = host
         self.peer_glob = peer_glob
         self.peer_staleness_s = peer_staleness_s
@@ -163,6 +169,10 @@ class TelemetryHTTPServer:
                             body = server.registry.render_prometheus() \
                                 .encode()
                             ctype = PROMETHEUS_CONTENT_TYPE
+                    elif parts.path == "/trace" \
+                            and server.trace_fn is not None:
+                        body = json.dumps(server.trace_fn()).encode()
+                        ctype = "application/json"
                     elif parts.path == "/healthz":
                         health = {"status": "ok",
                                   "uptime_s": round(time.time() - server._t0, 3)}

@@ -37,8 +37,12 @@ class FlightRecorder:
         self.dumps = 0
 
     def note(self, kind: str, **data) -> None:
-        """Record a discrete event (bad step, rewind, ckpt commit, ...)."""
-        rec = {"t": time.time(), "kind": kind}
+        """Record a discrete event (bad step, rewind, ckpt commit, ...).
+        Carries BOTH clocks: ``t`` (wall — correlates with external logs
+        and other hosts) and ``mono`` (monotonic — orders against span /
+        reqtrace timelines in this process and the fleet assembler's
+        clock-aligned merge)."""
+        rec = {"t": time.time(), "mono": time.monotonic(), "kind": kind}
         if data:
             rec.update(data)
         self._events.append(rec)
@@ -55,10 +59,19 @@ class FlightRecorder:
         rec = {
             "reason": reason,
             "time": time.time(),
+            "time_mono": time.monotonic(),
             "pid": os.getpid(),
             "events": self.events(),
             "spans": (self.tracer.events(last=max_spans)
                       if self.tracer is not None else []),
+            # the wall anchor of the span clock: span t0s are
+            # perf_counter-only, and without this mapping a dump's span
+            # timeline cannot be correlated with external logs or other
+            # processes (wall ≈ span_epoch_wall + (t0 - span_epoch))
+            "span_epoch": (self.tracer._epoch
+                           if self.tracer is not None else None),
+            "span_epoch_wall": (self.tracer.epoch_wall
+                                if self.tracer is not None else None),
             "metrics": (self.registry.snapshot()
                         if self.registry is not None else {}),
         }
@@ -71,15 +84,19 @@ class FlightRecorder:
 
     def dump(self, reason: str, path: str | None = None,
              detail: str | None = None, extra: dict | None = None) -> dict:
-        """Write the postmortem record as one JSON file (append-numbered so
-        repeated dumps of a flapping job don't clobber each other); always
+        """Write the postmortem record as one JSON file. Dumps to the
+        DEFAULT path are append-numbered so repeated dumps of a flapping
+        job don't clobber each other; an explicit ``path=`` is honored
+        verbatim — callers passing one (the fleet black box numbers its
+        own ``fleet_blackbox_N.json`` files) already uniquify, and a
+        silent ``.N`` suffix would break their documented names. Always
         returns the record even when the write fails — the caller is
         usually mid-crash and must not die in its own error handler."""
         rec = self.record(reason, detail=detail, extra=extra)
         target = path or self.path
         self.dumps += 1
         if target:
-            final = target if self.dumps == 1 \
+            final = target if path is not None or self.dumps == 1 \
                 else f"{target}.{self.dumps}"
             try:
                 d = os.path.dirname(os.path.abspath(final))

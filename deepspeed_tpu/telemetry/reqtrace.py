@@ -88,8 +88,8 @@ TENANT_OVERFLOW_LABEL = "other"
 class _Req:
     """One request's trace state: identity + the bounded event timeline."""
 
-    __slots__ = ("trace_id", "uid", "tenant", "sampled", "t0", "t_admit",
-                 "pages", "events", "dropped")
+    __slots__ = ("trace_id", "uid", "tenant", "sampled", "t0", "wall0",
+                 "t_admit", "pages", "events", "dropped")
 
     def __init__(self, trace_id: str, uid: int, tenant: str, sampled: bool):
         self.trace_id = trace_id
@@ -97,6 +97,11 @@ class _Req:
         self.tenant = tenant
         self.sampled = sampled
         self.t0 = time.perf_counter()
+        #: wall anchor captured once at begin: per-event wall clocks are
+        #: wall0 + (t - t0) — zero per-event cost, and good enough to
+        #: correlate a timeline with external logs / other processes
+        #: (monotonic-only dumps cannot be correlated at all)
+        self.wall0 = time.time()
         self.t_admit: float | None = None
         self.pages = 0                      # blocks reserved at admit
         self.events: list[tuple] = []       # (t, kind, fields|None)
@@ -105,8 +110,12 @@ class _Req:
     def to_dict(self) -> dict:
         out = {"trace_id": self.trace_id, "uid": self.uid,
                "tenant": self.tenant, "sampled": self.sampled,
-               "t_start": self.t0, "events_dropped": self.dropped,
-               "events": [dict({"t": t, "kind": kind}, **(fields or {}))
+               "t_start": self.t0, "t_start_wall": self.wall0,
+               "events_dropped": self.dropped,
+               "events": [dict({"t": t,
+                                "wall": round(
+                                    self.wall0 + (t - self.t0), 6),
+                                "kind": kind}, **(fields or {}))
                           for t, kind, fields in self.events]}
         return out
 
@@ -157,6 +166,10 @@ class ReqTracer:
         self._labels: set[str] = set()
         self._ctr = itertools.count(1)
         self._pid = os.getpid()
+        # wall anchor for unattributed global-ring events (same one-shot
+        # scheme as _Req.wall0)
+        self._mono0 = time.perf_counter()
+        self._wall0 = time.time()
         self._last_breach_dump = 0.0
         self._profiling = False
         self.traces_started = 0
@@ -190,14 +203,19 @@ class ReqTracer:
         self._labels.add(label)
         return label
 
-    def begin(self, uid: int, tenant=None, prompt: int = 0) -> str | None:
+    def begin(self, uid: int, tenant=None, prompt: int = 0,
+              trace_id: str | None = None) -> str | None:
         """Open a trace for an arriving request: assign the trace ID,
         resolve the tenant label, decide sampling (deterministic in the
         trace ID), record the ``enqueue`` event. Returns the trace ID
-        (None when disabled)."""
+        (None when disabled). ``trace_id`` ADOPTS an externally minted
+        canonical ID instead of minting one — a serving replica passes
+        the router's trace ID here so one ID names the request in every
+        process the fleet assembler merges (fleettrace.py)."""
         if not self.enabled:
             return None
-        trace_id = f"{self._pid:x}-{uid & 0xFFFFFFFF:x}-{next(self._ctr):x}"
+        trace_id = trace_id or \
+            f"{self._pid:x}-{uid & 0xFFFFFFFF:x}-{next(self._ctr):x}"
         sampled = self.sample >= 1.0 or (
             (zlib.crc32(trace_id.encode()) & 0xFFFF) / 65536.0 < self.sample)
         old = self._live.pop(uid, None)
@@ -412,7 +430,9 @@ class ReqTracer:
         return None
 
     def global_events(self) -> list[dict]:
-        return [dict({"t": t, "kind": kind}, **(fields or {}))
+        return [dict({"t": t,
+                      "wall": round(self._wall0 + (t - self._mono0), 6),
+                      "kind": kind}, **(fields or {}))
                 for t, kind, fields in self._global]
 
     def __len__(self) -> int:

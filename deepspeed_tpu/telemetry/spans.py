@@ -115,6 +115,10 @@ class SpanTracer:
         self._n = 0                       # total spans ever recorded
         self._tl = threading.local()      # per-thread nesting depth
         self._epoch = time.perf_counter()
+        #: wall-clock anchor of ``_epoch`` — span t0s are monotonic-only
+        #: (cheap), but once timelines cross process boundaries a dump
+        #: needs the wall mapping (wall ≈ epoch_wall + (t0 - _epoch))
+        self.epoch_wall = time.time()
         self._jax_profiler = None         # lazy; import failure logged once
 
     # -- recording -------------------------------------------------------

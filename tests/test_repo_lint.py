@@ -162,6 +162,55 @@ def test_metric_lint_pins_the_tenant_cardinality_cap():
     assert metric_lint.check_cardinality_cap("/nonexistent") != []
 
 
+# --- metric-family documentation (docs/METRICS.md) ---------------------------
+
+def test_every_emitted_metric_family_is_documented():
+    """Drift guard for the auto-generated docs/METRICS.md reference:
+    every literal serving_*/telemetry_* family emitted anywhere must be
+    documented, and every documented family must still be emitted (run
+    ``python bin/check_metric_names.py --write-doc`` after adding or
+    removing one)."""
+    violations = metric_lint.check_metrics_doc(ROOT)
+    assert violations == [], "\n".join(violations)
+
+
+def test_metric_family_collector_sees_emits_and_forwarders(tmp_path):
+    """The collector must catch registry emits AND reqtrace's
+    forwarders (_tenant_inc/_observe_slo carry the family name at a
+    non-zero arg index), and the doc check must flag drift both ways."""
+    pkg = tmp_path / "deepspeed_tpu"
+    pkg.mkdir()
+    (pkg / "m.py").write_text(
+        "def f(reg, self, uid):\n"
+        "    reg.counter('serving_x_total', help='xs counted')\n"
+        "    reg.gauge('telemetry_y', help='ys')\n"
+        "    self._tenant_inc('serving_tenant_z_total', 't', 1, 'zs')\n"
+        "    self._observe_slo(uid, 'serving_tenant_w_s', 0.1, 1,\n"
+        "                      'ws', 'w', None)\n"
+        "    reg.counter('Train/ignored')\n")
+    fams = metric_lint.collect_metric_families(str(tmp_path))
+    assert set(fams) == {"serving_x_total", "telemetry_y",
+                         "serving_tenant_z_total", "serving_tenant_w_s"}
+    assert fams["serving_x_total"]["help"] == "xs counted"
+    assert fams["serving_tenant_w_s"]["type"] == "histogram"
+    # no doc at all -> one violation
+    out = metric_lint.check_metrics_doc(str(tmp_path))
+    assert len(out) == 1 and "missing" in out[0]
+    # a doc covering only some families flags the missing AND the stale
+    doc = tmp_path / "docs"
+    doc.mkdir()
+    (doc / "METRICS.md").write_text(
+        "| `serving_x_total` |\n| `serving_gone_total` |\n")
+    out = metric_lint.check_metrics_doc(str(tmp_path))
+    assert any("telemetry_y" in v and "not documented" in v for v in out)
+    assert any("serving_gone_total" in v and "no longer emitted" in v
+               for v in out)
+    # the generated doc round-trips clean
+    (doc / "METRICS.md").write_text(
+        metric_lint.render_metrics_doc(str(tmp_path)))
+    assert metric_lint.check_metrics_doc(str(tmp_path)) == []
+
+
 # --- reqtrace lifecycle coverage --------------------------------------------
 
 def test_repo_reqtrace_lifecycle_events_all_emitted():
