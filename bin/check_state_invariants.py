@@ -29,7 +29,16 @@ in ``deepspeed_tpu/`` outside the allowlisted ``StateManager`` methods:
   export/import/abort API (``migrate_out`` / ``export_ack`` /
   ``export_abort`` / ``migrate_in_begin`` / ``import_commit`` /
   ``abort_import``) — a stray mutation would let a pinned export's
-  pages be scheduled or released mid-transfer.
+  pages be scheduled or released mid-transfer;
+- assignments to a ``.weight_version`` / ``._weight_version`` attribute
+  (the serving weight hot-swap's version stamp, serving/deploy.py):
+  legal ONLY inside the swap API (``engine_v2.swap_weights``, the
+  replica backends' ``swap_weights``, ``PrefixCache.set_weight_version``
+  and the respective ``__init__``\\ s) — the version gates cross-replica
+  KV transfer, so a stray mutation would let skewed pages migrate as
+  "same version" (exactly the silent corruption the guard exists to
+  stop). The router-side heartbeat MIRROR deliberately uses a different
+  attribute name (``ReplicaHandle.wv``) so it stays writable.
 
 Reads (``allocator.free_blocks``, ``prefix_cache.stats()``, iterating
 ``seq.blocks``) are fine anywhere.
@@ -50,14 +59,18 @@ STATE_FILE = "deepspeed_tpu/inference/ragged.py"
 #: (rule, function name) pairs allowed inside STATE_FILE
 ALLOWED = {
     "allocator": {"_alloc", "release", "migrate_in_begin",
-                  "import_commit", "abort_import", "adopt_prefix"},
+                  "import_commit", "abort_import", "adopt_prefix",
+                  "flush_prefix_cache"},
     #: snapshot_prefix/release_prefix/adopt_prefix are the cross-replica
     #: radix-pull surface (placement-time distributed cache): the export
     #: leg's gather-scoped pin and the import leg's unreferenced adopt
     #: both mutate trie ownership and so must live behind the same
-    #: refcounted API as admit/release
+    #: refcounted API as admit/release; flush_prefix_cache is the weight
+    #: hot-swap's skew guard (evict-everything-unreferenced at swap
+    #: commit — stale pages must not seed post-swap prefills)
     "prefix_cache": {"admit", "release", "_alloc", "import_commit",
-                     "snapshot_prefix", "release_prefix", "adopt_prefix"},
+                     "snapshot_prefix", "release_prefix", "adopt_prefix",
+                     "flush_prefix_cache"},
     "blocks": {"admit", "migrate_in_begin", "import_commit",
                "abort_import"},
     "n_provisional": {"provision", "commit_speculative",
@@ -68,6 +81,17 @@ ALLOWED = {
     #: refcounted export/import/abort API exists to prevent.
     "migrating": {"migrate_out", "export_ack", "export_abort",
                   "migrate_in_begin", "import_commit", "abort_import"},
+}
+
+#: weight-version mutation sites: (file basename, function) pairs — the
+#: swap API plus the constructors that establish the initial version.
+#: Unlike the StateManager rules these span three files, so the rule
+#: carries its own location set instead of riding STATE_FILE.
+WEIGHT_VERSION_ALLOWED = {
+    ("engine_v2.py", "__init__"), ("engine_v2.py", "swap_weights"),
+    ("replica.py", "__init__"), ("replica.py", "swap_weights"),
+    ("prefix_cache.py", "__init__"),
+    ("prefix_cache.py", "set_weight_version"),
 }
 
 #: mutating list-method names (on a ``.blocks`` attribute)
@@ -93,6 +117,7 @@ def _chain(node: ast.expr) -> list[str]:
 class _Visitor(ast.NodeVisitor):
     def __init__(self, path: str, in_state_file: bool):
         self.path = path
+        self.fname = os.path.basename(path)
         self.in_state_file = in_state_file
         self.violations: list[str] = []
         self._func_stack: list[str] = []
@@ -140,6 +165,18 @@ class _Visitor(ast.NodeVisitor):
                                f"block-list mutation .blocks.{meth}()")
         self.generic_visit(node)
 
+    def _flag_weight_version(self, node: ast.AST) -> None:
+        if any((self.fname, f) in WEIGHT_VERSION_ALLOWED
+               for f in self._func_stack):
+            return
+        ok = ", ".join(sorted(f"{f}:{fn}"
+                              for f, fn in WEIGHT_VERSION_ALLOWED))
+        self.violations.append(
+            f"{self.path}:{node.lineno}: assignment to a "
+            f".weight_version attribute outside the swap API (allowed "
+            f"only in {ok}) — the version gates cross-replica KV "
+            f"transfer; route through swap_weights/set_weight_version")
+
     def _check_targets(self, node, targets) -> None:
         for t in targets:
             if isinstance(t, ast.Attribute) and t.attr == "blocks":
@@ -151,6 +188,9 @@ class _Visitor(ast.NodeVisitor):
             elif isinstance(t, ast.Attribute) and t.attr == "migrating":
                 self._flag(node, "migrating",
                            "assignment to a .migrating attribute")
+            elif isinstance(t, ast.Attribute) \
+                    and t.attr.lstrip("_") == "weight_version":
+                self._flag_weight_version(node)
             elif isinstance(t, (ast.Tuple, ast.List)):
                 self._check_targets(node, t.elts)
 
@@ -160,6 +200,14 @@ class _Visitor(ast.NodeVisitor):
 
     def visit_AugAssign(self, node: ast.AugAssign):
         self._check_targets(node, [node.target])
+        self.generic_visit(node)
+
+    def visit_AnnAssign(self, node: ast.AnnAssign):
+        # annotated attribute assignment (`self._weight_version: dict =
+        # ...`) — only the weight-version rule inspects these; the
+        # StateManager rules predate annotated writes and stay as-is
+        if node.value is not None:
+            self._check_targets(node, [node.target])
         self.generic_visit(node)
 
 

@@ -11,7 +11,18 @@ no role — resharding happens at load. What remains useful offline:
 - ``ds_to_universal``: explode a checkpoint into per-parameter "atom"
   files (.npy + index) — reference checkpoint/ds_to_universal.py:469;
 - ``UniversalCheckpoint``: read atoms back as a param tree.
+- ``manifest``: the jax-free integrity core (size+crc32 manifests,
+  verified-tag resolution, the ``weight_version`` content digest) shared
+  by the orbax train path and the serving tier's weight hot-swap.
 """
+from .manifest import (  # noqa: F401
+    file_crc32,
+    manifest_digest,
+    resolve_tag,
+    tag_status,
+    write_file_atomic,
+    write_manifest,
+)
 from .universal import (  # noqa: F401
     UniversalCheckpoint,
     ds_to_universal,

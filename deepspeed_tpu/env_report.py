@@ -144,6 +144,20 @@ def feature_report() -> list[tuple[str, bool, str]]:
         feats.append(("serving: distributed prefix cache", False,
                       str(e)))
 
+    # zero-downtime weight deploys (serving/deploy.py): rolling hot-swap
+    # behind the router — pure host logic, availability is an import check
+    try:
+        from .serving import deploy as _deploy  # noqa: F401
+        feats.append((
+            "serving: zero-downtime weight deploys", True,
+            "Router.deploy(ckpt) — verified-manifest rolling swap "
+            "(canary + probe + health-gated soak, auto-rollback, "
+            "version-skew-safe KV); engine_v2.swap_weights/save_weights; "
+            "BENCH_MODE=deploy"))
+    except Exception as e:  # pragma: no cover — import breakage only
+        feats.append(("serving: zero-downtime weight deploys", False,
+                      str(e)))
+
     # telemetry / monitor backends (telemetry/ + monitor/): which push
     # backends can actually activate, and where the pull endpoint +
     # flight recorder would land for this process

@@ -2,6 +2,7 @@ from .engine import InferenceConfig, InferenceEngine, init_inference  # noqa: F4
 from .engine_v2 import (  # noqa: F401
     InferenceEngineV2,
     RaggedInferenceConfig,
+    WeightSwapError,
     build_engine,
 )
 from .migration import (  # noqa: F401
@@ -9,6 +10,7 @@ from .migration import (  # noqa: F401
     MigrationError,
     PageBundle,
     iter_chunks,
+    version_skew,
 )
 from .prefix_cache import PageNode, PrefixCache  # noqa: F401
 from .ragged import BlockedAllocator, SequenceDescriptor, StateManager  # noqa: F401

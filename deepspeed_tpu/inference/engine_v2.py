@@ -31,6 +31,7 @@ Architecture (TPU-first, round-4 async design):
 from __future__ import annotations
 
 import dataclasses
+import os
 import time
 from dataclasses import dataclass
 from functools import partial
@@ -74,6 +75,20 @@ KIND_SPEC_2D = {"row": P("tensor", None), "col": P(None, "tensor"),
 KIND_SPEC_3D = {"row": P(None, "tensor", None),
                 "col": P(None, None, "tensor"),
                 "rep": P(None, None, None)}
+
+
+class WeightSwapError(RuntimeError):
+    """A live weight swap was refused or failed verification. ``reason``
+    is machine-readable (``integrity`` | ``shape_mismatch`` |
+    ``probe_failed`` | ``no_checkpoint``) — the serving replica ships it
+    verbatim in its ``swap_fail`` reply and the deploy orchestrator keys
+    rollback decisions on it. Raising here NEVER leaves the engine on
+    partial weights: the old params keep serving."""
+
+    def __init__(self, reason: str, detail: str = ""):
+        super().__init__(f"weight swap refused: {reason}"
+                         + (f" ({detail})" if detail else ""))
+        self.reason = reason
 
 
 @dataclass
@@ -354,6 +369,14 @@ class InferenceEngineV2:
         # every release (debug mode — O(pool) per flush)
         import os as _os
         self._audit_state = _os.environ.get("DS_TPU_STATE_AUDIT") == "1"
+
+        # --- versioned weights (live hot-swap, serving/deploy.py) --------
+        # monotonic id + content digest of the params this engine serves;
+        # "init" = the constructor's (model, params|rng) weights, before
+        # any swap. Rides every exported PageBundle and the serving
+        # heartbeat so cross-replica KV transfer can refuse version skew.
+        # Mutation is pinned to swap_weights (check_state_invariants.py).
+        self._weight_version: dict = {"id": 0, "digest": "init"}
 
         # --- weights: same tree as the trainer, TP-sharded ---------------
         self.params, plan = load_tp_params(model, params, rng, topology,
@@ -2584,6 +2607,7 @@ class InferenceEngineV2:
             tail_bytes=len(tail or b""),
             # the engine's fp8-KV pool is scale-free e4m3 (no side-car
             # scale arrays); pools that carry them ship them here
+            weight_version=dict(self._weight_version),
             chain=chain_hashes(snap["tokens"][:n_full * bs], bs),
             scales=None,
             pages=page_blobs, tail=tail)
@@ -2670,7 +2694,16 @@ class InferenceEngineV2:
         decode-ready. The resume step is a plain decode of the last
         token: nothing is recomputed, so a greedy stream continues
         bit-identically."""
+        from .migration import MigrationError, version_skew
+
         bundle.validate()
+        if version_skew(bundle.weight_version, self._weight_version):
+            # KV computed under other weights must never resume against
+            # this pool — the importer aborts and the router falls back
+            # (resume-on-source / replay), never a silent mixed forward
+            raise MigrationError(
+                f"version_skew: bundle weights "
+                f"{bundle.weight_version} vs pool {self._weight_version}")
         seq = self.state.seqs[uid]
         bs = self.config.block_size
         m = self.mcfg
@@ -2745,7 +2778,8 @@ class InferenceEngineV2:
                       * np.dtype(self._kv_dtype).itemsize)
         bundle = PageBundle.prefix(
             trace_id, [int(t) for t in tokens[:snap["n_tokens"]]], bs,
-            np.dtype(self._kv_dtype).name, page_bytes, blobs)
+            np.dtype(self._kv_dtype).name, page_bytes, blobs,
+            weight_version=dict(self._weight_version))
         bundle.validate()
         self.stats["kv_pull_bytes_out"] = self.stats.get(
             "kv_pull_bytes_out", 0) + bundle.payload_bytes
@@ -2758,11 +2792,16 @@ class InferenceEngineV2:
         cached copy — their device content is already correct). Returns
         the pages now cache-resident; raises (and adopts nothing) on a
         geometry/dtype mismatch or a pool too full to hold the chain."""
-        from .migration import MigrationError
+        from .migration import MigrationError, version_skew
 
         bundle.validate()
         if bundle.kind != "prefix":
             raise MigrationError(f"not a prefix bundle ({bundle.kind})")
+        if version_skew(bundle.weight_version, self._weight_version):
+            raise MigrationError(
+                f"version_skew: chain computed under "
+                f"{bundle.weight_version}, pool serves "
+                f"{self._weight_version}")
         if self._prefix_cache is None or self._ring_tokens:
             raise MigrationError("no shareable prefix cache on this pool")
         if bundle.block_size != self.config.block_size:
@@ -2794,6 +2833,172 @@ class InferenceEngineV2:
         self.stats["kv_pull_bytes_in"] = self.stats.get(
             "kv_pull_bytes_in", 0) + bundle.payload_bytes
         return bundle.n_full
+
+    # ------------------------------------------------------------------
+    # Versioned weight hot-swap (the hybrid-engine republish primitive,
+    # DeepSpeed-Chat's in-place weight update for colocated train+serve,
+    # reference deepspeed/runtime/hybrid_engine.py — here doubling as the
+    # serving tier's zero-downtime rolling deploy, serving/deploy.py).
+    # Contract: quiesce at a window boundary (drain the async pipeline;
+    # live sequences PAUSE, their KV stays valid — same-shape update),
+    # load through the PR-3 verified-manifest path, verify the new tree,
+    # and only then commit. ANY failure leaves the old weights serving.
+    # ------------------------------------------------------------------
+    def weight_version(self) -> dict:
+        """The serving weight version: ``{"id": monotonic int, "digest":
+        manifest digest}`` ("init" digest = constructor weights)."""
+        return dict(self._weight_version)
+
+    def save_weights(self, save_dir: str, tag: str | None = None,
+                     wid: int | None = None) -> str:
+        """Publish this engine's live params as a verified swap
+        checkpoint: ``<save_dir>/<tag>/state`` (orbax, the engine's own
+        param tree — quantized/stacked form included, so a swap restore
+        needs no re-transform), ``meta.json`` (geometry guard),
+        ``manifest.json`` (size+crc32 commit proof), then the atomic
+        ``latest`` advance — the exact PR-3 ordering, so a crash mid-save
+        can never publish a torn deploy target."""
+        from ..checkpoint.manifest import (manifest_digest,
+                                           write_file_atomic,
+                                           write_manifest)
+
+        wid = int(wid if wid is not None
+                  else self._weight_version["id"] + 1)
+        tag = tag or f"weights_v{wid}"
+        root = os.path.abspath(save_dir)
+        path = os.path.join(root, tag)
+        os.makedirs(path, exist_ok=True)
+        import orbax.checkpoint as ocp
+
+        ocp.PyTreeCheckpointer().save(os.path.join(path, "state"),
+                                      {"params": self.params}, force=True)
+        m = self.mcfg
+        meta = {"tag": tag, "global_steps": wid,
+                "format": "engine_weights",
+                "model_dims": {"num_layers": m.num_layers,
+                               "hidden": m.hidden_size,
+                               "heads": m.num_heads,
+                               "vocab": m.vocab_size},
+                "quant_bits": self.config.quant_bits,
+                "dtype": str(self.config.dtype)}
+        with open(os.path.join(path, "meta.json"), "w") as f:
+            import json as _json
+            _json.dump(meta, f, indent=2, default=str)
+        write_manifest(path, tag, wid)
+        write_file_atomic(os.path.join(root, "latest"), tag)
+        logger.info(f"engine_v2: published weights {path} "
+                    f"(digest {manifest_digest(path)})")
+        return path
+
+    def swap_weights(self, ckpt_dir: str, tag: str | None = None,
+                     wid: int | None = None) -> dict:
+        """In-place live weight swap from a verified checkpoint.
+
+        Sequence: (1) **quiesce** — drain every in-flight dispatch to a
+        window boundary (live sequences pause; their KV stays valid for
+        a same-shape update, nothing is flushed or replayed); (2)
+        **verify** — resolve the tag and check its size+crc32 manifest
+        (:mod:`~..checkpoint.manifest`): a torn or tampered checkpoint is
+        a structured ``integrity`` refusal before a single byte loads;
+        (3) **load** — restore the ``params`` entry INTO the current
+        tree's structure and shardings (same-shape contract: a tree,
+        shape, or dtype mismatch — including a checkpoint saved for a
+        different quantization/stacking config — refuses
+        ``shape_mismatch``; the restore target carries this engine's
+        shardings, so a checkpoint written on a different mesh resharded
+        here is fine, the universal-checkpoint property); (4) **probe**
+        — a finiteness sweep over the restored float leaves gates the
+        commit (``probe_failed``; the serving deploy adds an end-to-end
+        probe REQUEST through the full forward on top); (5) **commit** —
+        repoint ``self.params``, release the old buffers, stamp the new
+        ``weight_version``. The old params object is retained until the
+        probe passes: any raise leaves it serving untouched."""
+        from ..checkpoint.manifest import (manifest_digest, resolve_tag,
+                                           tag_status)
+
+        t0 = time.perf_counter()
+        # (1) quiesce: every in-flight device step commits; the pipeline
+        # is empty at return, so nothing concurrently reads self.params
+        self._drain(drain_all=True)
+        quiesce_s = time.perf_counter() - t0
+        # (2) verify the tag through the PR-3 manifest contract: an
+        # explicit tag never silently falls back (missing is structured
+        # no_checkpoint, torn/tampered is the crc gate's integrity
+        # refusal); no tag resolves 'latest' then newest-verified
+        if tag is not None:
+            status, reason = tag_status(os.path.join(ckpt_dir, tag))
+            if status == "missing":
+                raise WeightSwapError("no_checkpoint",
+                                      f"tag '{tag}' missing")
+            if status != "verified":
+                raise WeightSwapError(
+                    "integrity", f"tag '{tag}' {status}: {reason}")
+        else:
+            tag, why = resolve_tag(ckpt_dir, None)
+            if not tag:
+                raise WeightSwapError("no_checkpoint", why)
+        path = os.path.join(ckpt_dir, tag)
+        try:
+            digest = manifest_digest(path)
+        except OSError as e:
+            raise WeightSwapError("integrity", f"manifest unreadable: {e}")
+        wid = int(wid if wid is not None
+                  else self._weight_version["id"] + 1)
+        t1 = time.perf_counter()
+        # (3) same-shape restore into the live tree's structure/shardings
+        import orbax.checkpoint as ocp
+
+        target = {"params": self.params}
+        restore_args = jax.tree.map(
+            lambda x: ocp.ArrayRestoreArgs(
+                sharding=x.sharding, global_shape=x.shape, dtype=x.dtype),
+            target)
+        try:
+            restored = ocp.PyTreeCheckpointer().restore(
+                os.path.join(path, "state"), item=target,
+                restore_args=restore_args)
+        except Exception as e:  # orbax raises various concrete types
+            raise WeightSwapError("shape_mismatch", str(e))
+        new_params = restored["params"]
+        # (4) probe: a non-finite leaf would poison every stream served
+        # after the swap — refuse and keep the old weights. The sweep
+        # accumulates per-leaf flags ON DEVICE and syncs exactly once:
+        # this runs inside the quiesce window every paused request pays,
+        # so per-leaf host round-trips would inflate the quiesce stall
+        # by hundreds of d2h latencies on a real model
+        flags = [jnp.all(jnp.isfinite(leaf))
+                 for leaf in jax.tree.leaves(new_params)
+                 if hasattr(leaf, "dtype")
+                 and jnp.issubdtype(leaf.dtype, jnp.floating)]
+        if flags and not bool(jnp.all(jnp.stack(flags))):
+            raise WeightSwapError(
+                "probe_failed", "restored weights hold non-finite values")
+        # (5) commit: in-flight sequences resume against the new weights
+        # at the next dispatch, keeping their own KV (same-shape ⇒ valid
+        # — the hybrid-engine small-update contract). The SHARED prefix
+        # cache flushes, though: a NEW request must never prefill from
+        # pages the old weights computed (and StateManager.release skips
+        # publishing pages from sequences that lived across the swap, by
+        # admit-time version — so the post-swap trie only ever holds
+        # post-swap KV).
+        self.params = new_params
+        self._weight_version = {"id": wid, "digest": digest}
+        flushed = self.state.flush_prefix_cache()
+        if self._prefix_cache is not None:
+            self._prefix_cache.set_weight_version(wid)
+        swap_s = time.perf_counter() - t1
+        if self._rt.enabled:
+            self._rt.event(-1, "weight_swap", wid=wid, flushed=flushed,
+                           quiesce_s=round(quiesce_s, 6),
+                           swap_s=round(swap_s, 6))
+        self._telem.note("weight_swap", wid=wid, digest=digest,
+                         quiesce_s=round(quiesce_s, 4),
+                         swap_s=round(swap_s, 4))
+        logger.info(f"engine_v2: weight swap to v{wid} (digest {digest}) "
+                    f"quiesce {quiesce_s * 1e3:.1f}ms "
+                    f"swap {swap_s * 1e3:.1f}ms")
+        return {"wv": self.weight_version(),
+                "quiesce_s": quiesce_s, "swap_s": swap_s}
 
     def _record_dispatch_telemetry(self, kind: str, useful: int,
                                    budget: int, uids) -> None:

@@ -79,6 +79,13 @@ class PageBundle:
     #: seeds its trie and the arriving request prefills from it — no
     #: sequence exists, so every token is computed and page-aligned)
     kind: str = "seq"
+    #: the weight version the pages were computed under —
+    #: ``{"id": monotonic int, "digest": manifest digest}`` — stamped at
+    #: export and checked at import: KV computed under one set of weights
+    #: must never seed a pool serving another (the rolling-deploy
+    #: version-skew guard; ``None`` = pre-versioning bundle, matches only
+    #: a peer that also reports no version)
+    weight_version: dict | None = None
     chain: list[int] = field(default_factory=list)
     #: per-page quant-scale sidecar. The engine's fp8-KV pool is
     #: scale-free (e4m3 covers K/V activations), so this is None there;
@@ -142,7 +149,7 @@ class PageBundle:
                 "bs": self.block_size, "dtype": self.kv_dtype,
                 "page_bytes": self.page_bytes,
                 "tail_rows": self.tail_rows, "tail_bytes": self.tail_bytes,
-                "kind": self.kind,
+                "kind": self.kind, "wv": self.weight_version,
                 "chain": list(self.chain), "scales": self.scales}
 
     @classmethod
@@ -163,13 +170,14 @@ class PageBundle:
                    tail_rows=int(meta["tail_rows"]),
                    tail_bytes=int(meta["tail_bytes"]),
                    kind=str(meta.get("kind", "seq")),
+                   weight_version=meta.get("wv"),
                    chain=[int(h) for h in meta["chain"]],
                    scales=meta.get("scales"))
 
     @classmethod
     def prefix(cls, trace_id: str, tokens: list[int], block_size: int,
-               kv_dtype: str, page_bytes: int,
-               pages: list[bytes]) -> "PageBundle":
+               kv_dtype: str, page_bytes: int, pages: list[bytes],
+               weight_version: dict | None = None) -> "PageBundle":
         """A bare cached-chain bundle (placement-time radix pull):
         ``tokens`` must be exactly ``len(pages)`` full pages of prompt
         prefix; the importer adopts the pages into its trie unreferenced
@@ -185,8 +193,18 @@ class PageBundle:
                    n_generated=0, max_new_tokens=0, eos_id=None,
                    tenant="", block_size=block_size, kv_dtype=kv_dtype,
                    page_bytes=page_bytes, tail_rows=0, tail_bytes=0,
-                   kind="prefix", chain=chain, scales=None,
+                   kind="prefix", weight_version=weight_version,
+                   chain=chain, scales=None,
                    pages=list(pages), tail=None)
+
+
+def version_skew(a: dict | None, b: dict | None) -> bool:
+    """True when two weight-version stamps name DIFFERENT weights. A
+    ``None`` stamp (pre-versioning bundle or peer) is treated as
+    compatible-with-anything: the skew guard exists to stop a transfer
+    between replicas KNOWN to run different weights, and refusing legacy
+    traffic would turn an upgrade into an outage."""
+    return a is not None and b is not None and a != b
 
 
 def iter_chunks(bundle: PageBundle, max_bytes: int = CHUNK_BYTES,
@@ -301,7 +319,8 @@ def toy_tail_payload(prefix_hash: int, tail_tokens) -> bytes:
 
 def toy_bundle(trace_id: str, prompt: list[int], generated: list[int],
                max_new_tokens: int, eos_id: int | None, tenant: str,
-               block_size: int) -> PageBundle:
+               block_size: int,
+               weight_version: dict | None = None) -> PageBundle:
     """Build the toy backend's synthetic-but-verifiable bundle: payloads
     are pure functions of the chain, so the importer re-derives and
     compares them (transfer-integrity oracle)."""
@@ -320,12 +339,13 @@ def toy_bundle(trace_id: str, prompt: list[int], generated: list[int],
         block_size=block_size, kv_dtype="toy",
         page_bytes=TOY_PAGE_BYTES, tail_rows=tail_rows,
         tail_bytes=len(tail or b""),
-        chain=chain, scales=None,
+        weight_version=weight_version, chain=chain, scales=None,
         pages=[toy_page_payload(h) for h in chain], tail=tail)
 
 
-def toy_prefix_bundle(trace_id: str, tokens: list[int],
-                      block_size: int) -> PageBundle | None:
+def toy_prefix_bundle(trace_id: str, tokens: list[int], block_size: int,
+                      weight_version: dict | None = None
+                      ) -> PageBundle | None:
     """Prefix-pull export for the toy backend: bundle the full pages of
     ``tokens`` (already truncated to the cached extent by the caller)
     with chain-derived payloads the importer verifies."""
@@ -336,7 +356,8 @@ def toy_prefix_bundle(trace_id: str, tokens: list[int],
     chain = chain_hashes(aligned, block_size)
     return PageBundle.prefix(trace_id, aligned, block_size, "toy",
                              TOY_PAGE_BYTES,
-                             [toy_page_payload(h) for h in chain])
+                             [toy_page_payload(h) for h in chain],
+                             weight_version=weight_version)
 
 
 def toy_verify(bundle: PageBundle) -> None:

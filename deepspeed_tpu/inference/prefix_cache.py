@@ -79,6 +79,13 @@ class PageNode:
     #: immutable for the node's lifetime, computed once at insert so the
     #: heartbeat-cadence residency digest never re-hashes the trie
     chain_hash: int = 0
+    #: the cache-level :attr:`PrefixCache.weight_version` at insert (the
+    #: weight hot-swap skew guard): a node whose stamp trails the
+    #: cache's current version holds KV computed under OLD weights —
+    #: ``match``/``residency_digest`` skip it, so a post-swap request
+    #: can never prefill from it even while a pre-swap sequence still
+    #: pins it (eviction could not reclaim a referenced page)
+    wv: int = 0
     children: dict[tuple[int, ...], "PageNode"] = field(default_factory=dict)
 
     @property
@@ -101,6 +108,14 @@ class PrefixCache:
         #: bumped on every digest-affecting mutation (insert/evict) — a
         #: replica heartbeat re-ships its digest only when this moved
         self.version = 0
+        #: monotonic weight-version id of the params every cached page was
+        #: computed under. Rides next to the residency digest in replica
+        #: heartbeats so the router's cross-replica radix pulls can refuse
+        #: a chain computed under different weights (version skew = silent
+        #: KV corruption). Mutation is pinned to :meth:`set_weight_version`
+        #: (bin/check_state_invariants.py) — the serving swap API is the
+        #: only legal writer.
+        self.weight_version = 0
         # lifetime stats (the engine folds these into its stats dict)
         self.hit_tokens = 0
         self.lookup_tokens = 0
@@ -164,6 +179,22 @@ class PrefixCache:
         """Every block id the trie currently owns (pool audit)."""
         return {n.block for n in self._nodes()}
 
+    def set_weight_version(self, wid: int) -> None:
+        """Record a completed same-shape weight swap. Every node
+        inserted before this instant becomes STALE (its ``wv`` stamp
+        trails): invisible to ``match`` and the residency digest, so a
+        post-swap request can never prefill from old-weight KV — even
+        pages still pinned by in-flight pre-swap sequences (which keep
+        their own KV, the hybrid-engine contract, and simply unpin at
+        release). Callers (StateManager.flush_prefix_cache / the toy's
+        _flush_radix) evict the unpinned ones eagerly to reclaim
+        blocks; pinned stale nodes age out through the ordinary LRU
+        once released. The state-invariant lint pins every
+        ``weight_version`` assignment to this method and ``__init__``."""
+        if wid != self.weight_version:
+            self.weight_version = int(wid)
+            self.version += 1          # force a digest re-ship
+
     def residency_digest(self, max_entries: int = 4096) -> list[int]:
         """Chain hashes (:func:`chain_hashes` scheme) of every cached page,
         capped at ``max_entries`` most-recently-used — the compact
@@ -175,8 +206,11 @@ class PrefixCache:
         and only when something changed. A listed hash commits to its
         whole path (which exists while the node does), so "longest j with
         ``chain[j]`` in the digest" is exactly the cached-chain length
-        even under the MRU cap."""
-        out = [(n.last_used, n.chain_hash) for n in self._nodes()]
+        even under the MRU cap. Stale-version pages (pinned across a
+        weight swap — ``match`` refuses them) are excluded: the digest
+        must never advertise a chain this replica would not serve."""
+        out = [(n.last_used, n.chain_hash) for n in self._nodes()
+               if n.wv == self.weight_version]
         if len(out) > max_entries:
             out.sort(reverse=True)               # keep the most recent
             out = out[:max_entries]
@@ -194,7 +228,10 @@ class PrefixCache:
         node, out = self.root, []
         for j in range(limit // bs):
             child = node.children.get(tuple(tokens[j * bs:(j + 1) * bs]))
-            if child is None:
+            if child is None or child.wv != self.weight_version:
+                # absent, or a stale-version page a live pre-swap
+                # sequence still pins (weight hot-swap): serving it to a
+                # new request would mix KV across weight versions
                 break
             out.append(child)
             node = child
@@ -220,6 +257,39 @@ class PrefixCache:
                     f"prefix cache refcount underflow on block {n.block}")
             n.refs -= 1
             n.last_used = self._clock
+
+    # -- stale-version subtrees (weight hot-swap skew guard) --------------
+    # A node whose ``wv`` stamp trails the cache's current version holds
+    # old-weight KV. Nothing fresh is ever inserted UNDER a stale node
+    # (the write paths below replace-or-stop instead of walking in), so
+    # a stale node's whole subtree is stale — removable as a unit once
+    # no sequence pins any page in it.
+
+    def _subtree_pinned(self, node: PageNode) -> bool:
+        stack = [node]
+        while stack:
+            n = stack.pop()
+            if n.refs > 0:
+                return True
+            stack.extend(n.children.values())
+        return False
+
+    def _remove_subtree(self, parent: PageNode,
+                        child: PageNode) -> list[int]:
+        """Detach ``child`` (and everything under it) from the trie,
+        returning the freed block ids. Caller guarantees the subtree is
+        unpinned (:meth:`_subtree_pinned`)."""
+        del parent.children[child.key]
+        out: list[int] = []
+        stack = [child]
+        while stack:
+            n = stack.pop()
+            out.append(n.block)
+            self._n_nodes -= 1
+            self.evicted_pages += 1
+            stack.extend(n.children.values())
+        self.version += 1
+        return out
 
     # -- the write path ---------------------------------------------------
     def publish(self, tokens, blocks: list[int], n_shared: int,
@@ -265,12 +335,31 @@ class PrefixCache:
                     raise RuntimeError(
                         f"prefix cache refcount underflow on block "
                         f"{child.block}")
+            elif child is not None \
+                    and child.wv != self.weight_version:
+                # the cached copy is a STALE-version subtree (weight
+                # hot-swap): replace it when nothing below it is pinned;
+                # otherwise stop caching here and free the rest — a
+                # conservative miss, never a cross-version serve
+                if self._subtree_pinned(child):
+                    to_free.extend(blocks[j:])
+                    return to_free
+                to_free.extend(self._remove_subtree(node, child))
+                child = PageNode(key=key, block=blocks[j],
+                                 parent=node, wv=self.weight_version,
+                                 chain_hash=page_hash(node.chain_hash,
+                                                      key))
+                node.children[key] = child
+                self._n_nodes += 1
+                self.inserted_pages += 1
+                self.version += 1
             elif child is not None:
                 # dedup: same chain already cached — surrender our copy
                 to_free.append(blocks[j])
                 self.deduped_pages += 1
             else:
                 child = PageNode(key=key, block=blocks[j], parent=node,
+                                 wv=self.weight_version,
                                  chain_hash=page_hash(node.chain_hash,
                                                       key))
                 node.children[key] = child
@@ -302,6 +391,24 @@ class PrefixCache:
         if n_full > len(blocks):
             raise ValueError(f"{n_full} imported pages but only "
                              f"{len(blocks)} blocks")
+        # pre-scan for pinned stale-version pages (weight hot-swap):
+        # refusing BEFORE any mutation keeps the raise leak-free — a
+        # mid-chain abort would strand acquired pins. The importer's
+        # established fallback is recompute/replay, never a
+        # cross-version serve.
+        scan = self.root
+        for j in range(n_full):
+            child = scan.children.get(
+                tuple(tokens[j * bs:(j + 1) * bs]))
+            if child is None:
+                break
+            if child.wv != self.weight_version \
+                    and self._subtree_pinned(child):
+                raise RuntimeError(
+                    f"prefix cache holds a pinned stale-version page "
+                    f"at depth {j} (weight swap in flight); adopt "
+                    f"refused")
+            scan = child
         self._clock += 1
         node = self.root
         out: list[PageNode] = []
@@ -309,11 +416,16 @@ class PrefixCache:
         for j in range(n_full):
             key = tuple(tokens[j * bs:(j + 1) * bs])
             child = node.children.get(key)
+            if child is not None and child.wv != self.weight_version:
+                # unpinned stale-version subtree: replace it in place
+                to_free.extend(self._remove_subtree(node, child))
+                child = None
             if child is not None:
                 to_free.append(blocks[j])
                 self.deduped_pages += 1
             else:
                 child = PageNode(key=key, block=blocks[j], parent=node,
+                                 wv=self.weight_version,
                                  chain_hash=page_hash(node.chain_hash,
                                                       key))
                 node.children[key] = child

@@ -82,6 +82,11 @@ class SequenceDescriptor:
     n_inflight: int = 0               # sampled tokens not yet read back
     n_shared_blocks: int = 0          # leading trie-owned (read-only) pages
     prefix_hit_tokens: int = 0        # prompt tokens served from the trie
+    #: prefix-cache weight version at admit (weight hot-swap skew guard):
+    #: a sequence that lived across a swap computed its KV (at least
+    #: partly) under the OLD weights — release frees its pages instead of
+    #: publishing them into the post-swap trie
+    admit_wv: int = 0
     #: speculative decoding (speculative.py): candidate tokens whose KV may
     #: land in this sequence's OWNED tail pages ahead of acceptance. Only
     #: the rollback-aware StateManager methods (``provision`` /
@@ -228,6 +233,21 @@ class StateManager:
             raise RuntimeError("attach_prefix_cache before admitting")
         self.prefix_cache = cache
 
+    def flush_prefix_cache(self) -> int:
+        """Evict EVERY unreferenced cached page back to the free list
+        (the weight hot-swap's skew guard, engine_v2.swap_weights): a
+        page computed under the old weights must not seed a NEW
+        request's prefill after the swap. Pages pinned by live
+        sequences stay — an in-flight sequence keeps its own KV across
+        a same-shape update (the hybrid-engine contract) — and fall to
+        the ordinary LRU once released. Returns pages reclaimed."""
+        if self.prefix_cache is None:
+            return 0
+        reclaimed = self.prefix_cache.evict(len(self.prefix_cache))
+        if reclaimed:
+            self.allocator.free(reclaimed)
+        return len(reclaimed)
+
     def _blocks_for(self, n_tokens: int) -> int:
         # a sequence can never OWN more slots than the table has — the
         # rolling buffer reuses them past that point
@@ -322,6 +342,8 @@ class StateManager:
             seq.n_computed = len(shared_nodes) * bs
             seq.prefix_hit_tokens = seq.n_computed
         seq.blocks = [n.block for n in shared_nodes] + fresh
+        if self.prefix_cache is not None:
+            seq.admit_wv = self.prefix_cache.weight_version
         self.seqs[uid] = seq
         rt = self.reqtrace
         if rt is not None and rt.enabled:
@@ -355,13 +377,25 @@ class StateManager:
         seq = self.seqs.pop(uid)
         published = 0
         if self.prefix_cache is not None and seq.slot >= 0:
-            self._shared_nodes.pop(uid, None)
-            to_free = self.prefix_cache.publish(
-                seq.tokens, seq.blocks, seq.n_shared_blocks,
-                min(seq.n_computed, len(seq.tokens)))
-            published = len(seq.blocks) - len(to_free)
-            if to_free:
-                self.allocator.free(to_free)
+            shared = self._shared_nodes.pop(uid, None)
+            if seq.admit_wv != self.prefix_cache.weight_version:
+                # the weights swapped while this sequence was live
+                # (engine_v2.swap_weights): its KV was computed at least
+                # partly under the OLD weights, so publishing it would
+                # re-seed the post-swap trie with stale pages — drop the
+                # shared pins and free the owned tail instead
+                if shared:
+                    self.prefix_cache.release(shared)
+                owned = seq.blocks[seq.n_shared_blocks:]
+                if owned:
+                    self.allocator.free(owned)
+            else:
+                to_free = self.prefix_cache.publish(
+                    seq.tokens, seq.blocks, seq.n_shared_blocks,
+                    min(seq.n_computed, len(seq.tokens)))
+                published = len(seq.blocks) - len(to_free)
+                if to_free:
+                    self.allocator.free(to_free)
         elif seq.blocks:
             self.allocator.free(seq.blocks)
         if seq.slot >= 0:
@@ -669,6 +703,10 @@ class StateManager:
             seq.prefix_hit_tokens = 0     # imported, not served from cache
             if dups:
                 self.allocator.free(dups)
+        if self.prefix_cache is not None:
+            # skew-gated imports only land same-version bundles, so the
+            # imported pages are current-by-construction
+            seq.admit_wv = self.prefix_cache.weight_version
         seq.migrating = None
         rt = self.reqtrace
         if rt is not None and rt.enabled:

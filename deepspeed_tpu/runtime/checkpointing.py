@@ -33,14 +33,19 @@ import json
 import os
 import shutil
 import time
-import zlib
-from typing import Any
 
 import jax
 import jax.numpy as jnp
 
+from ..checkpoint.manifest import (file_crc32 as _file_crc32,
+                                   tag_status,
+                                   write_file_atomic as _write_file_atomic,
+                                   write_manifest)
 from ..utils.logging import log_dist, logger
 from .resilience import CheckpointWaitTimeout
+
+__all__ = ["CheckpointIntegrityError", "save_checkpoint", "load_checkpoint",
+           "wait_for_checkpoint", "write_manifest", "tag_status"]
 
 
 class CheckpointIntegrityError(RuntimeError):
@@ -52,73 +57,11 @@ def _injector(engine):
     return res.injector if res is not None else None
 
 
-# --------------------------------------------------------------------------
-# Manifest (per-entry checksums) + tag verification
-# --------------------------------------------------------------------------
-
-def _file_crc32(path: str, chunk: int = 1 << 20) -> int:
-    crc = 0
-    with open(path, "rb") as f:
-        while True:
-            buf = f.read(chunk)
-            if not buf:
-                break
-            crc = zlib.crc32(buf, crc)
-    return crc & 0xFFFFFFFF
-
-
-def write_manifest(path: str, tag: str, global_steps: int,
-                   level: str = "crc32") -> None:
-    """Commit proof for ``<path>`` (one tag dir): every file's size (and
-    crc32 under the full integrity level), written atomically AFTER the
-    state commit and BEFORE the 'latest' advance."""
-    if level == "none":
-        return
-    entries: dict[str, dict] = {}
-    for dirpath, _, files in os.walk(path):
-        for fn in sorted(files):
-            if dirpath == path and fn == "manifest.json":
-                continue
-            full = os.path.join(dirpath, fn)
-            rel = os.path.relpath(full, path)
-            ent: dict[str, Any] = {"size": os.path.getsize(full)}
-            if level == "crc32":
-                ent["crc32"] = _file_crc32(full)
-            entries[rel] = ent
-    doc = {"version": 1, "tag": tag, "global_steps": int(global_steps),
-           "integrity": level, "entries": entries}
-    _write_file_atomic(os.path.join(path, "manifest.json"),
-                       json.dumps(doc, indent=2))
-
-
-def tag_status(path: str, level: str = "crc32") -> tuple[str, str]:
-    """Classify one tag dir: ``verified`` (manifest checks out), ``legacy``
-    (complete but pre-manifest), ``bad`` (truncated/corrupt), ``missing``."""
-    if not os.path.isdir(path):
-        return "missing", "no such tag dir"
-    if not os.path.exists(os.path.join(path, "meta.json")):
-        return "bad", "meta.json missing"
-    if not os.path.isdir(os.path.join(path, "state")):
-        return "bad", "state dir missing"
-    man_path = os.path.join(path, "manifest.json")
-    if not os.path.exists(man_path):
-        return "legacy", "no manifest (pre-integrity checkpoint)"
-    try:
-        with open(man_path) as f:
-            man = json.load(f)
-    except (OSError, ValueError) as e:
-        return "bad", f"manifest unreadable: {e}"
-    for rel, ent in man.get("entries", {}).items():
-        full = os.path.join(path, rel)
-        if not os.path.exists(full):
-            return "bad", f"entry missing: {rel}"
-        size = os.path.getsize(full)
-        if size != ent.get("size"):
-            return "bad", f"entry truncated: {rel} ({size} != {ent['size']})"
-        if level == "crc32" and "crc32" in ent \
-                and _file_crc32(full) != ent["crc32"]:
-            return "bad", f"entry checksum mismatch: {rel}"
-    return "verified", ""
+# The manifest layer (per-entry checksums, tag verification, the atomic
+# file write) lives in checkpoint/manifest.py — jax-free, because the
+# serving tier's weight hot-swap verifies checkpoints from toy replica
+# processes that never import jax. This module re-exports the names its
+# callers (resilience policy, tests) have always used.
 
 
 def _tag_steps(path: str) -> float:
@@ -136,17 +79,6 @@ def _tag_steps(path: str) -> float:
         return os.path.getmtime(path) - 1e12  # always below any real step
     except OSError:
         return float("-inf")
-
-
-def _write_file_atomic(target: str, content: str) -> None:
-    """tmp + ``os.replace``: readers see the old content or the new,
-    never a torn/empty file — a crash mid-write cannot poison the tag."""
-    tmp = f"{target}.tmp.{os.getpid()}"
-    with open(tmp, "w") as f:
-        f.write(content)
-        f.flush()
-        os.fsync(f.fileno())
-    os.replace(tmp, target)
 
 
 def _ocp():

@@ -164,6 +164,24 @@ class FaultInjector:
                                              late delivery drills dedup)...
       ``replica_stall_stream_s`` (float)     ...for this long (default 1.0)
 
+    Weight-swap points (serving/deploy.py rolling deploys; armed per-slot
+    via ``FleetConfig.per_slot`` like the rest of the chaos matrix):
+      ``swap_crash_mid_quiesce`` (int k)     die handling the k-th swap
+                                             message, after quiesce and
+                                             before the load — the restart
+                                             comes up on the OLD version
+                                             and the deploy aborts
+      ``swap_corrupt_manifest`` (int k)      the k-th swap's checkpoint
+                                             fails manifest verification
+                                             (structured "integrity"
+                                             refusal; old weights serve)
+      ``swap_canary_degrade`` (float s)      after the next successful
+                                             swap, every decoded token
+                                             pays an extra s seconds —
+                                             the canary LOOKS healthy at
+                                             the handshake, so the deploy
+                                             health gate must catch it
+
     Crashes raise :class:`InjectedFault` (catchable in-process), or hard-kill
     the process with ``os._exit(INJECTED_CRASH_EXIT_CODE)`` when
     ``DS_TPU_FAULT_HARD=1`` (or ``hard=True``) — the subprocess tests use

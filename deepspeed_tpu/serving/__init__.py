@@ -24,6 +24,8 @@ into clock-aligned per-request views with black-box postmortem dumps
 See README.md "Serving fleet" / "Disaggregated serving" for topology,
 knobs, and runbooks.
 """
+from .deploy import (DeployConfig, DeployError, DeployManager,
+                     write_toy_checkpoint)
 from .disagg import MigrationState, RebalancePolicy, ROLES, ScaleAdvisor
 from .fleet import Fleet, FleetConfig
 from .placement import (StickyMap, best_digest_peer, chain_hashes,
@@ -36,12 +38,13 @@ from .transport import SocketChannel, SocketListener, connect_channel
 from .workload import TraceConfig, synth_trace
 
 __all__ = [
-    "AdmissionError", "ChannelClosed", "ChannelTimeout", "Fleet",
+    "AdmissionError", "ChannelClosed", "ChannelTimeout", "DeployConfig",
+    "DeployError", "DeployManager", "Fleet",
     "FleetConfig", "LineChannel", "MigrationState", "ROLES",
     "RebalancePolicy", "RequestRecord", "Router", "RouterConfig",
     "ScaleAdvisor", "ShmReader", "ShmRing", "SocketChannel",
     "SocketListener", "StickyMap", "TraceConfig", "attach_ring",
     "best_digest_peer", "chain_hashes", "connect_channel", "match_pages",
     "open_ring", "pick_replica", "poll_channels", "pull_beats_recompute",
-    "synth_trace",
+    "synth_trace", "write_toy_checkpoint",
 ]

@@ -87,6 +87,15 @@ class MigrationState:
     #: shm transfer)
     relayed: bool = False
 
+    @property
+    def weight_version(self) -> dict | None:
+        """The producing weight version stamped in the bundle meta at
+        export — the router's relay gates targets on it (a bundle
+        computed under one version must never import into a replica
+        serving another; the skew-safe fallback is resume-on-source /
+        replay, see serving/deploy.py)."""
+        return (self.meta or {}).get("wv")
+
     def add_chunk(self, msg: dict) -> None:
         i = int(msg["i"])
         if i not in self.chunks:

@@ -82,6 +82,12 @@ class ReplicaHandle:
         #: the replica's shared-memory page ring segment name (shm
         #: transport, serving/shm.py); None = relay-only peer
         self.shm: str | None = None
+        #: the weight version this incarnation serves
+        #: (``{"id", "digest"}`` from ready/heartbeat; None until ready).
+        #: Router-side MIRROR of the replica's authoritative
+        #: ``weight_version`` — named ``wv`` so the state-invariant lint
+        #: can pin mutation of the real thing to the swap API.
+        self.wv: dict | None = None
         #: fleet tracing (telemetry/fleettrace.py): the router's latest
         #: heartbeat-RTT and clock-offset estimates for this incarnation
         #: (None until a ping round-trips; reset on respawn — the new
@@ -132,7 +138,7 @@ class ReplicaHandle:
             from .transport import connect_channel
 
             self.state = SPAWNING
-            self.load = self.digest = self.shm = None
+            self.load = self.digest = self.shm = self.wv = None
             self.rtt_s = self.clock_offset_s = None
             self.last_msg_t = time.monotonic()
             try:
@@ -173,7 +179,7 @@ class ReplicaHandle:
         self.chan = LineChannel(self.proc.stdout.fileno(),
                                 self.proc.stdin.fileno(), own_fds=False)
         self.state = SPAWNING
-        self.load = self.digest = self.shm = None
+        self.load = self.digest = self.shm = self.wv = None
         self.rtt_s = self.clock_offset_s = None
         self.last_msg_t = time.monotonic()
         logger.info(f"fleet: slot {self.slot} spawned epoch {self.epoch} "
@@ -339,6 +345,9 @@ class Fleet:
         r.max_live = int(msg.get("max_live", 1))
         r.block_size = int(msg.get("block_size", 0))
         r.shm = msg.get("shm") or None
+        # r.wv is deliberately NOT set here: the router's _note_wv owns
+        # every wv transition (gauge + sticky invalidation) and would
+        # see an already-updated handle as "no change"
         # the worker's own view of its role wins (a remote daemon's
         # config lives with the daemon, not the fleet)
         r.role = str(msg.get("role", r.role))
@@ -353,6 +362,23 @@ class Fleet:
         """Chaos/bench hook: SIGKILL the slot's current incarnation (the
         next maintain() observes the death and runs the normal policy)."""
         self.replicas[slot].kill()
+
+    def set_deployed_weights(self, ckpt: str | None, tag: str | None,
+                             wid: int) -> None:
+        """Commit a COMPLETED deploy to the spawn template: replicas
+        respawned from here on load this checkpoint at startup. Called
+        only once a rolling deploy fully converged (serving/deploy.py) —
+        during the roll the template still names the prior version, so a
+        replica that dies mid-swap restarts on the OLD weights (the
+        always-safe side of the canary gate). ``ckpt=None`` reverts the
+        template to init weights."""
+        if ckpt is None:
+            self.cfg.replica.pop("ckpt", None)
+            self.cfg.replica.pop("ckpt_tag", None)
+        else:
+            self.cfg.replica["ckpt"] = ckpt
+            self.cfg.replica["ckpt_tag"] = tag
+        self.cfg.replica["wid"] = int(wid)
 
     def _export_state(self) -> None:
         if self._telem is None or not self._telem.enabled:

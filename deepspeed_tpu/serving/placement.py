@@ -83,19 +83,32 @@ class StickyMap:
             del self._m[h]
 
 
-def best_digest_peer(chain: list[int], handles,
-                     exclude_slot: int = -1) -> tuple[object | None, int]:
+def best_digest_peer(chain: list[int], handles, exclude_slot: int = -1,
+                     weight_version: dict | None = None
+                     ) -> tuple[object | None, int]:
     """Deepest residency-digest match for ``chain`` across ``handles``,
     excluding one slot (the replica the request was just placed on).
     Returns ``(handle, matched_pages)`` — the pull-source candidate for
     placement-time radix pulls. Ties break toward the lower slot
     (determinism: chaos tests replay placement). Only the DIGEST counts
     here, never the sticky map: a pull ships real pages, so the source
-    must actually hold them."""
+    must actually hold them.
+
+    ``weight_version`` (the PULLING replica's ``{"id", "digest"}``)
+    filters the candidates to same-version peers: during a rolling
+    deploy two replicas may serve different weights, and a chain
+    computed under one must never seed the other — the skew-safe path
+    is to never even attempt the pull (the caller counts the skip and
+    the puller recomputes, the always-safe fallback). ``None`` on either
+    side skips the filter (pre-versioning peers)."""
     best, pages = None, 0
     for h in handles:
         if h.slot == exclude_slot:
             continue
+        hv = getattr(h, "wv", None)
+        if weight_version is not None and hv is not None \
+                and hv != weight_version:
+            continue                     # cross-version peer: never pull
         m = match_pages(chain, h.digest)
         if m > pages or (m == pages and m > 0 and best is not None
                          and h.slot < best.slot):

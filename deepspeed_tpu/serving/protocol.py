@@ -63,10 +63,28 @@ Message vocabulary (``t`` is the type tag)::
                                             on the shm transport)
     {"t":"kv_fail","id":str}                pull dead: admit the held
                                             request and recompute
+    {"t":"swap","wid":int,"ckpt":str|null,"tag":str|null}
+                                            versioned weight hot-swap
+                                            (serving/deploy.py): quiesce
+                                            at the next window boundary,
+                                            load the checkpoint through
+                                            the verified-manifest path,
+                                            answer swap_ok/swap_fail;
+                                            ckpt null = revert to the
+                                            template ("init") weights
 
   replica -> router
     {"t":"ready","pid":int,"block_size":int,"max_live":int,"epoch":int,
-     "role":"prefill"|"decode"|"mixed"}
+     "role":"prefill"|"decode"|"mixed",
+     "wv":{"id":int,"digest":str}}          "wv" = the weight version
+                                            this replica serves (id is
+                                            the fleet-monotonic deploy
+                                            id, digest the checkpoint
+                                            manifest fingerprint); also
+                                            rides every heartbeat so the
+                                            router's skew gates and
+                                            per-replica version gauges
+                                            track swaps live
     {"t":"chunk","id":str,"off":int,"toks":[int]}    stream tokens; "off"
                                             is the stream offset of the
                                             first token (replay dedup)
@@ -111,6 +129,17 @@ Message vocabulary (``t`` is the type tag)::
                                             recompute fallback engaged)
     {"t":"kv_none","id":str,"a":int}        chain not cached here (pull
                                             export miss)
+    {"t":"swap_ok","wid":int,"wv":{...},"quiesce_s":float,
+     "swap_s":float}                        weight swap committed: the
+                                            new version serves, with the
+                                            quiesce-stall and load costs
+                                            the deploy histograms record
+    {"t":"swap_fail","wid":int,"reason":str}  swap refused (integrity |
+                                            shape_mismatch | probe_failed
+                                            | no_checkpoint | unsupported)
+                                            — the OLD weights keep
+                                            serving; the deploy aborts or
+                                            rolls back
     {"t":"bye"}                             clean shutdown ack
 
 Deadlines are LAW here (bin/check_deadlines.py lints this package): every

@@ -45,7 +45,17 @@ from .protocol import (ChannelClosed, ChannelTimeout, LineChannel,
 _MASK = (1 << 64) - 1
 
 #: structured per-request failure reasons a replica may report
-FAIL_REASONS = ("capacity", "draining", "duplicate", "internal")
+#: ("version_skew" = a KV transfer was refused because the pages were
+#: computed under different weights than this replica serves — the
+#: rolling-deploy skew guard; the router falls back to
+#: recompute/resume, never a mixed-version forward)
+FAIL_REASONS = ("capacity", "draining", "duplicate", "internal",
+                "version_skew")
+
+#: structured weight-swap refusal reasons (the ``swap_fail`` reply's
+#: vocabulary; engine_v2.WeightSwapError.reason uses the same words)
+SWAP_FAIL_REASONS = ("integrity", "shape_mismatch", "probe_failed",
+                     "no_checkpoint", "unsupported")
 
 
 def _mix(s: int, t: int) -> int:
@@ -83,6 +93,10 @@ class ToyBackend:
         #: OWNS its fake pool — StateManager's refcounted-API lint governs
         #: the engine's pool, not this simulation)
         self.radix = PrefixCache(self.block_size)
+        #: serving weight version (monotonic id + checkpoint manifest
+        #: digest; "init" = template weights). Assignment is pinned to
+        #: __init__/swap_weights (bin/check_state_invariants.py).
+        self.weight_version = {"id": 0, "digest": "init"}
         self._next_block = 1
         self.seqs: dict[str, dict] = {}
         self.order: list[str] = []
@@ -111,7 +125,8 @@ class ToyBackend:
             seed = _mix(seed, int(t))
         self.seqs[rec.trace_id] = {
             "rec": rec, "nodes": nodes, "generated": [],
-            "prefill_left": len(rec.prompt) - hit, "seed": seed}
+            "prefill_left": len(rec.prompt) - hit, "seed": seed,
+            "wv": self.weight_version["id"]}
         self.order.append(rec.trace_id)
         return None
 
@@ -132,9 +147,15 @@ class ToyBackend:
         """Release path: publish full computed pages into the trie (the
         blocks are fake ids — the trie only tracks ownership), exactly
         like StateManager.release, so the residency digest grows the way
-        a real replica's does."""
+        a real replica's does — including the swap skew guard: a
+        sequence that lived across a weight swap releases WITHOUT
+        publishing (its pages would be stale under the new weights)."""
         seq = self.seqs.pop(rid)
         self.order.remove(rid)
+        if seq.get("wv", 0) != self.weight_version["id"]:
+            if seq["nodes"]:
+                self.radix.release(seq["nodes"])
+            return
         tokens = list(seq["rec"].prompt) + seq["generated"]
         n_full = len(tokens) // self.block_size
         blocks = [n.block for n in seq["nodes"]]
@@ -227,7 +248,8 @@ class ToyBackend:
         rec = seq["rec"]
         return toy_bundle(rid, list(rec.prompt), list(seq["generated"]),
                           rec.max_new_tokens, rec.eos_token_id,
-                          rec.tenant, self.block_size)
+                          rec.tenant, self.block_size,
+                          weight_version=dict(self.weight_version))
 
     def take_handoffs(self) -> list[tuple]:
         """Bundle every sequence frozen for transfer this step — prefill
@@ -268,23 +290,30 @@ class ToyBackend:
         if not nodes:
             return None
         return toy_prefix_bundle(
-            "", tokens[:len(nodes) * self.block_size], self.block_size)
+            "", tokens[:len(nodes) * self.block_size], self.block_size,
+            weight_version=dict(self.weight_version))
 
     def adopt_prefix(self, bundle) -> int:
         """Seed the local radix from a pulled chain (verifying payload
         integrity first); the pulling request's admit then hits these
         pages through the normal match path. Returns pages adopted, 0 on
-        a corrupt bundle (caller recomputes)."""
-        from ..inference.migration import MigrationError, toy_verify
+        a corrupt OR version-skewed bundle (caller recomputes — a chain
+        computed under other weights must never seed this trie)."""
+        from ..inference.migration import (MigrationError, toy_verify,
+                                           version_skew)
 
+        if version_skew(bundle.weight_version, self.weight_version):
+            return 0
         try:
             toy_verify(bundle)
-        except MigrationError:
+            nodes, _ = self.radix.adopt(
+                bundle.tokens,
+                [self._fresh_block() for _ in range(bundle.n_full)],
+                bundle.n_full * self.block_size)
+        except (MigrationError, RuntimeError):
+            # corrupt bundle, or a pinned stale-version page blocks the
+            # chain (a swap raced the pull): recompute
             return 0
-        nodes, _ = self.radix.adopt(
-            bundle.tokens,
-            [self._fresh_block() for _ in range(bundle.n_full)],
-            bundle.n_full * self.block_size)
         self.radix.release(nodes)
         self.pulled_pages += bundle.n_full
         over = len(self.radix) - self.cache_pages
@@ -300,6 +329,11 @@ class ToyBackend:
         if seq is None:
             return
         self.seqs.pop(rid, None)
+        if seq.get("wv", 0) != self.weight_version["id"]:
+            if seq["nodes"]:            # lived across a swap: no publish
+                self.radix.release(seq["nodes"])
+            self.migrations_out += 1
+            return
         tokens = list(seq["rec"].prompt) + seq["generated"]
         n_computed = len(tokens) - 1
         n_full = n_computed // self.block_size
@@ -327,10 +361,12 @@ class ToyBackend:
     def import_begin(self, rid: str, meta: dict) -> str | None:
         """Reserve capacity for an arriving bundle; structured refusal
         reason or None."""
-        from ..inference.migration import BundleAssembler
+        from ..inference.migration import BundleAssembler, version_skew
 
         if rid in self.seqs:
             return "duplicate"
+        if version_skew(meta.get("wv"), self.weight_version):
+            return "version_skew"
         if len(self.seqs) >= self.max_live:
             return "capacity"
         self._imports[rid] = BundleAssembler(meta)
@@ -375,17 +411,19 @@ class ToyBackend:
         try:
             bundle = asm.assemble()
             toy_verify(bundle)      # payload integrity oracle
-        except MigrationError:
+            n_aligned = bundle.n_full * self.block_size
+            nodes, _ = self.radix.adopt(
+                bundle.tokens,
+                [self._fresh_block() for _ in range(bundle.n_full)],
+                n_aligned)
+        except (MigrationError, RuntimeError):
+            # torn payload, or a pinned stale-version page blocks the
+            # chain (a swap raced the transfer): the router replays
             self.import_abort(rid)
             return ("fail", "import_failed")
         del self._imports[rid]
         prompt = bundle.tokens[:bundle.prompt_len]
         generated = bundle.tokens[bundle.prompt_len:]
-        n_aligned = bundle.n_full * self.block_size
-        nodes, _ = self.radix.adopt(
-            bundle.tokens,
-            [self._fresh_block() for _ in range(bundle.n_full)],
-            n_aligned)
         seed = 0
         for t in prompt:
             seed = _mix(seed, int(t))
@@ -397,7 +435,8 @@ class ToyBackend:
                 max_new_tokens=bundle.max_new_tokens,
                 eos_token_id=bundle.eos_id, tenant=bundle.tenant),
             "nodes": nodes, "generated": [int(t) for t in generated],
-            "prefill_left": 0, "seed": seed}
+            "prefill_left": 0, "seed": seed,
+            "wv": self.weight_version["id"]}
         self.order.append(rid)
         self.migrations_in += 1
         return ("ok", None)
@@ -432,6 +471,81 @@ class ToyBackend:
 
     def digest_version(self) -> int:
         return self.radix.version
+
+    # -- versioned weight hot-swap (serving/deploy.py) -------------------
+    def swap_weights(self, ckpt: str | None, tag: str | None,
+                     wid: int) -> tuple[str | None, dict | None]:
+        """Load a "weights" checkpoint through the verified-manifest path
+        and adopt its version, or refuse with a structured reason. The
+        toy has no real parameters — its stream is a pure function of
+        the prompt, which is what lets the multiprocess deploy suite
+        assert bit-identical streams across a rolling swap — but it runs
+        the REAL verification: manifest crc gate, shape guard, digest
+        stamp. ``ckpt=None`` reverts to the template ("init") weights —
+        the rollback target when the fleet never deployed a checkpoint.
+        Returns ``(None, info)`` on success, ``(reason, None)`` on
+        refusal; the old version keeps serving on ANY refusal."""
+        t0 = time.perf_counter()
+        if ckpt is None:
+            self.weight_version = {"id": int(wid), "digest": "init"}
+            self._flush_radix(int(wid))
+            return None, {"wv": dict(self.weight_version),
+                          "quiesce_s": 0.0,
+                          "swap_s": time.perf_counter() - t0}
+        import json
+
+        from ..checkpoint.manifest import (manifest_digest, resolve_tag,
+                                           tag_status)
+
+        if tag is not None:
+            # an explicitly named tag NEVER silently falls back: missing
+            # is a structured no_checkpoint, anything torn/tampered is
+            # the crc gate's integrity refusal
+            status, reason = tag_status(os.path.join(ckpt, tag))
+            if status == "missing":
+                return "no_checkpoint", None
+            if status != "verified":
+                return "integrity", None
+            rtag = tag
+        else:
+            rtag, why = resolve_tag(ckpt, None)
+            if not rtag:
+                return "no_checkpoint", None
+        path = os.path.join(ckpt, rtag)
+        try:
+            with open(os.path.join(path, "meta.json")) as f:
+                meta = json.load(f)
+        except (OSError, ValueError):
+            return "integrity", None
+        shape = meta.get("shape") or {}
+        if int(shape.get("vocab", self.vocab)) != self.vocab \
+                or int(shape.get("block_size",
+                                 self.block_size)) != self.block_size:
+            # the same-shape contract: a different-geometry checkpoint
+            # is refused BEFORE anything changes (KV would be invalid)
+            return "shape_mismatch", None
+        self.weight_version = {"id": int(wid),
+                               "digest": manifest_digest(path)}
+        self._flush_radix(int(wid))
+        return None, {"wv": dict(self.weight_version), "quiesce_s": 0.0,
+                      "swap_s": time.perf_counter() - t0}
+
+    def _flush_radix(self, wid: int) -> None:
+        """Swap commit, trie half (mirrors
+        ``StateManager.flush_prefix_cache``): evict every unreferenced
+        cached page — a new request must not prefill from pages the old
+        weights computed — and stamp the new version so the digest
+        re-ships. Live sequences keep their pins and release without
+        publishing (the ``wv`` guard in :meth:`_finish`)."""
+        self.radix.evict(len(self.radix))
+        self.radix.set_weight_version(wid)
+
+    def degrade(self, delay_s: float) -> None:
+        """Chaos hook (``swap_canary_degrade``): the canary came up
+        'working' but slow — every decoded token now pays ``delay_s``,
+        so the deploy's health gate (probe TTFT / straggler signals)
+        must catch what the swap handshake alone cannot."""
+        self.decode_delay_s = float(delay_s)
 
 
 class EngineBackend:
@@ -473,9 +587,14 @@ class EngineBackend:
         self._imports: dict[str, object] = {}    # rid -> BundleAssembler
         self._resumed: set[str] = set()          # mig_resume'd: serve local
         self._handoff_req: set[str] = set()      # rebalance victims
+        self._degrade_s = 0.0                    # swap_canary_degrade chaos
         self.migrations_out = 0
         self.migrations_in = 0
         self.pulled_pages = 0
+
+    @property
+    def weight_version(self) -> dict:
+        return self.eng.weight_version()
 
     def has_work(self) -> bool:
         return bool(self._uids) or bool(self.eng._inflight)
@@ -526,6 +645,8 @@ class EngineBackend:
         if self._in_prefill() \
                 and inj.countdown("replica_crash_during_prefill"):
             inj.crash_now("replica_crash_during_prefill", "engine prefill")
+        if self._degrade_s:
+            time.sleep(self._degrade_s)
         emitted = self.eng.step()
         events: list[tuple] = []
         by_uid = {uid: rid for rid, uid in self._uids.items()}
@@ -670,10 +791,13 @@ class EngineBackend:
 
     def import_begin(self, rid: str, meta: dict) -> str | None:
         from ..inference.migration import (BundleAssembler,
-                                           MigrationError, PageBundle)
+                                           MigrationError, PageBundle,
+                                           version_skew)
 
         if rid in self._uids:
             return "duplicate"
+        if version_skew(meta.get("wv"), self.weight_version):
+            return "version_skew"
         shell = PageBundle.from_meta(meta)
         if not self.eng.can_import(
                 len(shell.tokens),
@@ -753,6 +877,29 @@ class EngineBackend:
     def digest_version(self) -> int:
         return self.eng.prefix_cache_version()
 
+    # -- versioned weight hot-swap (serving/deploy.py) -------------------
+    def swap_weights(self, ckpt: str | None, tag: str | None,
+                     wid: int) -> tuple[str | None, dict | None]:
+        """In-place engine weight swap through
+        ``engine_v2.swap_weights`` (verified manifest, same-shape
+        restore into the live shardings, finiteness probe; any failure
+        keeps the old params serving). ``ckpt=None`` (revert to init
+        weights) is unsupported here — an engine fleet bootstraps from a
+        published ``save_weights`` checkpoint so rollback always has a
+        verified target."""
+        from ..inference.engine_v2 import WeightSwapError
+
+        if ckpt is None:
+            return "unsupported", None
+        try:
+            info = self.eng.swap_weights(ckpt, tag=tag, wid=int(wid))
+        except WeightSwapError as e:
+            return e.reason, None
+        return None, info
+
+    def degrade(self, delay_s: float) -> None:
+        self._degrade_s = float(delay_s)
+
 
 def _build_backend(cfg: dict):
     kind = cfg.get("backend", "toy")
@@ -787,6 +934,19 @@ def serve(cfg: dict, chan: LineChannel) -> int:
     if inj.countdown("replica_crash_on_start"):
         inj.crash_now("replica_crash_on_start", "replica startup")
     backend = _build_backend(cfg)
+    if cfg.get("ckpt"):
+        # the fleet's deployed version: a replica (re)spawned mid- or
+        # post-deploy loads the SAME verified checkpoint the template
+        # names, so a crash during a rolling swap restarts on whatever
+        # version the fleet had committed to — never a half-deployed one.
+        # A load failure here is always-safe: log and serve the template
+        # ("init") weights; the router's version gauges surface the skew.
+        reason, _ = backend.swap_weights(cfg["ckpt"], cfg.get("ckpt_tag"),
+                                         int(cfg.get("wid", 1)))
+        if reason:
+            logger.error(f"replica: startup weight load from "
+                         f"{cfg['ckpt']} refused ({reason}); serving "
+                         f"init weights")
 
     telem = None
     snap_path = cfg.get("telemetry_snapshot")
@@ -805,6 +965,7 @@ def serve(cfg: dict, chan: LineChannel) -> int:
                "block_size": backend.block_size,
                "max_live": backend.max_live, "role": role,
                "shm": ring.name if ring is not None else None,
+               "wv": dict(backend.weight_version),
                "epoch": int(cfg.get("epoch", 0))}, timeout=send_t)
 
     draining = False
@@ -1223,6 +1384,45 @@ def serve(cfg: dict, chan: LineChannel) -> int:
                 # the pull died somewhere (peer gone, chain evicted,
                 # router gave up): recompute — the always-safe fallback
                 _settle_pull(str(msg["id"]), 0)
+            elif t == "swap":
+                # versioned weight hot-swap (serving/deploy.py): the
+                # loop sits between step() calls here, so this IS the
+                # window boundary — in-flight sequences are paused, not
+                # drained, and their KV stays valid for the same-shape
+                # update. The backend verifies + loads; any failure is a
+                # structured swap_fail with the OLD weights serving.
+                wid = int(msg.get("wid", 0))
+                if inj.countdown("swap_crash_mid_quiesce"):
+                    inj.crash_now("swap_crash_mid_quiesce",
+                                  f"weight swap to v{wid}")
+                t_sw = time.monotonic()
+                if inj.countdown("swap_corrupt_manifest"):
+                    reason, info = "integrity", None
+                else:
+                    reason, info = backend.swap_weights(
+                        msg.get("ckpt"), msg.get("tag"), wid)
+                if reason:
+                    logger.error(f"replica: weight swap to v{wid} "
+                                 f"refused ({reason})")
+                    chan.send({"t": "swap_fail", "wid": wid,
+                               "reason": reason}, timeout=send_t)
+                else:
+                    # stamp every in-flight request's fleet-trace
+                    # segment: a rolling-deploy stall shows up ON the
+                    # requests that paid it
+                    for rid in list(rtrace):
+                        _trace_ev(rid, "weight_swap", wid=wid)
+                    v = inj.fire("swap_canary_degrade")
+                    if v:
+                        backend.degrade(float(v))
+                    chan.send(
+                        {"t": "swap_ok", "wid": wid,
+                         "wv": dict(backend.weight_version),
+                         "quiesce_s": round(info["quiesce_s"], 6),
+                         "swap_s": round(info.get(
+                             "swap_s", time.monotonic() - t_sw), 6)},
+                        timeout=send_t)
+                    last_hb = 0.0    # ship the new version immediately
             elif t == "drain":
                 draining = True
             elif t == "trace_req":
@@ -1329,7 +1529,8 @@ def serve(cfg: dict, chan: LineChannel) -> int:
         now = time.monotonic()
         if now - last_hb >= hb_interval:
             last_hb = now
-            hb: dict = {"t": "hb", "load": backend.load()}
+            hb: dict = {"t": "hb", "load": backend.load(),
+                        "wv": dict(backend.weight_version)}
             if ping_echo is not None:
                 # clock-sync answer: the router computes rtt from its
                 # echoed timestamp and our offset from the RTT midpoint

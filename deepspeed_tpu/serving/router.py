@@ -46,7 +46,10 @@ from ..telemetry import LATENCY_BUCKETS_S, get_telemetry, configure as \
     telemetry_configure, sanitize_label_value
 from ..telemetry.reqtrace import (TENANT_CARDINALITY_CAP,
                                   TENANT_OVERFLOW_LABEL)
+from ..inference.migration import version_skew
 from ..utils.logging import logger
+from .deploy import DeployConfig, DeployError, DeployManager, \
+    verify_deploy_target
 from .disagg import (DECODE_CAPABLE, MigrationState, PREFILL_CAPABLE,
                      RebalancePolicy, ScaleAdvisor, role_of)
 from .fleet import DRAINING, Fleet, FleetConfig, QUARANTINED, READY
@@ -185,6 +188,11 @@ class _Req:
     #: this request was rebalanced once already (or a rebalance for it
     #: aborted): never pick it again — the anti-ping-pong hysteresis
     rebalanced: bool = False
+    #: dispatch only to this slot (-1 = normal placement): the deploy
+    #: canary probe pins itself to the freshly-swapped replica; a pinned
+    #: request whose slot is not ready stays queued (its submitter's
+    #: deadline — the deploy probe timeout — bounds the wait)
+    pin_slot: int = -1
 
 
 class Router:
@@ -231,6 +239,13 @@ class Router:
         self.kv_pulls = 0
         self.kv_pull_fallbacks = 0
         self.rebalances = 0
+        #: cross-version KV transfers refused by the skew guard, by path
+        self.version_skews = 0
+        #: rolling weight deploys (serving/deploy.py): the active state
+        #: machine (None = no deploy ever started / last one finished
+        #: and was replaced) and per-outcome completion counts
+        self._deploy: DeployManager | None = None
+        self.deploys = {o: 0 for o in ("ok", "rolled_back", "aborted")}
         # fleet-wide distributed tracing (telemetry/fleettrace.py):
         # constructed ONLY when enabled — disabled is zero-overhead by
         # absence, and replicas are told to record/ship segments via the
@@ -282,7 +297,8 @@ class Router:
     # -- admission -------------------------------------------------------
     def submit(self, prompt, *, tenant: str = "default",
                max_new_tokens: int = 16, eos_token_id: int | None = None,
-               priority: int = 0, trace_id: str | None = None) -> str:
+               priority: int = 0, trace_id: str | None = None,
+               pin_slot: int = -1) -> str:
         """Admit a request or refuse it with a structured
         :class:`AdmissionError`. Returns the trace ID; results arrive via
         :meth:`poll`/:meth:`run` and :meth:`result`."""
@@ -338,7 +354,8 @@ class Router:
         # actually serve from cache: the prompt's last token always
         # computes fresh (its forward produces the first logits)
         chain = chain_hashes(rec.prompt[:-1], bs) if bs else []
-        req = _Req(rec=rec, chain=chain, submit_t=rec.submitted_t)
+        req = _Req(rec=rec, chain=chain, submit_t=rec.submitted_t,
+                   pin_slot=int(pin_slot))
         self._reqs[tid] = req
         self._queues.setdefault(rec.priority, deque()).append(tid)
         self._tenant_live[tenant] = self._tenant_live.get(tenant, 0) + 1
@@ -446,6 +463,10 @@ class Router:
             if now - self._last_straggler_gauges >= 1.0:
                 self._last_straggler_gauges = now
                 self._update_straggler_gauges()
+        if self._deploy is not None and self._deploy.active:
+            # the rolling-deploy state machine: deadline checks + the
+            # next swap/probe/rollback action, one bounded step per tick
+            self._deploy.tick(now)
         self._dispatch(now)
         # per-role autoscale hints: signals only (gauges), no actuator
         self._scale.update(
@@ -476,11 +497,106 @@ class Router:
             self.poll()
         return self.results()
 
+    # -- zero-downtime weight deploys (serving/deploy.py) ----------------
+    # One rolling swap at a time: canary -> probe -> soak -> replica-by-
+    # replica, at most one replica quiesced fleet-wide, automatic
+    # rollback on canary breach / swap failure / crash. The state
+    # machine is ticked from poll(); nothing here blocks.
+
+    def start_deploy(self, ckpt: str, tag: str | None = None,
+                     cfg: DeployConfig | None = None) -> dict:
+        """Begin a rolling deploy of the verified checkpoint at
+        ``ckpt`` (tag resolved via its ``latest`` when not given).
+        Non-blocking: progress rides :meth:`poll`; watch
+        :meth:`deploy_status`. Raises :class:`~.deploy.DeployError` on a
+        bad target and ``RuntimeError`` when a deploy is already
+        running. Returns the initial status dict."""
+        if self._deploy is not None and self._deploy.active:
+            raise RuntimeError(
+                f"a deploy to v{self._deploy.wid} is already running "
+                f"(phase {self._deploy.phase})")
+        rtag, digest = verify_deploy_target(ckpt, tag)
+        wid = 1 + max(
+            [int(self.fleet.cfg.replica.get("wid", 0))]
+            + [int((r.wv or {}).get("id", 0))
+               for r in self.fleet.replicas])
+        self._deploy = DeployManager(self, os.path.abspath(ckpt), rtag,
+                                     wid, digest, cfg or DeployConfig())
+        return self._deploy.status()
+
+    def deploy(self, ckpt: str, tag: str | None = None,
+               cfg: DeployConfig | None = None,
+               deadline_s: float = 180.0) -> dict:
+        """Blocking convenience over :meth:`start_deploy`: poll until
+        the deploy reaches a terminal outcome (bounded by
+        ``deadline_s`` on top of the deploy's own deadline). Traffic
+        submitted before or during keeps flowing — poll() serves it on
+        the same ticks."""
+        self.start_deploy(ckpt, tag, cfg)
+        deadline = time.monotonic() + deadline_s
+        while self._deploy.active:
+            if time.monotonic() >= deadline:
+                break
+            self.poll()
+        return self._deploy.status()
+
+    def deploy_status(self) -> dict | None:
+        """The latest (possibly finished) deploy's status, or None."""
+        return self._deploy.status() if self._deploy is not None else None
+
+    def note_deploy_finished(self, dep: DeployManager) -> None:
+        """DeployManager callback at terminal transition: outcome
+        counters + the fleet-target version gauge."""
+        self.deploys[dep.outcome] = self.deploys.get(dep.outcome, 0) + 1
+        if self._ftrace is not None and dep.outcome != "ok":
+            self._blackbox({"kind": "deploy_" + dep.outcome,
+                            "reason": dep.reason})
+        if self._telem.enabled:
+            self._telem.registry.counter(
+                "serving_router_deploys_total",
+                labels={"outcome": dep.outcome},
+                help="rolling weight deploys by terminal outcome "
+                     "(ok | rolled_back | aborted)").inc()
+            self._telem.registry.gauge(
+                "serving_router_weight_version",
+                help="the fleet template's deployed weight-version id "
+                     "(what a restarted replica loads)").set(
+                int(self.fleet.cfg.replica.get("wid", 0)))
+
+    def _note_wv(self, h, wv: dict | None) -> None:
+        """A ready/heartbeat carried a weight version: track it on the
+        handle and invalidate what a version change breaks — sticky
+        placement entries bias toward cache the OLD version computed."""
+        if wv is None or wv == h.wv:
+            return
+        if h.wv is not None:
+            self._sticky.forget_slot(h.slot)
+        h.wv = dict(wv)
+        if self._telem.enabled:
+            self._telem.registry.gauge(
+                "serving_router_replica_weight_version",
+                labels={"replica": str(h.slot)},
+                help="weight-version id each replica currently serves "
+                     "(mixed values across replicas = a rolling deploy "
+                     "in flight)").set(int(wv.get("id", 0)))
+
+    def _count_version_skew(self, path: str) -> None:
+        self.version_skews += 1
+        if self._telem.enabled:
+            self._telem.registry.counter(
+                "serving_router_version_skew_total",
+                labels={"path": path},
+                help="cross-version KV transfers refused by the "
+                     "rolling-deploy skew guard, by path (the fallback "
+                     "is recompute / resume-on-source — never a "
+                     "mixed-version forward)").inc()
+
     # -- message handling ------------------------------------------------
     def _handle(self, h, msg: dict) -> None:
         t = msg.get("t")
         if t == "ready":
             self.fleet.on_ready(h, msg)
+            self._note_wv(h, msg.get("wv"))
         elif t == "hb":
             h.load = msg.get("load")
             if "digest" in msg:
@@ -488,8 +604,13 @@ class Router:
                 # (replicas version it); the router keeps its copy
                 d = msg["digest"]
                 h.digest = set(d) if d else None
+            if "wv" in msg:
+                self._note_wv(h, msg.get("wv"))
             if self._ftrace is not None and "echo" in msg:
                 self._on_clock_sample(h, msg)
+        elif t in ("swap_ok", "swap_fail"):
+            if self._deploy is not None:
+                self._deploy.on_swap(h, msg)
         elif t == "trace":
             self._on_trace(h, msg)
         elif t in ("chunk", "done", "failed"):
@@ -546,6 +667,17 @@ class Router:
             self._terminate(tid, DONE, None)
         else:                            # failed
             reason = str(msg.get("reason", "internal"))
+            if reason == "version_skew" and req.mig is not None \
+                    and self._slot_alive(req.mig.src_slot,
+                                         req.mig.src_epoch):
+                # the race backstop: the target swapped between our
+                # version check and its import_begin. The SOURCE still
+                # holds the frozen sequence — resume it there (zero work
+                # lost; role-split degrades to mixed for this request)
+                # instead of burning a retry on a replay
+                self._count_version_skew("import")
+                self._abort_rebalance(req, reason)
+                return
             if reason == "draining":
                 # the replica is winding down, not broken: stop routing
                 # to it and requeue WITHOUT burning a retry (the drain
@@ -794,8 +926,17 @@ class Router:
         to it — or, with no target, tell the source to keep decoding."""
         mig = req.mig
         tid = req.rec.trace_id
-        cands = [r for r in self._candidates(DECODE_CAPABLE)
-                 if r.slot != mig.src_slot]
+        pre = [r for r in self._candidates(DECODE_CAPABLE)
+               if r.slot != mig.src_slot]
+        # skew gate: the bundle's pages were computed under the source's
+        # weights — a target serving another version must never import
+        # them. Mid-deploy this degrades role-split to mixed (resume on
+        # the source) instead of corrupting KV.
+        cands = [r for r in pre
+                 if not version_skew(mig.weight_version,
+                                     getattr(r, "wv", None))]
+        if pre and not cands:
+            self._count_version_skew("migration")
         if not cands:
             # degrade to mixed: cheaper than failing or re-prefilling,
             # and the scale advisor turns this into a decode-up hint
@@ -1111,6 +1252,7 @@ class Router:
                 "state": r.state, "role": role_of(r), "epoch": r.epoch,
                 "live": (r.load or {}).get("live"),
                 "digest_entries": len(r.digest) if r.digest else 0,
+                "weight_version": r.wv,
                 "rtt_s": r.rtt_s, "clock_offset_s": r.clock_offset_s}
         assignments = {
             tid: {"status": rq.status, "slot": rq.assigned_slot,
@@ -1183,7 +1325,8 @@ class Router:
         reps = {}
         for r in self.fleet.replicas:
             e = {"state": r.state, "role": role_of(r), "epoch": r.epoch,
-                 "live": (r.load or {}).get("live")}
+                 "live": (r.load or {}).get("live"),
+                 "weight_version": r.wv}
             if self._ftrace is not None:
                 e["rtt_s"] = r.rtt_s
                 e["clock_offset_s"] = r.clock_offset_s
@@ -1195,6 +1338,9 @@ class Router:
                 "degraded": sorted(s for s, d in degraded.items() if d),
                 "blackbox_dumps": self.blackbox_dumps,
                 "trace_segments": self.trace_segments,
+                "deploy": self.deploy_status(),
+                "deploys": dict(self.deploys),
+                "version_skews": self.version_skews,
                 "fleet_trace": self._ftrace is not None}
 
     def export_fleet_chrome(self, path: str,
@@ -1227,9 +1373,20 @@ class Router:
             if not cands:
                 return
             tid = None
+            cand_slots = {c.slot for c in cands}
             for p in sorted(self._queues, reverse=True):
-                if self._queues[p]:
-                    tid = self._queues[p].popleft()
+                q = self._queues[p]
+                for i, qt in enumerate(q):
+                    rq = self._reqs[qt]
+                    if rq.pin_slot >= 0 and rq.pin_slot not in cand_slots:
+                        # pinned slot not dispatchable right now: stays
+                        # queued (the pinner's deadline bounds the wait),
+                        # everyone behind it keeps flowing
+                        continue
+                    del q[i]
+                    tid = qt
+                    break
+                if tid is not None:
                     break
             if tid is None:
                 return
@@ -1240,7 +1397,9 @@ class Router:
                     help="prompts placed on a decode-role replica for "
                          "lack of a ready prefill-capable slot").inc()
             req = self._reqs[tid]
-            rep, hit_pages = pick_replica(cands, req.chain, self._sticky)
+            pool = [c for c in cands if c.slot == req.pin_slot] \
+                if req.pin_slot >= 0 else cands
+            rep, hit_pages = pick_replica(pool, req.chain, self._sticky)
             req.attempt += 1
             req.status = ASSIGNED
             req.assigned_slot = rep.slot
@@ -1309,10 +1468,27 @@ class Router:
     # router says kv_fail.
 
     def _maybe_pull(self, req: _Req, rep, hit_pages: int):
+        rep_wv = getattr(rep, "wv", None)
         peer, pages = best_digest_peer(req.chain, self.fleet.ready(),
-                                       exclude_slot=rep.slot)
+                                       exclude_slot=rep.slot,
+                                       weight_version=rep_wv)
         extra = pages - hit_pages
         if peer is None or extra < self.cfg.kv_pull_min_pages:
+            # was a cross-version peer the only thing worth pulling
+            # from? Only worth asking while the fleet is actually
+            # mixed-version (a deploy in flight) — the cheap any() gate
+            # keeps the steady state to one digest scan per dispatch
+            if rep_wv is not None and any(
+                    version_skew(getattr(h, "wv", None), rep_wv)
+                    for h in self.fleet.ready()):
+                p_any, pg_any = best_digest_peer(
+                    req.chain, self.fleet.ready(), exclude_slot=rep.slot)
+                if p_any is not None \
+                        and pg_any - hit_pages >= self.cfg.kv_pull_min_pages \
+                        and version_skew(getattr(p_any, "wv", None),
+                                         rep_wv):
+                    self._count_version_skew("kv_pull")
+                    self._fail_pull_count_only("version_skew")
             return None, 0
         bs = rep.block_size or self._fleet_block_size() or 1
         shm_ok = bool(peer.shm) and not rep.address and not peer.address
@@ -1415,6 +1591,14 @@ class Router:
                 # torn source leg, or the request moved on (replayed
                 # elsewhere) while the chain was in flight
                 self._fail_pull(tid, "torn_or_moved")
+                return
+            tgt = self.fleet.replicas[pull.tgt_slot]
+            if version_skew((pull.meta or {}).get("wv"),
+                            getattr(tgt, "wv", None)):
+                # either side swapped while the chain was in flight:
+                # kv_fail releases the puller to recompute (skew-safe)
+                self._count_version_skew("kv_pull")
+                self._fail_pull(tid, "version_skew")
                 return
             pull.phase = "xfer"
             ok = self._send_to_slot(

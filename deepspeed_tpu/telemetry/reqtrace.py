@@ -74,6 +74,13 @@ LIFECYCLE_EVENTS = (
     #                     dir="in" = pulled pages adopted into the local
     #                     trie; both carry the pulling request's serving
     #                     trace ID, linking the two replicas' timelines
+    "weight_swap",      # in-place weight hot-swap (engine_v2.swap_weights):
+    #                     a pool-level event (uid -1 — it pauses EVERY live
+    #                     sequence) carrying the new weight-version id +
+    #                     quiesce/swap durations; the serving replica
+    #                     additionally stamps each in-flight request's
+    #                     fleet-trace segment so rolling-deploy stalls are
+    #                     attributable per request
 )
 
 #: hard cap on distinct tenant label values per process — the scrape's

@@ -421,3 +421,54 @@ def test_deadline_detector_honors_allowlist(tmp_path):
 def test_deadline_lint_requires_the_serving_package():
     out = deadline_lint.check_repo("/nonexistent")
     assert len(out) == 1 and "missing" in out[0]
+
+
+def test_deadline_lint_covers_deploy_waits(tmp_path):
+    """serving/deploy.py is inside the linted package: an unbounded
+    wait smuggled into the deploy orchestrator (a blocking join on a
+    quiesce, a bare select) is flagged like anywhere else in serving/ —
+    every quiesce/probe/rollback wait must be deadline-bounded."""
+    serving = tmp_path / "deepspeed_tpu" / "serving"
+    serving.mkdir(parents=True)
+    (serving / "deploy.py").write_text(
+        "import select\n"
+        "def wait_for_swap(t, fds):\n"
+        "    t.join()\n"                           # flagged: unbounded
+        "    select.select(fds, [], [])\n")        # flagged: no timeout
+    out = deadline_lint.check_repo(str(tmp_path))
+    assert len(out) == 2
+    assert ":3:" in out[0] and ".join()" in out[0]
+    assert ":4:" in out[1] and "select()" in out[1]
+
+
+def test_state_invariant_detector_pins_weight_version_to_swap_api(
+        tmp_path):
+    """The weight-version stamp gates cross-replica KV transfer: a
+    stray assignment anywhere outside the swap API (including annotated
+    and private-alias forms) is flagged; the swap API itself and the
+    constructors stay legal, as does the router-side ``wv`` mirror."""
+    bad = tmp_path / "deepspeed_tpu" / "serving" / "router.py"
+    bad.parent.mkdir(parents=True)
+    bad.write_text(
+        "class Router:\n"
+        "    def _handle(self, h, eng):\n"
+        "        eng.weight_version = {'id': 9}\n"   # flagged
+        "        eng._weight_version: dict = {}\n"   # flagged (annotated)
+        "        h.wv = {'id': 9}\n"                 # mirror attr: ok
+        "        v = eng.weight_version\n")          # read: ok
+    out = state_lint.check_file(str(bad))
+    assert len(out) == 2
+    assert ":3:" in out[0] and "weight_version" in out[0]
+    assert ":4:" in out[1]
+    ok = tmp_path / "deepspeed_tpu" / "inference" / "engine_v2.py"
+    ok.parent.mkdir(parents=True)
+    ok.write_text(
+        "class Engine:\n"
+        "    def __init__(self):\n"
+        "        self._weight_version = {'id': 0}\n"     # ctor: ok
+        "    def swap_weights(self, wid):\n"
+        "        self._weight_version = {'id': wid}\n"   # swap API: ok
+        "    def sneaky(self, wid):\n"
+        "        self._weight_version = {'id': wid}\n")  # flagged
+    out = state_lint.check_file(str(ok))
+    assert len(out) == 1 and ":7:" in out[0]

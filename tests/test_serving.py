@@ -248,9 +248,17 @@ def test_two_replica_smoke_admission_placement_failover():
         assert router.stale_msgs == 0
 
         # ---- placement: serialized same-prefix requests co-locate on
-        # the replica whose digest holds the chain
+        # the replica whose digest holds the chain. Digests publish at
+        # RELEASE and ride the next heartbeat — give each one a bounded
+        # window to land before the next placement decision, or the
+        # decision falls back to sticky/load and can split under machine
+        # load (this was a measured ~1/4 flake on a loaded box)
         placements = collections.defaultdict(set)
         for i, rec in enumerate(trace[:6]):
+            deadline = time.monotonic() + 2.0
+            while time.monotonic() < deadline and any(
+                    h.digest is None for h in router.fleet.ready()):
+                router.poll()
             tid = router.submit(rec.prompt, tenant=rec.tenant,
                                 max_new_tokens=4,
                                 trace_id=f"p{i}")
