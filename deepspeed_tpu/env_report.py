@@ -158,6 +158,20 @@ def feature_report() -> list[tuple[str, bool, str]]:
         feats.append(("serving: zero-downtime weight deploys", False,
                       str(e)))
 
+    # crash-safe control plane (serving/journal.py): write-ahead request
+    # journal + fleet re-adoption — pure host logic, import check
+    try:
+        from .serving import journal as _journal  # noqa: F401
+        feats.append((
+            "serving: crash-safe router (journal + resync)", True,
+            "RouterConfig.journal_dir — crc'd segmented write-ahead log "
+            "(fsync always|interval|none), restart replays + re-adopts "
+            "daemon replicas via resync (streams re-attach, exactly-"
+            "once); BENCH_MODE=router router_restart scenario"))
+    except Exception as e:  # pragma: no cover — import breakage only
+        feats.append(("serving: crash-safe router (journal + resync)",
+                      False, str(e)))
+
     # telemetry / monitor backends (telemetry/ + monitor/): which push
     # backends can actually activate, and where the pull endpoint +
     # flight recorder would land for this process

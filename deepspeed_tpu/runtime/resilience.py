@@ -182,6 +182,30 @@ class FaultInjector:
                                              the handshake, so the deploy
                                              health gate must catch it
 
+    Router-side points (serving/router.py, armed via
+    ``RouterConfig.faults`` and always HARD — the journal chaos matrix
+    SIGKILLs the CONTROL PLANE at each journaled phase, all count-based
+    via :meth:`countdown`):
+      ``router_crash_after_admit`` (int k)   die after journaling the
+                                             k-th admit (admitted-unplaced
+                                             recovery)
+      ``router_crash_after_place`` (int k)   die after the k-th placement
+                                             went out (mid-stream
+                                             recovery: daemons keep
+                                             decoding, resync re-attaches)
+      ``router_crash_before_relay_ack``      (int k) die between the
+                                             importer's mig_ack and the
+                                             ack relay to the pinned
+                                             handoff source
+      ``router_crash_mid_kv_pull`` (int k)   die right after starting a
+                                             placement-time radix pull
+                                             (the puller's local deadline
+                                             recomputes)
+      ``router_crash_mid_deploy_canary``     (int k) die while a rolling
+                                             deploy sits in its canary
+                                             phase (recovery rolls the
+                                             fleet back deterministically)
+
     Crashes raise :class:`InjectedFault` (catchable in-process), or hard-kill
     the process with ``os._exit(INJECTED_CRASH_EXIT_CODE)`` when
     ``DS_TPU_FAULT_HARD=1`` (or ``hard=True``) — the subprocess tests use

@@ -19,7 +19,11 @@ seeded multi-tenant traces the bench and chaos suites replay.
 Fleet-wide distributed tracing (telemetry/fleettrace.py,
 ``RouterConfig(fleet_trace=True)``) assembles router + replica timelines
 into clock-aligned per-request views with black-box postmortem dumps
-(``bin/ds_postmortem``) and straggler gauges.
+(``bin/ds_postmortem``) and straggler gauges. The router itself is
+crash-safe (journal.py, ``RouterConfig.journal_dir``): a write-ahead
+request journal plus the resync/re_adopt exchange let a restarted
+router re-adopt daemon replicas' in-flight work — decode continues
+through the outage and streams re-attach without replay.
 
 See README.md "Serving fleet" / "Disaggregated serving" for topology,
 knobs, and runbooks.
@@ -28,6 +32,8 @@ from .deploy import (DeployConfig, DeployError, DeployManager,
                      write_toy_checkpoint)
 from .disagg import MigrationState, RebalancePolicy, ROLES, ScaleAdvisor
 from .fleet import Fleet, FleetConfig
+from .journal import (Journal, JournalError, RecoveredState,
+                      reduce_router_records)
 from .placement import (StickyMap, best_digest_peer, chain_hashes,
                         match_pages, pick_replica, pull_beats_recompute)
 from .protocol import (ChannelClosed, ChannelTimeout, LineChannel,
@@ -40,7 +46,9 @@ from .workload import TraceConfig, synth_trace
 __all__ = [
     "AdmissionError", "ChannelClosed", "ChannelTimeout", "DeployConfig",
     "DeployError", "DeployManager", "Fleet",
-    "FleetConfig", "LineChannel", "MigrationState", "ROLES",
+    "FleetConfig", "Journal", "JournalError", "LineChannel",
+    "MigrationState", "ROLES", "RecoveredState",
+    "reduce_router_records",
     "RebalancePolicy", "RequestRecord", "Router", "RouterConfig",
     "ScaleAdvisor", "ShmReader", "ShmRing", "SocketChannel",
     "SocketListener", "StickyMap", "TraceConfig", "attach_ring",

@@ -363,6 +363,25 @@ class Fleet:
         next maintain() observes the death and runs the normal policy)."""
         self.replicas[slot].kill()
 
+    def abandon(self) -> None:
+        """Chaos/bench hook (the router-crash emulation): drop every
+        channel with no shutdown message and no kill. Daemon (address)
+        slots observe the disconnect and keep serving their in-flight
+        work; pipe-spawned children see EOF on stdin and exit on their
+        own. This Fleet is dead afterwards."""
+        for r in self.replicas:
+            if r.chan is not None:
+                r.chan.close()
+                r.chan = None
+            if r.proc is not None:
+                for f in (r.proc.stdin, r.proc.stdout):
+                    if f is not None:
+                        try:
+                            f.close()
+                        except OSError:
+                            pass         # broken pipe at close
+            r.state = DEAD
+
     def set_deployed_weights(self, ckpt: str | None, tag: str | None,
                              wid: int) -> None:
         """Commit a COMPLETED deploy to the spawn template: replicas

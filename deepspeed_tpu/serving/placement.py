@@ -69,11 +69,22 @@ class StickyMap:
         while len(self._m) > self.cap:
             self._m.popitem(last=False)
 
-    def lookup(self, chain: list[int]) -> tuple[int, int] | None:
-        """(slot, matched_pages) for the deepest remembered chain hash."""
+    def lookup(self, chain: list[int],
+               allowed: set[int] | None = None) -> tuple[int, int] | None:
+        """(slot, matched_pages) for the deepest remembered chain hash.
+
+        ``allowed`` restricts the walk to slots the caller can actually
+        use: a deeper entry pointing at an ineligible slot must not
+        SHADOW a shallower eligible one. (The concrete case: a request's
+        own dispatch noted its full prompt chain at the prefill-role
+        replica, one page deeper than the tenant's shared prefix — a
+        handoff relay that can only target decode-capable slots would
+        otherwise discard the sticky signal entirely and fall back to
+        lagging load estimates, splitting same-tenant bundles across
+        decode replicas.)"""
         for j in range(len(chain) - 1, -1, -1):
             slot = self._m.get(chain[j])
-            if slot is not None:
+            if slot is not None and (allowed is None or slot in allowed):
                 return slot, j + 1
         return None
 
@@ -151,7 +162,8 @@ def pick_replica(candidates: list, chain: list[int],
     if not candidates:
         raise ValueError("no candidate replicas")
     best, best_key, best_hit = None, None, 0
-    sticky_hit = sticky.lookup(chain) if sticky is not None else None
+    sticky_hit = sticky.lookup(chain, {c.slot for c in candidates}) \
+        if sticky is not None else None
     for rep in candidates:
         pages = match_pages(chain, rep.digest)
         s_pages = sticky_hit[1] \

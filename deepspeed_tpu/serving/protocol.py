@@ -63,6 +63,18 @@ Message vocabulary (``t`` is the type tag)::
                                             on the shm transport)
     {"t":"kv_fail","id":str}                pull dead: admit the held
                                             request and recompute
+    {"t":"resync"}                          crash-safe router (journal.py):
+                                            a restarted router asks what
+                                            this replica still holds —
+                                            answered with "resync_ok"
+    {"t":"re_adopt","id":str,"a":int,"have":int}  the restarted router
+                                            re-owns this request under a
+                                            fresh attempt nonce; the
+                                            replica clears its orphan
+                                            deadline and re-attaches the
+                                            stream from offset "have"
+                                            (a buffered terminal reply
+                                            re-sends instead)
     {"t":"swap","wid":int,"ckpt":str|null,"tag":str|null}
                                             versioned weight hot-swap
                                             (serving/deploy.py): quiesce
@@ -140,6 +152,17 @@ Message vocabulary (``t`` is the type tag)::
                                             — the OLD weights keep
                                             serving; the deploy aborts or
                                             rolls back
+    {"t":"resync_ok","reqs":[{"id":str,"committed":int,"done":bool?}],
+     "role":str,"wv":{...},"digest":[int]}  re-adoption inventory: live
+                                            sequences (with streamed-token
+                                            counts) + recently-terminal
+                                            requests whose replies may
+                                            have died with the old
+                                            router, plus role / weight
+                                            version / residency digest so
+                                            the restarted router's
+                                            placement state rebuilds in
+                                            one exchange
     {"t":"bye"}                             clean shutdown ack
 
 Deadlines are LAW here (bin/check_deadlines.py lints this package): every

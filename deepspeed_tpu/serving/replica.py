@@ -29,6 +29,15 @@ no-unwind death, not an exception the loop could accidentally absorb.
 The loop never blocks unboundedly: reads poll with a short timeout so
 stepping and heartbeats interleave with message handling, and writes are
 deadline-bounded (a dead router cannot wedge a replica in a pipe write).
+
+``--listen`` daemons are additionally ROUTER-CRASH-SAFE (the serving
+tier's control-plane survivability, serving/journal.py): one
+:class:`DaemonState` survives every router connection, so in-flight
+decode continues through a router outage — streams buffer per request
+(bounded, with an orphan deadline) and re-attach when a restarted
+router re-adopts them via the ``resync``/``re_adopt`` exchange. An idle
+daemon's re-accept loop backs off exponentially with seeded jitter
+(:class:`AcceptBackoff`) instead of spinning while the router is down.
 """
 from __future__ import annotations
 
@@ -449,6 +458,30 @@ class ToyBackend:
     def drain_done(self) -> bool:
         return not self.seqs
 
+    # -- fleet re-adoption (crash-safe router, serving/journal.py) -------
+    def live_requests(self) -> dict[str, int]:
+        """rid -> generated-token count for every ADOPTABLE sequence a
+        restarted router could re-attach to. Imports in flight are
+        excluded: their payload buffer died with the router that was
+        relaying it, so they can only abort."""
+        return {rid: len(seq["generated"])
+                for rid, seq in self.seqs.items()
+                if not seq.get("importing")}
+
+    def resync_resume(self, rid: str) -> None:
+        """A restarted router re-adopted this request: any pinned export
+        resumes local decode (the old router's relay buffer is gone) and
+        a pending boundary handoff un-freezes — role-split degrades to
+        mixed for the outage's sequences instead of stranding them."""
+        if rid in self._exports:
+            self.export_abort(rid, resume=True)
+        elif rid in self._handoff:
+            self._handoff.remove(rid)
+            seq = self.seqs.get(rid)
+            if seq is not None:
+                seq["resumed"] = True
+                self.order.append(rid)
+
     def load(self) -> dict:
         # frozen sequences (handoff pending / export pinned / import
         # arriving) hold capacity but schedule nothing — mirror the
@@ -868,6 +901,22 @@ class EngineBackend:
     def drain_done(self) -> bool:
         return not self.has_work()
 
+    # -- fleet re-adoption (crash-safe router, serving/journal.py) -------
+    def live_requests(self) -> dict[str, int]:
+        out: dict[str, int] = {}
+        for rid, uid in self._uids.items():
+            if rid in self._imports:
+                continue
+            seq = self.eng.state.seqs.get(uid)
+            if seq is not None:
+                out[rid] = int(seq.n_generated)
+        return out
+
+    def resync_resume(self, rid: str) -> None:
+        if rid in self._exports:
+            self.export_abort(rid, resume=True)
+        self._handoff_req.discard(rid)
+
     def load(self) -> dict:
         return self.eng.load_summary()
 
@@ -921,32 +970,238 @@ def _cleanup_shm(ring, readers: dict) -> None:
     readers.clear()
 
 
-def serve(cfg: dict, chan: LineChannel) -> int:
+class AcceptBackoff:
+    """Exponential backoff + seeded jitter for a daemon's re-accept loop.
+
+    A down router used to cost an idle ``--listen`` daemon one wakeup
+    per fixed 1s accept timeout forever; this paces the accept waits out
+    to ``max_s`` instead. The accept's ``select`` IS the sleep —
+    :meth:`next` returns the timeout to pass ``accept_channel`` — and
+    the whole sequence is deterministic in the seed so the unit test
+    pins exact delays. :meth:`reset` on any accepted connection (or
+    while the backend still holds work, where the loop polls fast).
+    ``_sleep`` is the test seam for :meth:`pause`, the out-of-loop
+    variant."""
+
+    def __init__(self, base_s: float = 0.05, max_s: float = 2.0,
+                 jitter: float = 0.5, seed: int = 0):
+        import random
+        self.base_s = float(base_s)
+        self.max_s = float(max_s)
+        self.jitter = min(max(float(jitter), 0.0), 1.0)
+        self._rng = random.Random(seed)
+        self._n = 0
+        self._sleep = time.sleep          # test seam
+
+    def next(self) -> float:
+        """The next accept timeout: ``base * 2^n`` capped at ``max_s``,
+        shaved by up to ``jitter`` of itself (never below
+        ``(1 - jitter) * base``) so a fleet of daemons desynchronizes."""
+        d = min(self.base_s * (2.0 ** self._n), self.max_s)
+        self._n += 1
+        return d * (1.0 - self.jitter * self._rng.random())
+
+    def pause(self) -> float:
+        """Sleep the next delay through the ``_sleep`` seam; returns it."""
+        d = self.next()
+        self._sleep(d)
+        return d
+
+    def reset(self) -> None:
+        self._n = 0
+
+
+class DaemonState:
+    """Replica state that must survive a router connection (the serving
+    tier's control-plane crash safety, serving/journal.py): the backend
+    with its in-flight sequences, per-request attempt nonces and stream
+    logs, buffered terminal replies, and the orphan deadlines that bound
+    work no restarted router ever re-adopts.
+
+    A pipe-parent replica builds a fresh one per process (its lifetime
+    IS the connection). A ``--listen`` daemon builds ONE and threads it
+    through every accept, so in-flight decode continues through a router
+    outage and streams re-attach on the ``resync``/``re_adopt`` exchange
+    without replay."""
+
+    def __init__(self, cfg: dict):
+        from .shm import open_ring
+
+        self.cfg = cfg
+        self.inj = FaultInjector(spec=cfg.get("faults") or {}, env="",
+                                 hard=True)
+        v = self.inj.fire("replica_slow_start_s")
+        if v:
+            time.sleep(float(v))
+        if self.inj.countdown("replica_crash_on_start"):
+            self.inj.crash_now("replica_crash_on_start", "replica startup")
+        self.backend = _build_backend(cfg)
+        if cfg.get("ckpt"):
+            # the fleet's deployed version: a replica (re)spawned mid- or
+            # post-deploy loads the SAME verified checkpoint the template
+            # names, so a crash during a rolling swap restarts on the
+            # version the fleet had committed to — never a half-deployed
+            # one. A load failure is always-safe: log and serve the
+            # template ("init") weights; the version gauges surface it.
+            reason, _ = self.backend.swap_weights(
+                cfg["ckpt"], cfg.get("ckpt_tag"), int(cfg.get("wid", 1)))
+            if reason:
+                logger.error(f"replica: startup weight load from "
+                             f"{cfg['ckpt']} refused ({reason}); serving "
+                             f"init weights")
+        # intra-host fast path (serving/shm.py): payload rides this
+        # replica's shared ring, descriptors ride the line protocol
+        self.ring = open_ring(int(cfg.get("shm_bytes", 0) or 0))
+        self.readers: dict[str, object] = {}
+        self.attempts: dict[str, int] = {}   # rid -> router attempt nonce
+        #: rid -> every generated token streamed so far (insertion-
+        #: ordered; re_adopt re-sends the tail from the router's offset)
+        self.stream_log: dict[str, list[int]] = {}
+        #: rid -> buffered terminal reply ({"msg", "t"}) — the done/failed
+        #: a dead router may never have durably received; bounded LRU +
+        #: TTL, re-sent on re_adopt
+        self.term_buf: dict[str, dict] = {}
+        #: rid -> deadline past which un-re-adopted work is flushed
+        self.orphans: dict[str, float] = {}
+        # transfer-protocol state (pulls hold deferred puts; exports are
+        # retained for shm-relay resends)
+        self.pulls: dict[str, dict] = {}
+        self.pull_exports: dict[str, tuple] = {}
+        self.mig_shm: dict[str, str | None] = {}
+        self.mig_relay_need: set[str] = set()
+        self.orphan_deadline_s = float(cfg.get("orphan_deadline_s", 30.0))
+        self.stream_log_cap = int(cfg.get("stream_log_cap", 256))
+        self.term_buf_cap = int(cfg.get("term_buf_cap", 128))
+
+    # -- stream bookkeeping ---------------------------------------------
+    def note_chunk(self, rid: str, off: int, toks: list[int]) -> None:
+        """Fold a streamed chunk into the per-request log (idempotent on
+        overlap, exactly like the router's committed-prefix folding)."""
+        log = self.stream_log.get(rid)
+        if log is None:
+            while len(self.stream_log) >= self.stream_log_cap:
+                self.stream_log.pop(next(iter(self.stream_log)))
+            log = self.stream_log[rid] = []
+        if off <= len(log):
+            log.extend(toks[len(log) - off:])
+
+    def note_term(self, rid: str, msg: dict) -> None:
+        self.stream_log.pop(rid, None)
+        self.term_buf[rid] = {"msg": dict(msg), "t": time.monotonic()}
+        while len(self.term_buf) > self.term_buf_cap:
+            self.term_buf.pop(next(iter(self.term_buf)))
+
+    def reset_request(self, rid: str) -> None:
+        """A fresh put supersedes anything remembered for this id."""
+        self.orphans.pop(rid, None)
+        self.stream_log.pop(rid, None)
+        self.term_buf.pop(rid, None)
+
+    # -- router-outage handling -----------------------------------------
+    def admit_offline(self, msg: dict) -> None:
+        """Admit a (pull-deferred) put with no router to answer: the
+        stream buffers; a refusal buffers as a terminal reply."""
+        rid = str(msg["id"])
+        self.backend.cancel(rid)
+        reason = self.backend.put(RequestRecord.from_wire(msg))
+        if reason:
+            self.note_term(rid, {"t": "failed", "id": rid,
+                                 "a": self.attempts.get(rid, 0),
+                                 "reason": reason})
+
+    def on_disconnect(self) -> None:
+        """The router went away: stamp every live/recently-terminal
+        request with an orphan deadline, and settle in-flight pulls
+        locally (the relaying router is gone, the chain can never
+        complete — recompute is the always-safe fallback)."""
+        now = time.monotonic()
+        dl = now + self.orphan_deadline_s
+        for rid, entry in list(self.pulls.items()):
+            self.pulls.pop(rid, None)
+            self.admit_offline(entry["put"])
+        for rid in set(self.attempts) | set(self.term_buf):
+            self.orphans.setdefault(rid, dl)
+
+    def offline_tick(self) -> None:
+        """One disconnected scheduling quantum: decode CONTINUES through
+        the router outage — events buffer in the stream logs / terminal
+        buffer, bounded by the orphan deadlines."""
+        now = time.monotonic()
+        self.expire_orphans(now)
+        for rid in [r for r, e in list(self.pulls.items())
+                    if now >= e["deadline"]]:
+            entry = self.pulls.pop(rid)
+            self.admit_offline(entry["put"])
+        for rid, kind, toks, off in self.backend.step(self.inj):
+            if kind == "chunk":
+                self.note_chunk(rid, off, [int(t) for t in toks])
+            elif kind == "done":
+                self.note_term(rid, {"t": "done", "id": rid,
+                                     "a": self.attempts.pop(rid, 0),
+                                     "toks": [int(t) for t in toks]})
+            else:
+                self.note_term(rid, {"t": "failed", "id": rid,
+                                     "a": self.attempts.pop(rid, 0),
+                                     "reason": str(toks)})
+        # boundary crossings with nobody to relay the handoff: resume
+        # them local right away (role-split degrades to mixed for the
+        # outage's sequences — never a stranded frozen export)
+        for rid in list(getattr(self.backend, "_handoff", ())):
+            self.backend.resync_resume(rid)
+
+    def expire_orphans(self, now: float) -> None:
+        """Flush work whose orphan deadline passed un-re-adopted, and
+        age out stale buffered terminals."""
+        for rid in [r for r, dl in list(self.orphans.items())
+                    if now >= dl]:
+            self.drop_request(rid)
+        for rid in [r for r, e in list(self.term_buf.items())
+                    if now - e["t"] > self.orphan_deadline_s]:
+            self.term_buf.pop(rid, None)
+            self.orphans.pop(rid, None)
+
+    def drop_request(self, rid: str) -> None:
+        self.orphans.pop(rid, None)
+        self.attempts.pop(rid, None)
+        self.stream_log.pop(rid, None)
+        self.term_buf.pop(rid, None)
+        self.pulls.pop(rid, None)
+        self.pull_exports.pop(rid, None)
+        self.mig_shm.pop(rid, None)
+        self.mig_relay_need.discard(rid)
+        self.backend.cancel(rid)
+
+    # -- resync ----------------------------------------------------------
+    def resync_inventory(self) -> list[dict]:
+        """What a freshly-connected router needs for re-adoption: live
+        sequences (committed = tokens logged so far) and recently-
+        terminal requests whose replies may have died with the old
+        router."""
+        out = []
+        live = self.backend.live_requests()
+        for rid in live:
+            out.append({"id": rid,
+                        "committed": len(self.stream_log.get(rid, ()))})
+        for rid, e in self.term_buf.items():
+            if rid in live:
+                continue
+            m = e["msg"]
+            out.append({"id": rid, "done": m.get("t") == "done",
+                        "committed": len(m.get("toks", ()))})
+        return out
+
+
+def serve(cfg: dict, chan: LineChannel,
+          state: DaemonState | None = None) -> int:
     """The replica event loop. Returns 0 on an explicit shutdown message
     and 2 when the router went away (a ``--listen`` daemon then goes
-    back to accepting; the pipe-parent mode exits either way); raises
-    only on injected soft faults (the worker runs injection HARD, so in
-    production shape a crash is an ``os._exit``)."""
-    inj = FaultInjector(spec=cfg.get("faults") or {}, env="", hard=True)
-    v = inj.fire("replica_slow_start_s")
-    if v:
-        time.sleep(float(v))
-    if inj.countdown("replica_crash_on_start"):
-        inj.crash_now("replica_crash_on_start", "replica startup")
-    backend = _build_backend(cfg)
-    if cfg.get("ckpt"):
-        # the fleet's deployed version: a replica (re)spawned mid- or
-        # post-deploy loads the SAME verified checkpoint the template
-        # names, so a crash during a rolling swap restarts on whatever
-        # version the fleet had committed to — never a half-deployed one.
-        # A load failure here is always-safe: log and serve the template
-        # ("init") weights; the router's version gauges surface the skew.
-        reason, _ = backend.swap_weights(cfg["ckpt"], cfg.get("ckpt_tag"),
-                                         int(cfg.get("wid", 1)))
-        if reason:
-            logger.error(f"replica: startup weight load from "
-                         f"{cfg['ckpt']} refused ({reason}); serving "
-                         f"init weights")
+    back to accepting — with ``state`` threaded through, its in-flight
+    work keeps decoding between routers; the pipe-parent mode exits
+    either way); raises only on injected soft faults (the worker runs
+    injection HARD, so in production shape a crash is an ``os._exit``)."""
+    st = state if state is not None else DaemonState(cfg)
+    inj = st.inj
+    backend = st.backend
 
     telem = None
     snap_path = cfg.get("telemetry_snapshot")
@@ -957,10 +1212,8 @@ def serve(cfg: dict, chan: LineChannel) -> int:
     send_t = float(cfg.get("send_timeout_s", 2.0))
     digest_max = int(cfg.get("digest_max", 4096))
     role = getattr(backend, "role", "mixed")
-    # intra-host fast path (serving/shm.py): payload rides this replica's
-    # shared ring, descriptors ride the line protocol; 0 = relay-only
-    from .shm import attach_ring, open_ring
-    ring = open_ring(int(cfg.get("shm_bytes", 0) or 0))
+    from .shm import attach_ring
+    ring = st.ring
     chan.send({"t": "ready", "pid": os.getpid(),
                "block_size": backend.block_size,
                "max_live": backend.max_live, "role": role,
@@ -969,7 +1222,7 @@ def serve(cfg: dict, chan: LineChannel) -> int:
                "epoch": int(cfg.get("epoch", 0))}, timeout=send_t)
 
     draining = False
-    attempts: dict[str, int] = {}        # rid -> router attempt nonce
+    attempts = st.attempts               # rid -> router attempt nonce
     last_hb = 0.0
     digest_ver_sent = -1                 # first heartbeat always ships it
     stall_until = 0.0
@@ -1030,26 +1283,53 @@ def serve(cfg: dict, chan: LineChannel) -> int:
     # placement-time radix pulls (puller side): puts held back while
     # their pulled chain is in flight — {"put", "deadline", "asm",
     # "shm", "relay"}; admitted (recompute fallback) at the deadline NO
-    # MATTER WHAT the fleet does
-    pulls: dict[str, dict] = {}
+    # MATTER WHAT the fleet does. All of these live on the daemon state
+    # so they survive a router outage.
+    pulls = st.pulls
     # peer exports retained for shm-relay resends (bounded FIFO)
-    pull_exports: dict[str, tuple] = {}
+    pull_exports = st.pull_exports
     # import leg: source ring name per in-flight migration, and rids
     # whose shm reads failed (EOF then asks for an inline relay resend)
-    mig_shm: dict[str, str | None] = {}
-    mig_relay_need: set[str] = set()
+    mig_shm = st.mig_shm
+    mig_relay_need = st.mig_relay_need
     # per-peer-ring attach results (the transport negotiation cache):
     # name -> ShmReader | None (None = attach failed, relay forever)
-    readers: dict[str, object] = {}
+    readers = st.readers
+
+    def _send(msg: dict) -> bool:
+        """Protocol send that survives a dead router: on failure, drain
+        whatever the router already wrote — a put that raced the crash
+        is real admitted work the restarted router will re-adopt via
+        resync — then mark the channel closed so the recv loop observes
+        the death only AFTER the drained messages are processed."""
+        if chan.closed:
+            return False
+        try:
+            chan.send(msg, timeout=send_t)
+            return True
+        except (ChannelClosed, ChannelTimeout) as e:
+            logger.warning(f"replica: send failed ({e}); holding state "
+                           f"for resync")
+            chan._pump()
+            chan.closed = True
+            return False
 
     def _stream(msg: dict) -> None:
         """Send a chunk/done/failed message, honoring an active
         stream-stall window (heartbeats keep flowing — the 'engine
-        wedged, process alive' shape)."""
+        wedged, process alive' shape). Generated-stream messages are
+        noted in the daemon state FIRST, so a router death mid-send
+        loses nothing a later resync cannot re-attach."""
+        t = msg.get("t")
+        if t == "chunk":
+            st.note_chunk(str(msg["id"]), int(msg.get("off", 0)),
+                          [int(x) for x in msg.get("toks", ())])
+        elif t in ("done", "failed"):
+            st.note_term(str(msg["id"]), msg)
         if time.monotonic() < stall_until:
             stalled.append(msg)
             return
-        chan.send(msg, timeout=send_t)
+        _send(msg)
 
     def _reader(name: str | None):
         """Attach a peer's ring once; cache the verdict per pair. The
@@ -1154,13 +1434,19 @@ def serve(cfg: dict, chan: LineChannel) -> int:
             msg = chan.recv(timeout=0.001 if busy else
                             min(hb_interval, 0.05))
         except ChannelClosed:
-            _cleanup_shm(ring, readers)
+            # mark orphan deadlines + settle pulls locally so a --listen
+            # daemon keeps decoding through the outage; the pipe-parent
+            # mode exits (its replacement respawns clean)
+            st.on_disconnect()
+            if state is None:
+                _cleanup_shm(ring, readers)
             return 2                     # router went away
         if msg is not None:
             t = msg.get("t")
             if t == "put":
                 rid = str(msg["id"])
                 attempts[rid] = int(msg.get("a", 0))
+                st.reset_request(rid)
                 _trace_ev(rid, "put", prompt=len(msg.get("prompt", ())),
                           pull=bool(msg.get("pull")))
                 if not draining and inj.countdown("replica_crash_on_put"):
@@ -1179,11 +1465,9 @@ def serve(cfg: dict, chan: LineChannel) -> int:
                     _admit_put(msg)
             elif t == "flush":
                 rid = str(msg["id"])
-                pulls.pop(rid, None)
-                pull_exports.pop(rid, None)
                 _trace_ev(rid, "flush")
                 _trace_ship(rid)
-                backend.cancel(rid)
+                st.drop_request(rid)     # pulls/exports/buffers + cancel
             elif t == "mig_begin":
                 # a migrated-in sequence is arriving (decode role): claim
                 # capacity BEFORE the first payload chunk
@@ -1384,6 +1668,41 @@ def serve(cfg: dict, chan: LineChannel) -> int:
                 # the pull died somewhere (peer gone, chain evicted,
                 # router gave up): recompute — the always-safe fallback
                 _settle_pull(str(msg["id"]), 0)
+            elif t == "resync":
+                # fleet re-adoption (crash-safe router): a restarted
+                # router asks what this replica still holds — live
+                # sequences with their committed counts, recently-
+                # terminal replies, plus role/version/digest so its
+                # placement state rebuilds in one exchange
+                _send({"t": "resync_ok",
+                       "reqs": st.resync_inventory(), "role": role,
+                       "wv": dict(backend.weight_version),
+                       "digest": backend.digest(digest_max)})
+                digest_ver_sent = backend.digest_version()
+            elif t == "re_adopt":
+                # the restarted router re-owns this request under a
+                # fresh attempt nonce: clear its orphan deadline, resume
+                # any pinned transfer state locally, and re-attach the
+                # stream from the router's journaled offset — a buffered
+                # terminal reply re-sends instead
+                rid = str(msg["id"])
+                a = int(msg.get("a", 0))
+                have = int(msg.get("have", 0))
+                st.orphans.pop(rid, None)
+                _trace_ev(rid, "re_adopt", have=have)
+                ent = st.term_buf.get(rid)
+                if ent is not None \
+                        and rid not in backend.live_requests():
+                    st.attempts.pop(rid, None)
+                    _stream({**ent["msg"], "a": a})
+                else:
+                    attempts[rid] = a
+                    backend.resync_resume(rid)
+                    tail = st.stream_log.get(rid, [])[have:]
+                    if tail:
+                        _stream({"t": "chunk", "id": rid, "a": a,
+                                 "off": have,
+                                 "toks": [int(x) for x in tail]})
             elif t == "swap":
                 # versioned weight hot-swap (serving/deploy.py): the
                 # loop sits between step() calls here, so this IS the
@@ -1404,8 +1723,8 @@ def serve(cfg: dict, chan: LineChannel) -> int:
                 if reason:
                     logger.error(f"replica: weight swap to v{wid} "
                                  f"refused ({reason})")
-                    chan.send({"t": "swap_fail", "wid": wid,
-                               "reason": reason}, timeout=send_t)
+                    _send({"t": "swap_fail", "wid": wid,
+                           "reason": reason})
                 else:
                     # stamp every in-flight request's fleet-trace
                     # segment: a rolling-deploy stall shows up ON the
@@ -1415,13 +1734,11 @@ def serve(cfg: dict, chan: LineChannel) -> int:
                     v = inj.fire("swap_canary_degrade")
                     if v:
                         backend.degrade(float(v))
-                    chan.send(
-                        {"t": "swap_ok", "wid": wid,
-                         "wv": dict(backend.weight_version),
-                         "quiesce_s": round(info["quiesce_s"], 6),
-                         "swap_s": round(info.get(
-                             "swap_s", time.monotonic() - t_sw), 6)},
-                        timeout=send_t)
+                    _send({"t": "swap_ok", "wid": wid,
+                           "wv": dict(backend.weight_version),
+                           "quiesce_s": round(info["quiesce_s"], 6),
+                           "swap_s": round(info.get(
+                               "swap_s", time.monotonic() - t_sw), 6)})
                     last_hb = 0.0    # ship the new version immediately
             elif t == "drain":
                 draining = True
@@ -1523,12 +1840,16 @@ def serve(cfg: dict, chan: LineChannel) -> int:
             # stall expired: deliver the queued stream late — the router
             # has usually reassigned by now and must drop these as stale
             for m in stalled:
-                chan.send(m, timeout=send_t)
+                _send(m)
             stalled.clear()
 
         now = time.monotonic()
         if now - last_hb >= hb_interval:
             last_hb = now
+            # orphan hygiene rides the heartbeat cadence: work a router
+            # (restarted or not) never re-acked is flushed at its
+            # deadline even while a NEW router is connected
+            st.expire_orphans(now)
             hb: dict = {"t": "hb", "load": backend.load(),
                         "wv": dict(backend.weight_version)}
             if ping_echo is not None:
@@ -1546,7 +1867,7 @@ def serve(cfg: dict, chan: LineChannel) -> int:
             if ver != digest_ver_sent:
                 hb["digest"] = backend.digest(digest_max)
                 digest_ver_sent = ver
-            chan.send(hb, timeout=send_t)
+            _send(hb)
             if telem is not None:
                 telem.write_snapshot(snap_path)
 
@@ -1574,20 +1895,40 @@ def main(argv: list[str]) -> int:
 
         listener = SocketListener(listen)
         logger.info(f"replica: listening on {listener.bound_address}")
+        # ONE daemon state across every router connection: in-flight
+        # decode continues through a router outage (offline_tick between
+        # accepts), streams re-attach on resync/re_adopt, and the orphan
+        # deadline bounds work no restarted router ever collects
+        state = DaemonState(cfg)
+        backoff = AcceptBackoff(
+            base_s=float(cfg.get("accept_backoff_base_s", 0.05)),
+            max_s=float(cfg.get("accept_backoff_max_s", 2.0)),
+            seed=int(cfg.get("seed", 0) or 0)
+            ^ int(cfg.get("replica_id", 0) or 0))
         try:
             while True:
-                chan = listener.accept_channel(timeout=1.0)
+                # the accept's select IS the idle sleep: a busy daemon
+                # polls fast so decode keeps moving, an idle one backs
+                # off (seeded exponential + jitter, capped) instead of
+                # spinning on accept timeouts while the router is down
+                timeout = 0.001 if state.backend.has_work() \
+                    else backoff.next()
+                chan = listener.accept_channel(timeout=timeout)
                 if chan is None:
+                    state.offline_tick()
                     continue
+                backoff.reset()
                 try:
-                    rc = serve(cfg, chan)
+                    rc = serve(cfg, chan, state)
                 except (ChannelClosed, ChannelTimeout) as e:
                     logger.warning(f"replica: router lost ({e}); "
                                    f"accepting again")
+                    state.on_disconnect()
                     rc = None
                 finally:
                     chan.close()
                 if rc == 0:
+                    _cleanup_shm(state.ring, state.readers)
                     return 0             # explicit shutdown message
         except KeyboardInterrupt:
             return 0

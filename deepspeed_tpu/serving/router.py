@@ -1,9 +1,14 @@
-"""The stateless serving router: admission, placement, failover.
+"""The serving router: admission, placement, failover — crash-safe.
 
 One router process fronts N replica workers (fleet.py). Requests are
-replayable records (protocol.py); the router owns nothing durable — its
-whole state is reconstructible from the records in flight, which is what
-makes failover "resend the record and dedup by trace ID".
+replayable records (protocol.py); the router owns nothing durable by
+default — its whole state is reconstructible from the records in
+flight, which is what makes failover "resend the record and dedup by
+trace ID". With ``RouterConfig.journal_dir`` set, the state is ALSO
+durable: every transition write-ahead-journals (serving/journal.py) and
+a restarted router replays the journal and re-adopts the fleet's
+in-flight work via the ``resync`` exchange — the router itself stops
+being a single point of failure.
 
 The control loop (:meth:`Router.poll`) is single-threaded and every wait
 in it is bounded (bin/check_deadlines.py lints the package): one
@@ -47,9 +52,11 @@ from ..telemetry import LATENCY_BUCKETS_S, get_telemetry, configure as \
 from ..telemetry.reqtrace import (TENANT_CARDINALITY_CAP,
                                   TENANT_OVERFLOW_LABEL)
 from ..inference.migration import version_skew
+from ..runtime.resilience import FaultInjector
 from ..utils.logging import logger
 from .deploy import DeployConfig, DeployError, DeployManager, \
     verify_deploy_target
+from .journal import Journal, OPEN, reduce_router_records
 from .disagg import (DECODE_CAPABLE, MigrationState, PREFILL_CAPABLE,
                      RebalancePolicy, ScaleAdvisor, role_of)
 from .fleet import DRAINING, Fleet, FleetConfig, QUARANTINED, READY
@@ -60,6 +67,9 @@ from .protocol import ChannelClosed, RequestRecord, poll_channels
 #: terminal request states
 DONE, FAILED, SHED = "done", "failed", "shed"
 QUEUED, ASSIGNED = "queued", "assigned"
+#: journal-recovered, waiting for a replica to claim it via resync
+#: (bounded by ``resync_hold_s``, then it requeues and replays)
+RECOVERING = "recovering"
 
 
 class AdmissionError(RuntimeError):
@@ -154,6 +164,31 @@ class RouterConfig:
     #: robust z-score past which a replica's latency distributions mark
     #: it degraded (straggler detection — signals only)
     straggler_z: float = 3.0
+    #: crash-safe control plane (serving/journal.py): a directory here
+    #: write-ahead-journals every router state transition (admits,
+    #: placements, committed-chunk progress, terminals, deploy phases)
+    #: and a restarted Router over the SAME directory replays it,
+    #: re-dials daemon replicas, and re-adopts their in-flight work via
+    #: the ``resync`` exchange. None (the default) = journaling off:
+    #: behavior identical to the stateless router.
+    journal_dir: str | None = None
+    #: journal durability vs a HOST crash ("always" | "interval" |
+    #: "none"); a SIGKILL'd router process loses nothing under any mode
+    #: (records are written unbuffered)
+    journal_fsync: str = "interval"
+    journal_fsync_interval_s: float = 0.2
+    journal_segment_bytes: int = 4 << 20
+    #: how long recovered in-flight requests wait for a replica to claim
+    #: them via resync (extended on each replica ready) before falling
+    #: back to the ordinary retry-with-replay path
+    resync_hold_s: float = 3.0
+    #: deterministic router-side chaos (runtime/resilience.py
+    #: FaultInjector, always HARD — a real no-unwind os._exit):
+    #: router_crash_after_admit / router_crash_after_place /
+    #: router_crash_before_relay_ack / router_crash_mid_kv_pull /
+    #: router_crash_mid_deploy_canary, count-based like the replica
+    #: points — the journal chaos matrix drives these
+    faults: dict = field(default_factory=dict)
 
 
 @dataclass
@@ -193,6 +228,11 @@ class _Req:
     #: request whose slot is not ready stays queued (its submitter's
     #: deadline — the deploy probe timeout — bounds the wait)
     pin_slot: int = -1
+    #: rebuilt from the journal by a restarted router incarnation
+    recovered: bool = False
+    #: claimed by a replica through the resync exchange (its stream
+    #: re-attached without replay)
+    readopted: bool = False
 
 
 class Router:
@@ -269,6 +309,294 @@ class Router:
         self._bb_pending: dict[str, tuple[float, dict]] = {}
         self._seen_breaker_opens = 0
         self._last_straggler_gauges = 0.0
+        # crash-safe control plane (serving/journal.py): deterministic
+        # router-side fault points are HARD — an injected crash is a
+        # real no-unwind process death, exactly what the journal exists
+        # to survive
+        self._inj = FaultInjector(spec=dict(self.cfg.faults or {}),
+                                  env="", hard=True)
+        self._journal: Journal | None = None
+        self._recovering = False
+        self._resync_until = 0.0
+        self._recovered_deploy: dict | None = None
+        self._jdeploy_key = None
+        self._journal_deploy_last: dict | None = None
+        self._jbytes_seen = 0
+        #: a deploy record (any outcome) exists in the journal — the CLI
+        #: uses this to not re-start a deploy recovery already owns
+        self.journal_saw_deploy = False
+        self._boots = 1
+        self.recovered = 0
+        self.readopted = 0
+        self.resync_orphans = 0
+        #: restart -> first committed chunk of a re-adopted stream (the
+        #: bench scorecard's recovery-time headline); None until observed
+        self.recovery_first_chunk_s: float | None = None
+        self._recover_t0 = time.monotonic()
+        if self.cfg.journal_dir:
+            self._open_journal()
+
+    # -- crash safety: journal + recovery (serving/journal.py) -----------
+    def _open_journal(self) -> None:
+        t0 = time.perf_counter()
+        self._journal = Journal(
+            self.cfg.journal_dir, fsync=self.cfg.journal_fsync,
+            fsync_interval_s=self.cfg.journal_fsync_interval_s,
+            segment_bytes=self.cfg.journal_segment_bytes)
+        state = reduce_router_records(self._journal.replay())
+        self._journal.snapshot_fn = self._journal_snapshot
+        self.journal_saw_deploy = state.saw_deploy
+        self._recovered_deploy = state.deploy
+        bs = self._fleet_block_size()
+        for tid, r in state.reqs.items():
+            req = _Req(rec=r.rec,
+                       chain=chain_hashes(r.rec.prompt[:-1], bs)
+                       if bs else [],
+                       status=RECOVERING, committed=list(r.committed),
+                       attempt=r.attempt, retries=r.retries,
+                       submit_t=time.monotonic(), recovered=True)
+            if r.status != OPEN:
+                req.status = {"done": DONE, "failed": FAILED,
+                              "shed": SHED}.get(r.status, FAILED)
+                req.reason = r.reason
+                req.result = r.result
+            else:
+                req.last_activity_t = time.monotonic()
+                self._tenant_live[r.rec.tenant] = \
+                    self._tenant_live.get(r.rec.tenant, 0) + 1
+            self._reqs[tid] = req
+        self.recovered = sum(1 for q in self._reqs.values()
+                             if q.status == RECOVERING)
+        self._recovering = self.recovered > 0 \
+            or self._recovered_deploy is not None
+        self._resync_until = time.monotonic() + self.cfg.resync_hold_s
+        self._boots = state.boots + 1
+        self._jrec("boot", {"gen": self._boots,
+                            "ts": round(time.time(), 3)}, critical=True)
+        replay_s = time.perf_counter() - t0
+        if state.boots:
+            logger.warning(
+                f"router: recovered journal {self.cfg.journal_dir} "
+                f"(incarnation {state.boots + 1}): {self.recovered} "
+                f"in-flight request(s), deploy "
+                f"{'in flight' if self._recovered_deploy else 'settled'},"
+                f" replay {replay_s * 1e3:.1f}ms, "
+                f"{self._journal.bad_records} torn record(s) skipped")
+        if self._telem.enabled:
+            if state.boots:
+                self._telem.registry.counter(
+                    "serving_router_recoveries_total",
+                    help="router incarnations that recovered prior "
+                         "state from the write-ahead journal").inc()
+            self._telem.registry.gauge(
+                "serving_router_journal_replay_s",
+                help="journal replay duration at the last router "
+                     "boot").set(round(replay_s, 6))
+            self._telem.registry.gauge(
+                "serving_router_recovered_requests",
+                help="non-terminal requests rebuilt from the journal at "
+                     "the last router boot").set(self.recovered)
+
+    def _journal_snapshot(self) -> dict:
+        """Compaction snapshot written at segment rotation: every
+        non-terminal request (full replayable record + committed prefix
+        + nonce), TERMINAL results (id + status + tokens — what keeps
+        duplicate re-submission dedup and ``result()`` fidelity across a
+        compaction; no larger than what ``_reqs`` already retains in
+        memory), the deploy state, and the incarnation count — everything
+        an older segment could have said that still matters."""
+        reqs, terms = [], []
+        for tid, r in self._reqs.items():
+            if r.status in (DONE, FAILED, SHED):
+                e = {"id": tid, "status": r.status,
+                     "tenant": r.rec.tenant, "prio": r.rec.priority}
+                if r.reason:
+                    e["reason"] = r.reason
+                if r.status == DONE and r.result is not None:
+                    e["toks"] = list(r.result)
+                terms.append(e)
+                continue
+            w = r.rec.to_wire()
+            reqs.append({"id": tid, "prompt": w["prompt"],
+                         "max_new": w["max_new"], "eos": w["eos"],
+                         "tenant": w["tenant"], "prio": r.rec.priority,
+                         "committed": list(r.committed),
+                         "a": r.attempt, "retries": r.retries})
+        if self._deploy is not None and self._deploy.active:
+            dep = self._journal_deploy_last
+        else:
+            # a recovered deploy still awaiting its rollback must
+            # survive a compaction that races the recovery window
+            dep = self._recovered_deploy
+        return {"reqs": reqs, "terms": terms, "deploy": dep,
+                "saw_deploy": self.journal_saw_deploy,
+                "boots": self._boots}
+
+    def _jrec(self, kind: str, data: dict,
+              critical: bool = False) -> None:
+        if self._journal is None:
+            return
+        self._journal.append(kind, data, critical=critical)
+        if self._telem.enabled:
+            self._telem.registry.counter(
+                "serving_router_journal_records_total",
+                labels={"kind": sanitize_label_value(kind)},
+                help="write-ahead journal records appended, by "
+                     "kind").inc()
+            delta = self._journal.bytes_appended - self._jbytes_seen
+            self._jbytes_seen = self._journal.bytes_appended
+            self._telem.registry.counter(
+                "serving_router_journal_bytes_total",
+                help="write-ahead journal bytes appended").inc(delta)
+
+    def journal_stats(self) -> dict | None:
+        """Journal counters for scorecards/results, or None when off."""
+        return self._journal.stats() if self._journal is not None \
+            else None
+
+    def _tick_recovery(self, now: float) -> None:
+        """Recovery settlement: requests a resync claimed are already
+        streaming; once the hold expires (it extends on every replica
+        ready), everything still unclaimed falls back to the ordinary
+        retry-with-replay path — fresh nonces dedup any late deliveries
+        from un-adopted copies — and a journaled in-flight deploy
+        resolves deterministically (rollback)."""
+        if not self._recovering:
+            return
+        open_recs = [tid for tid, r in self._reqs.items()
+                     if r.status == RECOVERING]
+        if now < self._resync_until \
+                and (open_recs or self._recovered_deploy is not None):
+            return
+        for tid in open_recs:
+            req = self._reqs[tid]
+            req.status = QUEUED
+            req.attempt += 1     # invalidate any un-adopted copy's stream
+            self._queues.setdefault(req.rec.priority,
+                                    deque()).append(tid)
+            self._jrec("requeue", {"id": tid, "a": req.attempt,
+                                   "reason": "resync_orphan"})
+            self.resync_orphans += 1
+            logger.warning(f"router: recovered request {tid} unclaimed "
+                           f"by resync; replaying from scratch")
+            if self._telem.enabled:
+                self._telem.registry.counter(
+                    "serving_router_resync_orphans_total",
+                    help="journal-recovered requests no replica claimed "
+                         "within the resync hold (fell back to "
+                         "retry-with-replay)").inc()
+        self._rollback_recovered_deploy()
+        self._recovering = False
+
+    def _rollback_recovered_deploy(self) -> None:
+        """A deploy was journaled in flight when the router died. The
+        deterministic resolution is ROLLBACK: every resynced replica
+        serving the half-deployed version swaps back to the journaled
+        rollback target (the fleet template never advanced — it commits
+        only at convergence — so restarts already load the old
+        version)."""
+        dep = self._recovered_deploy
+        self._recovered_deploy = None
+        if dep is None:
+            return
+        wid = int(dep.get("wid", 0))
+        prev = dep.get("prev") or {}
+        rolled = 0
+        for h in self.fleet.ready():
+            if int((h.wv or {}).get("id", -1)) == wid:
+                h.send({"t": "swap", "wid": int(prev.get("wid", 0)),
+                        "ckpt": prev.get("ckpt"),
+                        "tag": prev.get("tag")})
+                rolled += 1
+        self.deploys["rolled_back"] = \
+            self.deploys.get("rolled_back", 0) + 1
+        self._jrec("deploy", {"wid": wid, "phase": "rollback",
+                              "outcome": "rolled_back",
+                              "reason": "router_crash",
+                              "prev": dict(prev)}, critical=True)
+        logger.warning(f"router: deploy to v{wid} was in flight at the "
+                       f"crash (journaled phase {dep.get('phase')}); "
+                       f"rolled {rolled} replica(s) back to "
+                       f"v{prev.get('wid', 0)}")
+        if self._telem.enabled:
+            self._telem.registry.counter(
+                "serving_router_deploys_total",
+                labels={"outcome": "rolled_back"},
+                help="rolling weight deploys by terminal outcome "
+                     "(ok | rolled_back | aborted)").inc()
+
+    def _journal_deploy_tick(self) -> None:
+        """Journal deploy phase transitions (one record per change, so
+        recovery knows exactly how far the roll got)."""
+        if self._journal is None or self._deploy is None:
+            return
+        dep = self._deploy
+        key = (dep.wid, dep.phase, dep.outcome)
+        if key == self._jdeploy_key:
+            return
+        self._jdeploy_key = key
+        self.journal_saw_deploy = True
+        payload = {"wid": dep.wid, "phase": dep.phase,
+                   "outcome": dep.outcome, "reason": dep.reason,
+                   "ckpt": dep.ckpt, "tag": dep.tag,
+                   "prev": dict(dep.prev)}
+        self._journal_deploy_last = payload
+        self._jrec("deploy", payload, critical=True)
+
+    def _on_resync(self, h, msg: dict) -> None:
+        """A replica answered resync with its inventory: re-adopt every
+        recovered request it still holds (greedily — the first reporter
+        wins, and greedy determinism makes any claimant's continued
+        stream identical), tell it to flush whatever this router does
+        not know or already re-placed, and fold the shipped
+        digest/role/version into the handle like a heartbeat would."""
+        if "digest" in msg:
+            d = msg["digest"]
+            h.digest = set(d) if d else None
+        h.role = str(msg.get("role", h.role))
+        if "wv" in msg:
+            self._note_wv(h, msg.get("wv"))
+        now = time.monotonic()
+        for e in msg.get("reqs") or ():
+            tid = str(e.get("id"))
+            req = self._reqs.get(tid)
+            if req is None or req.status in (DONE, FAILED, SHED) \
+                    or (req.status == ASSIGNED
+                        and req.assigned_slot != h.slot):
+                # unknown here, already terminal, or re-placed elsewhere
+                # — nobody will ever collect that copy: flush it
+                h.send({"t": "flush", "id": tid})
+                continue
+            if req.status == ASSIGNED:
+                continue             # already re-adopted on this slot
+            if req.status == QUEUED:
+                for q in self._queues.values():
+                    if tid in q:
+                        q.remove(tid)
+                        break
+            req.attempt += 1
+            req.status = ASSIGNED
+            req.assigned_slot = h.slot
+            req.assigned_epoch = h.epoch
+            req.assign_t = req.last_activity_t = now
+            req.readopted = True
+            req.placed.append(h.slot)
+            self._assigned_n[h.slot] = \
+                self._assigned_n.get(h.slot, 0) + 1
+            self._jrec("place", {"id": tid, "slot": h.slot,
+                                 "epoch": h.epoch, "a": req.attempt,
+                                 "via": "readopt"})
+            h.send({"t": "re_adopt", "id": tid, "a": req.attempt,
+                    "have": len(req.committed)})
+            self.readopted += 1
+            self._fev(tid, "readopt", slot=h.slot,
+                      have=len(req.committed))
+            if self._telem.enabled:
+                self._telem.registry.counter(
+                    "serving_router_readopted_total",
+                    help="recovered requests a replica claimed through "
+                         "the resync exchange (streams re-attached "
+                         "without replay)").inc()
 
     # -- lifecycle -------------------------------------------------------
     def start(self, min_ready: int = 1) -> None:
@@ -286,6 +614,19 @@ class Router:
 
     def close(self) -> None:
         self.fleet.shutdown()
+        if self._journal is not None:
+            self._journal.close()
+
+    def abandon(self) -> None:
+        """Chaos/bench hook: the in-process emulation of a router crash.
+        Every fleet channel drops with NO shutdown message, NO replica
+        kill and NO journal flush — ``--listen`` daemon slots observe a
+        disconnect and keep decoding (buffering for resync), pipe
+        children exit on their closed pipes. This Router object is dead
+        afterwards; build a new one over the same ``journal_dir`` to
+        recover."""
+        self.fleet.abandon()
+        self._journal = None             # deliberately not closed/flushed
 
     def __enter__(self) -> "Router":
         self.start()
@@ -359,6 +700,13 @@ class Router:
         self._reqs[tid] = req
         self._queues.setdefault(rec.priority, deque()).append(tid)
         self._tenant_live[tenant] = self._tenant_live.get(tenant, 0) + 1
+        self._jrec("admit", {"id": tid, "prompt": rec.prompt,
+                             "max_new": rec.max_new_tokens,
+                             "eos": rec.eos_token_id, "tenant": tenant,
+                             "prio": rec.priority}, critical=True)
+        if self._inj.countdown("router_crash_after_admit"):
+            self._inj.crash_now("router_crash_after_admit",
+                                f"admit of {tid}")
         self._fev(tid, "enqueue", tenant=tenant, prompt=len(rec.prompt),
                   priority=int(priority))
         if self._telem.enabled:
@@ -464,9 +812,16 @@ class Router:
                 self._last_straggler_gauges = now
                 self._update_straggler_gauges()
         if self._deploy is not None and self._deploy.active:
+            if self._deploy.phase in ("canary_probe", "canary_soak") \
+                    and self._inj.countdown(
+                        "router_crash_mid_deploy_canary"):
+                self._inj.crash_now("router_crash_mid_deploy_canary",
+                                    f"deploy v{self._deploy.wid} canary")
             # the rolling-deploy state machine: deadline checks + the
             # next swap/probe/rollback action, one bounded step per tick
             self._deploy.tick(now)
+            self._journal_deploy_tick()
+        self._tick_recovery(now)
         self._dispatch(now)
         # per-role autoscale hints: signals only (gauges), no actuator
         self._scale.update(
@@ -487,11 +842,11 @@ class Router:
         loop is bounded NO MATTER WHAT the fleet does). Returns
         :meth:`results`."""
         deadline = time.monotonic() + deadline_s
-        while any(r.status in (QUEUED, ASSIGNED)
+        while any(r.status in (QUEUED, ASSIGNED, RECOVERING)
                   for r in self._reqs.values()):
             if time.monotonic() >= deadline:
                 for tid, r in list(self._reqs.items()):
-                    if r.status in (QUEUED, ASSIGNED):
+                    if r.status in (QUEUED, ASSIGNED, RECOVERING):
                         self._terminate(tid, FAILED, "router_deadline")
                 break
             self.poll()
@@ -522,6 +877,8 @@ class Router:
                for r in self.fleet.replicas])
         self._deploy = DeployManager(self, os.path.abspath(ckpt), rtag,
                                      wid, digest, cfg or DeployConfig())
+        self._jdeploy_key = None
+        self._journal_deploy_tick()      # the deploy is now journaled
         return self._deploy.status()
 
     def deploy(self, ckpt: str, tag: str | None = None,
@@ -548,6 +905,7 @@ class Router:
         """DeployManager callback at terminal transition: outcome
         counters + the fleet-target version gauge."""
         self.deploys[dep.outcome] = self.deploys.get(dep.outcome, 0) + 1
+        self._journal_deploy_tick()      # the terminal outcome is durable
         if self._ftrace is not None and dep.outcome != "ok":
             self._blackbox({"kind": "deploy_" + dep.outcome,
                             "reason": dep.reason})
@@ -597,6 +955,18 @@ class Router:
         if t == "ready":
             self.fleet.on_ready(h, msg)
             self._note_wv(h, msg.get("wv"))
+            if self._journal is not None:
+                # crash-safe control plane: ask what this incarnation
+                # still holds (re-adoption); a fresh replica answers
+                # with an empty inventory, so this is cheap when there
+                # is nothing to recover
+                h.send({"t": "resync"})
+                if self._recovering:
+                    self._resync_until = max(
+                        self._resync_until,
+                        time.monotonic() + self.cfg.resync_hold_s)
+        elif t == "resync_ok":
+            self._on_resync(h, msg)
         elif t == "hb":
             h.load = msg.get("load")
             if "digest" in msg:
@@ -660,6 +1030,12 @@ class Router:
                     return
             req.result = toks
             req.done_t = now
+            if req.readopted and self.recovery_first_chunk_s is None:
+                # the whole stream finished during the outage: the
+                # re-sent authoritative done IS the first re-attached
+                # delivery
+                self.recovery_first_chunk_s = round(
+                    now - self._recover_t0, 6)
             if req.first_tok_t == 0.0 and toks:
                 req.first_tok_t = now
             self._observe_latency(req)
@@ -737,6 +1113,13 @@ class Router:
                     help="submit -> assignment dispatch").observe(
                     req.assign_t - req.submit_t)
         req.committed.extend(new)
+        self._jrec("prog", {"id": req.rec.trace_id, "off": have,
+                            "toks": new})
+        if req.readopted and self.recovery_first_chunk_s is None:
+            # the recovery headline: restart -> first chunk of a stream
+            # that re-attached without replay
+            self.recovery_first_chunk_s = round(
+                now - self._recover_t0, 6)
         self._note_commit(now, len(new))
 
     def _note_mismatch(self, req: _Req) -> None:
@@ -887,6 +1270,12 @@ class Router:
             if mig is None or mig.phase != "xfer" \
                     or h.slot != req.assigned_slot:
                 return
+            if self._inj.countdown("router_crash_before_relay_ack"):
+                # the source stays pinned-until-ack: recovery must
+                # settle it (resync re-adopts exactly one copy, the
+                # orphan deadline flushes the other)
+                self._inj.crash_now("router_crash_before_relay_ack",
+                                    f"handoff ack of {tid}")
             # importer owns the stream now; tell the source to release
             # its pinned pages (best effort — a source that died after
             # the export costs nothing, the bundle already landed)
@@ -972,6 +1361,9 @@ class Router:
         req.placed.append(rep.slot)
         self._assigned_n[rep.slot] = self._assigned_n.get(rep.slot, 0) + 1
         self._sticky.note(chain, rep.slot)
+        self._jrec("place", {"id": tid, "slot": rep.slot,
+                             "epoch": rep.epoch, "a": req.attempt,
+                             "via": "relay"})
         mig.phase = "xfer"
         mig.tgt_slot = rep.slot
         mig.recv_done_t = time.monotonic()
@@ -1110,6 +1502,8 @@ class Router:
             return
         req.retries += 1
         req.status = QUEUED
+        self._jrec("requeue", {"id": tid, "a": req.attempt,
+                               "reason": reason})
         self._fev(tid, "retry", reason=reason, retries=req.retries)
         # replay jumps the line: the request already waited its turn once
         self._queues.setdefault(req.rec.priority, deque()).appendleft(tid)
@@ -1259,7 +1653,7 @@ class Router:
                   "attempt": rq.attempt, "retries": rq.retries,
                   "migrating": rq.mig is not None}
             for tid, rq in self._reqs.items()
-            if rq.status in (QUEUED, ASSIGNED)}
+            if rq.status in (QUEUED, ASSIGNED, RECOVERING)}
         return {
             "replicas": reps,
             "assignments": assignments,
@@ -1428,11 +1822,21 @@ class Router:
                       role_fallback=role_fallback,
                       pull_slot=pull_peer.slot
                       if pull_peer is not None else None)
+            # WAL discipline: the placement is journaled BEFORE the put
+            # goes out — a crash in between leaves a journaled
+            # assignment nobody holds, which resync simply never claims
+            # (it requeues at the hold expiry)
+            self._jrec("place", {"id": tid, "slot": rep.slot,
+                                 "epoch": rep.epoch, "a": req.attempt,
+                                 "via": "dispatch"})
             if not rep.send(wire):
                 # send failed: the slot is toast; requeue and let
                 # maintain() reap it next tick
                 self._retry_or_fail(req, "send_failed")
                 return
+            if self._inj.countdown("router_crash_after_place"):
+                self._inj.crash_now("router_crash_after_place",
+                                    f"placement of {tid}")
             if pull_peer is not None:
                 self._start_pull(req, rep, pull_peer, peer_pages, now)
             if self._telem.enabled:
@@ -1519,6 +1923,12 @@ class Router:
         self._fev(tid, "pull_start", src_slot=peer.slot,
                   tgt_slot=rep.slot, pages=pages)
         self.kv_pulls += 1
+        if self._inj.countdown("router_crash_mid_kv_pull"):
+            # the pull can never complete without this relay: the
+            # puller's local deadline admits the held put and recomputes
+            # (the always-safe fallback), then resync re-adopts it
+            self._inj.crash_now("router_crash_mid_kv_pull",
+                                f"pull for {tid}")
         if self._telem.enabled:
             self._telem.registry.counter(
                 "serving_router_kv_pulls_total",
@@ -1811,6 +2221,12 @@ class Router:
         self._unassign(req)
         req.status = status
         req.reason = reason
+        jdata: dict = {"id": tid, "status": status}
+        if reason:
+            jdata["reason"] = reason
+        if status == DONE and req.result is not None:
+            jdata["toks"] = req.result
+        self._jrec("term", jdata, critical=True)
         self._fev(tid, status, reason=reason,
                   tokens=len(req.result) if req.result is not None
                   else len(req.committed))
@@ -1885,7 +2301,7 @@ class Router:
         self._draining = True
         deadline = time.monotonic() + deadline_s
         drain_sent = False
-        while any(r.status in (QUEUED, ASSIGNED)
+        while any(r.status in (QUEUED, ASSIGNED, RECOVERING)
                   for r in self._reqs.values()):
             if not drain_sent and not any(
                     r.status == QUEUED for r in self._reqs.values()):
@@ -1894,7 +2310,7 @@ class Router:
                 drain_sent = True
             if time.monotonic() >= deadline:
                 for tid, r in list(self._reqs.items()):
-                    if r.status in (QUEUED, ASSIGNED):
+                    if r.status in (QUEUED, ASSIGNED, RECOVERING):
                         self._terminate(tid, FAILED, "drain_timeout")
                 return False
             self.poll()
@@ -1902,3 +2318,127 @@ class Router:
             for rep in self.fleet.ready():
                 rep.send({"t": "drain"})
         return True
+
+
+def main(argv: list[str]) -> int:
+    """``python -m deepspeed_tpu.serving.router [--journal DIR] <cfg>``
+
+    The operational entry point the chaos matrix SIGKILLs: build a
+    Router from a JSON config (inline, or ``@path`` to a file), submit
+    its request waves, optionally start a deploy, run everything to a
+    terminal state and write a results JSON. Re-running the SAME command
+    over the same ``--journal`` directory IS the recovery path:
+    already-journaled admits are skipped (duplicate trace IDs), the
+    restarted router re-dials the fleet and re-adopts in-flight work via
+    resync, and a journaled in-flight deploy resolves deterministically.
+
+    Config keys::
+
+        router         RouterConfig fields; "fleet" nests FleetConfig
+        waves          [[request, ...], ...]: each request has
+                       {"prompt": [int], "trace_id": str,
+                        "max_new_tokens": int, "tenant": str,
+                        "eos_token_id": int|null, "priority": int};
+                       run() drives each wave to completion
+        poll_every     poll N times after each submit (staggers
+                       placement so crash points land mid-stream)
+        deploy         {"ckpt": str, "tag": str|null} started after the
+                       first wave's submits — skipped on recovery when
+                       the journal already carries a deploy
+        min_ready / run_deadline_s / results (output JSON path)
+    """
+    import json as _json
+
+    args = list(argv[1:])
+    journal = None
+    if args and args[0] == "--journal":
+        if len(args) < 2:
+            raise SystemExit(
+                "usage: python -m deepspeed_tpu.serving.router "
+                "[--journal DIR] <cfg json | @cfg-file>")
+        journal = args[1]
+        args = args[2:]
+    raw = args[0] if args else "{}"
+    if raw.startswith("@"):
+        with open(raw[1:], encoding="utf-8") as f:
+            raw = f.read()
+    cfg = _json.loads(raw)
+    rkw = dict(cfg.get("router") or {})
+    fkw = dict(rkw.pop("fleet", {}) or {})
+    rcfg = RouterConfig(fleet=FleetConfig(**fkw), **rkw)
+    if journal:
+        rcfg.journal_dir = journal
+    router = Router(rcfg)
+    deadline_s = float(cfg.get("run_deadline_s", 120.0))
+    poll_every = int(cfg.get("poll_every", 0))
+    out: dict = {}
+    try:
+        router.start(min_ready=int(cfg.get("min_ready", 1)))
+        waves = cfg.get("waves") or []
+        if cfg.get("requests"):
+            waves = [cfg["requests"]] + list(waves)
+        for wi, wave in enumerate(waves):
+            for r in wave:
+                try:
+                    router.submit(
+                        [int(x) for x in r["prompt"]],
+                        tenant=str(r.get("tenant", "default")),
+                        max_new_tokens=int(r.get("max_new_tokens", 16)),
+                        eos_token_id=r.get("eos_token_id"),
+                        priority=int(r.get("priority", 0)),
+                        trace_id=r.get("trace_id"))
+                except ValueError:
+                    pass             # journal-recovered: already owned
+                except AdmissionError:
+                    pass             # structured shed: lands in results
+                for _ in range(poll_every):
+                    router.poll()
+            if wi == 0 and cfg.get("deploy") \
+                    and not router.journal_saw_deploy:
+                router.start_deploy(cfg["deploy"]["ckpt"],
+                                    cfg["deploy"].get("tag"))
+            router.run(deadline_s=deadline_s)
+            for _ in range(int(cfg.get("inter_wave_polls", 0))):
+                router.poll()            # e.g. let digests land
+        dep_deadline = time.monotonic() + deadline_s
+        while router._deploy is not None and router._deploy.active:
+            if time.monotonic() >= dep_deadline:
+                break
+            router.poll()
+        for _ in range(int(cfg.get("settle_polls", 0))):
+            router.poll()                # e.g. let rollback wvs land
+        out = {
+            "results": router.results(),
+            "double_commits": router.double_commits,
+            "replay_mismatches": router.replay_mismatches,
+            "stale_msgs": router.stale_msgs,
+            "recovered": router.recovered,
+            "readopted": router.readopted,
+            "resync_orphans": router.resync_orphans,
+            "recovery_first_chunk_s": router.recovery_first_chunk_s,
+            "deploys": dict(router.deploys),
+            "deploy_status": router.deploy_status(),
+            "fleet_wv": {str(h.slot): h.wv
+                         for h in router.fleet.replicas},
+            "journal": router.journal_stats(),
+        }
+    finally:
+        path = cfg.get("results")
+        if path:
+            tmp = path + ".tmp"
+            with open(tmp, "w", encoding="utf-8") as f:
+                _json.dump(out, f)
+            os.replace(tmp, path)
+        if cfg.get("leave_fleet"):
+            # drop the channels but keep daemon replicas running —
+            # multi-incarnation harnesses reuse the fleet
+            router.abandon()
+        else:
+            router.close()
+    return 0
+
+
+if __name__ == "__main__":
+    import sys
+
+    sys.exit(main(sys.argv))

@@ -60,6 +60,9 @@ HEAVY = [
     # distributed prefix cache: the engine-pair prefix-pull parity test
     # compiles two tiny engines
     "test_kv_pull.py",
+    # crash-safe router: the engine-daemon crash-recovery test runs
+    # THREE router incarnations over two daemon engines (each compiles)
+    "test_journal.py",
 ]
 
 

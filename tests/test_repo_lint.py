@@ -32,6 +32,7 @@ metric_lint = _load("check_metric_names")
 state_lint = _load("check_state_invariants")
 reqtrace_lint = _load("check_reqtrace_events")
 deadline_lint = _load("check_deadlines")
+protocol_lint = _load("check_protocol_msgs")
 
 
 def test_repo_has_no_import_time_device_probes():
@@ -421,6 +422,69 @@ def test_deadline_detector_honors_allowlist(tmp_path):
 def test_deadline_lint_requires_the_serving_package():
     out = deadline_lint.check_repo("/nonexistent")
     assert len(out) == 1 and "missing" in out[0]
+
+
+def test_deadline_lint_covers_journal_waits(tmp_path):
+    """serving/journal.py is inside the linted package: the write-ahead
+    log is on the router's poll path, so an unbounded wait smuggled into
+    it (a blocking lock around fsync, a bare select) would hang the
+    whole control plane — it is flagged like anywhere else in
+    serving/."""
+    serving = tmp_path / "deepspeed_tpu" / "serving"
+    serving.mkdir(parents=True)
+    (serving / "journal.py").write_text(
+        "def append(lock, rec):\n"
+        "    lock.acquire()\n"                     # flagged: unbounded
+        "    lock.acquire(timeout=1.0)\n")         # bounded: ok
+    out = deadline_lint.check_repo(str(tmp_path))
+    assert len(out) == 1 and ":2:" in out[0]
+
+
+def test_serving_protocol_vocabulary_is_closed():
+    """Every literal {"t": ...} message sent in serving/ has a receiver
+    dispatch branch and vice versa (bin/check_protocol_msgs.py) — the
+    resync vocabulary must not rot silently."""
+    violations = protocol_lint.check_repo(ROOT)
+    assert violations == [], "\n".join(violations)
+
+
+def test_protocol_detector_flags_dark_sends_and_phantom_handlers(
+        tmp_path):
+    serving = tmp_path / "deepspeed_tpu" / "serving"
+    serving.mkdir(parents=True)
+    (serving / "a.py").write_text(
+        "def send(ch, msg, t):\n"
+        "    ch.send({'t': 'ping'})\n"             # sent + handled: ok
+        "    ch.send({'t': 'orphaned'})\n"         # dark send: flagged
+        "    if t == 'ping':\n"
+        "        pass\n"
+        "    elif t in ('phantom', 'ping'):\n"     # phantom: flagged
+        "        pass\n"
+        "    if msg['t'] == 'ping':\n"
+        "        pass\n")
+    out = protocol_lint.check_repo(str(tmp_path))
+    assert len(out) == 2, "\n".join(out)
+    assert any("'orphaned'" in v and "void" in v for v in out), out
+    assert any("'phantom'" in v and "dead" in v for v in out), out
+
+
+def test_protocol_detector_recognizes_every_tag_idiom(tmp_path):
+    """All three dispatch shapes count as handling — bare ``t``,
+    ``msg["t"]``, ``msg.get("t")`` — and non-tag compares (phases,
+    kinds) contribute nothing."""
+    serving = tmp_path / "deepspeed_tpu" / "serving"
+    serving.mkdir(parents=True)
+    (serving / "b.py").write_text(
+        "def recv(msg, t, phase):\n"
+        "    a = {'t': 'x1'}\n"
+        "    b = {'t': 'x2'}\n"
+        "    c = {'t': 'x3'}\n"
+        "    if t == 'x1': pass\n"
+        "    if msg['t'] == 'x2': pass\n"
+        "    if msg.get('t') == 'x3': pass\n"
+        "    if phase == 'xfer': pass\n"           # not a tag compare
+        "    return a, b, c\n")
+    assert protocol_lint.check_repo(str(tmp_path)) == []
 
 
 def test_deadline_lint_covers_deploy_waits(tmp_path):

@@ -152,6 +152,28 @@ def test_sticky_map_biases_and_forgets():
     assert pick_replica([a2, b], chain, sticky)[0] is a2
 
 
+def test_sticky_lookup_honors_candidate_slots():
+    """A deeper sticky entry pointing at an INELIGIBLE slot must not
+    shadow a shallower eligible one — the handoff-relay case: the
+    request's own dispatch noted its full prompt chain at the
+    prefill-role replica (one page deeper than the tenant's shared
+    prefix), and a relay restricted to decode-capable candidates used
+    to discard the sticky signal entirely, splitting same-tenant
+    bundles across decode replicas on lagging load estimates."""
+    chain = chain_hashes(list(range(80)), 16)          # 5 pages
+    sticky = StickyMap()
+    sticky.note(chain[:4], slot=1)       # tenant prefix -> decode slot
+    sticky.note([chain[4]], slot=0)      # own full chain -> prefill slot
+    assert sticky.lookup(chain) == (0, 5)
+    assert sticky.lookup(chain, {1, 2}) == (1, 4)
+    assert sticky.lookup(chain, {2}) is None
+    # pick_replica routes through the restricted walk: slot 1 wins even
+    # though the deepest raw entry names the non-candidate slot 0
+    a, b = _Cand(1, None, {"live": 5}), _Cand(2, None, {"live": 0})
+    rep, hit = pick_replica([a, b], chain, sticky)
+    assert rep is a and hit == 4
+
+
 def test_line_channel_roundtrip_and_deadlines():
     r1, w1 = os.pipe()
     a = LineChannel(r1, w1)
