@@ -1793,6 +1793,164 @@ def disagg_main():
     }), flush=True)
 
 
+def kv_tier_main():
+    """``BENCH_MODE=kv_tier``: the KV tier (inference/kvtier.py) cold vs
+    warm vs disabled on toy replicas whose radix trims after EVERY
+    release (cache_pages=0 — the HBM-starved regime the tier exists
+    for). A warmup wave seeds each tenant's prefix and the trim demotes
+    it straight into the host-RAM/NVMe tier; the measured wave's
+    placement misses then promote instead of recomputing. The
+    recompute-only baseline runs the SAME seeded trace with the tier
+    off, so the scorecard prices exactly what demotion bought: tier hit
+    rate, p50 TTFT vs recompute, promote/demote/fallback counters. A
+    final chaos leg arms tier_torn_spill + tier_crash_mid_demote and
+    asserts every stream stays bit-identical to the LCG oracle with 0
+    double-commits — the degrade-to-recompute contract, measured."""
+    from deepspeed_tpu.serving import (FleetConfig, Router, RouterConfig,
+                                       TraceConfig, synth_trace)
+    from deepspeed_tpu.serving.replica import _mix
+
+    import shutil
+
+    n_req = int(os.environ.get("BENCH_KV_TIER_REQUESTS", "24"))
+    n_ten = int(os.environ.get("BENCH_ROUTER_TENANTS", "3"))
+    prefix = int(os.environ.get("BENCH_ROUTER_PREFIX", "64"))
+    gen = int(os.environ.get("BENCH_ROUTER_GEN", "16"))
+    vocab = 1024
+    root = "/tmp/ds_bench_kv_tier"
+    # a previous run's NVMe spill would reopen tier-WARM and fake the
+    # cold-start premise (and its torn chaos segments would skew the
+    # torn counters): every run starts from a clean tree
+    shutil.rmtree(root, ignore_errors=True)
+
+    def replica_cfg(tier: bool, tag: str) -> dict:
+        cfg = {"backend": "toy", "block_size": 16, "max_live": 8,
+               "vocab": vocab, "hb_interval_s": 0.03,
+               "tokens_per_step": 4, "cache_pages": 0,
+               # prefill costs simulated device time: exactly what a
+               # promoted chain skips
+               "prefill_chunk": 16, "prefill_delay_s": 0.02}
+        if tier:
+            cfg["kv_tier"] = {"ram_bytes": 1 << 18,
+                              "nvme_dir": f"{root}/{tag}/tier"}
+        return cfg
+
+    trace = synth_trace(TraceConfig(
+        n_requests=n_req, n_tenants=n_ten, prefix_len=prefix,
+        max_new_tokens=gen, vocab=vocab, seed=11))
+    # one warm request per tenant: it seeds the prefix, and the
+    # cache_pages=0 trim DEMOTES it into the tier at release — the
+    # measured wave then starts HBM-cold but tier-warm
+    seen, warm = set(), []
+    for rec in trace:
+        if rec.tenant not in seen:
+            seen.add(rec.tenant)
+            warm.append(rec)
+    fkw = {"n_replicas": 2, "hb_timeout_s": 2.0}
+    rkw = {"kv_pull": True, "kv_pull_min_pages": 1, "rebalance": False,
+           "kv_rate_probe": True, "kv_rate_probe_dir": root}
+    warm_run = _router_scenario(
+        "kv_tier_warm", trace,
+        fleet_kw={**fkw, "replica": replica_cfg(True, "warm"),
+                  "snapshot_dir": f"{root}/warm/snap"},
+        router_kw=dict(rkw), warmup=warm)
+    off_run = _router_scenario(
+        "kv_tier_off", trace,
+        fleet_kw={**fkw, "replica": replica_cfg(False, "off")},
+        router_kw=dict(rkw), warmup=warm)
+
+    def _tier_ctr(tag, metric):
+        import glob
+        total = 0.0
+        for path in glob.glob(f"{root}/{tag}/snap/*.json"):
+            try:
+                with open(path) as f:
+                    fam = json.load(f).get(metric)
+            except (OSError, ValueError):
+                continue
+            if fam:
+                total += sum(s["value"] for s in fam["series"])
+        return total
+
+    promotes = _tier_ctr("warm", "serving_kv_tier_promotes_total")
+    demotes = _tier_ctr("warm", "serving_kv_tier_demotes_total")
+    tier_hit_rate = round(promotes / max(len(trace), 1), 3)
+
+    # chaos leg: injected tier failures must degrade to recompute with
+    # streams bit-identical to the closed-form toy oracle
+    def oracle(prompt, n):
+        seed = 0
+        for t in prompt:
+            seed = _mix(seed, int(t))
+        out = []
+        for i in range(n):
+            seed = _mix(seed, i)
+            out.append((seed >> 33) % vocab)
+        return out
+
+    chaos = {"requests": 0, "oracle_identical": 0, "double_commits": 0}
+    rep = replica_cfg(True, "chaos")
+    router = Router(RouterConfig(
+        fleet=FleetConfig(
+            n_replicas=2, replica=rep, hb_timeout_s=2.0,
+            backoff_base_s=0.05, log_dir=f"{root}/chaos/logs",
+            # the shared prefix co-locates on slot 0 (digest/sticky):
+            # arm the HARD crash there so it actually fires; slot 1
+            # (the failover target) gets the torn-spill write
+            per_slot={"0": {"faults": {"tier_crash_mid_demote": 3}},
+                      "1": {"faults": {"tier_torn_spill": 1}}}),
+        request_timeout_s=20.0, max_retries=3, rebalance=False,
+        kv_rate_probe=False))
+    try:
+        router.start(min_ready=2)
+        shared = list(range(64))
+        tids = []
+        for i in range(6):
+            tids.append((router.submit(shared + [900 + i],
+                                       max_new_tokens=8,
+                                       trace_id=f"x{i}"),
+                         shared + [900 + i]))
+            for _ in range(3):
+                router.poll()
+        res = router.run(deadline_s=120)
+        for tid, prompt in tids:
+            chaos["requests"] += 1
+            if res[tid]["status"] == "done" \
+                    and res[tid]["tokens"] == oracle(prompt, 8):
+                chaos["oracle_identical"] += 1
+        chaos["double_commits"] = router.double_commits
+        chaos["replica_restarts"] = router.fleet.restarts_total
+    finally:
+        router.close()
+
+    print(json.dumps({
+        "metric": f"KV tier warm vs recompute-only, {n_req} reqs / "
+                  f"{n_ten} tenants ({prefix} shared-prefix tokens, "
+                  f"HBM radix trimmed to 0 after every release)",
+        "value": warm_run["p50_ttft_s"],
+        "unit": "p50 TTFT s (tier-warm)",
+        "vs_baseline": round(
+            (off_run["p50_ttft_s"] or 0.0)
+            / max(warm_run["p50_ttft_s"] or 1e-9, 1e-9), 3),
+        "detail": {
+            "tier_warm": warm_run,
+            "recompute_only": off_run,
+            "tier_hit_rate": tier_hit_rate,
+            "tier_promotes": promotes,
+            "tier_demoted_pages": demotes,
+            "chaos": chaos,
+            "note": "cache_pages=0 makes every follow-up a placement "
+                    "miss in HBM; tier_warm promotes the demoted chain "
+                    "(tier_hit_rate = promotes/requests), "
+                    "recompute_only pays the full prefill again; the "
+                    "chaos block arms tier_torn_spill + "
+                    "tier_crash_mid_demote and requires every stream "
+                    "bit-identical to the LCG oracle with 0 "
+                    "double-commits",
+        },
+    }), flush=True)
+
+
 def deploy_main():
     """``BENCH_MODE=deploy``: a rolling weight swap under the fastgen
     tenant workload — continuous traffic through a 3-replica toy fleet
@@ -1938,6 +2096,9 @@ def main():
     if os.environ.get("BENCH_MODE") == "deploy":
         # rolling weight hot-swap under load (toy replicas, host-only)
         return deploy_main()
+    if os.environ.get("BENCH_MODE") == "kv_tier":
+        # KV tiering: tier-warm promotes vs recompute-only (host-only)
+        return kv_tier_main()
     # the FIRST device touch, under a bounded watchdog: a downed PJRT
     # tunnel must produce a structured JSON error line, never a hang
     # (round 5 lost both driver artifacts to exactly that)

@@ -49,6 +49,15 @@ import sys
 #: the directory this lint governs (relative to the repo root)
 SERVING_DIR = os.path.join("deepspeed_tpu", "serving")
 
+#: files OUTSIDE serving/ that sit on serving hot paths and are held to
+#: the same no-unbounded-waits rule: the KV tier (inference/kvtier.py)
+#: runs inside the replica event loop's admission and eviction paths —
+#: a blocking wait there would wedge heartbeats exactly like a serving
+#: wait would
+EXTRA_FILES = [
+    os.path.join("deepspeed_tpu", "inference", "kvtier.py"),
+]
+
 #: zero-arg calls that block forever without a timeout kwarg
 NEED_TIMEOUT_KW = {"wait", "join", "get", "acquire", "communicate"}
 
@@ -178,6 +187,12 @@ def check_repo(root: str) -> list[str]:
         for f in sorted(files):
             if f.endswith(".py"):
                 out += check_file(os.path.join(dirpath, f))
+    for rel in EXTRA_FILES:
+        path = os.path.join(root, rel)
+        if os.path.isfile(path):
+            # absent = nothing to lint (unit fixtures build partial
+            # trees); the repo test pins that the REAL tree has it
+            out += check_file(path)
     return out
 
 

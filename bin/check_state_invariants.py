@@ -94,6 +94,39 @@ WEIGHT_VERSION_ALLOWED = {
     ("prefix_cache.py", "set_weight_version"),
 }
 
+#: KV tiering (inference/kvtier.py): the tier's demote/promote
+#: mutators. ``absorb`` ingests an evicted chain (only the eviction
+#: sink may feed it — a stray absorb could tier pages whose pool
+#: content doesn't match the chain key, exactly the stale-serve hazard
+#: the trie's mutator pinning prevents); ``extract`` pairs with the
+#: refcounted adopt + scatter path (a stray extract whose bundle never
+#: adopts would inflate promote stats and skip the version-skew gate's
+#: counters); ``set_weight_version``/``close`` mutate tier membership.
+#: The implementation file itself (kvtier.py) is exempt like ragged.py
+#: is for the StateManager rules.
+KV_TIER_MUTATORS = {"absorb", "extract", "set_weight_version", "close"}
+KV_TIER_FILE = "deepspeed_tpu/inference/kvtier.py"
+KV_TIER_ALLOWED = {
+    ("engine_v2.py", "_demote_evicted"),
+    ("engine_v2.py", "_tier_promote"),
+    ("engine_v2.py", "swap_weights"),
+    ("replica.py", "_demote_evicted"),
+    ("replica.py", "_tier_promote"),
+    ("replica.py", "kv_export"),
+    ("replica.py", "swap_weights"),
+    ("replica.py", "_flush_radix"),
+    ("replica.py", "serve"),            # graceful-shutdown close(flush)
+}
+
+#: the prefix cache's eviction sink (the demotion hook): assignment is
+#: pinned to the attach sites so a stray handler can't silently
+#: redirect (or drop) demotions
+EVICT_SINK_ALLOWED = {
+    ("prefix_cache.py", "__init__"),
+    ("engine_v2.py", "__init__"),
+    ("replica.py", "__init__"),
+}
+
 #: mutating list-method names (on a ``.blocks`` attribute)
 LIST_MUTATORS = {"append", "extend", "insert", "pop", "remove", "clear",
                  "sort", "reverse"}
@@ -115,10 +148,12 @@ def _chain(node: ast.expr) -> list[str]:
 
 
 class _Visitor(ast.NodeVisitor):
-    def __init__(self, path: str, in_state_file: bool):
+    def __init__(self, path: str, in_state_file: bool,
+                 in_kvtier_file: bool = False):
         self.path = path
         self.fname = os.path.basename(path)
         self.in_state_file = in_state_file
+        self.in_kvtier_file = in_kvtier_file
         self.violations: list[str] = []
         self._func_stack: list[str] = []
 
@@ -156,6 +191,18 @@ class _Visitor(ast.NodeVisitor):
                 elif base == "prefix_cache" and meth in CACHE_MUTATORS:
                     self._flag(node, "prefix_cache",
                                f"direct prefix_cache.{meth}() call")
+                elif base == "kv_tier" and meth in KV_TIER_MUTATORS \
+                        and not self.in_kvtier_file \
+                        and not any((self.fname, f) in KV_TIER_ALLOWED
+                                    for f in self._func_stack):
+                    ok = ", ".join(sorted(
+                        f"{f}:{fn}" for f, fn in KV_TIER_ALLOWED))
+                    self.violations.append(
+                        f"{self.path}:{node.lineno}: direct "
+                        f"kv_tier.{meth}() call outside the demote/"
+                        f"promote wrappers (allowed only in {ok}) — "
+                        f"demotes feed through the eviction sink, "
+                        f"promotes through adopt_prefix + the scatter")
                 elif base == "blocks" and meth in LIST_MUTATORS \
                         and len(chain) >= 3:
                     # len >= 3: only ATTRIBUTE block lists (seq.blocks.*);
@@ -191,6 +238,16 @@ class _Visitor(ast.NodeVisitor):
             elif isinstance(t, ast.Attribute) \
                     and t.attr.lstrip("_") == "weight_version":
                 self._flag_weight_version(node)
+            elif isinstance(t, ast.Attribute) and t.attr == "evict_sink" \
+                    and not any((self.fname, f) in EVICT_SINK_ALLOWED
+                                for f in self._func_stack):
+                ok = ", ".join(sorted(f"{f}:{fn}"
+                                      for f, fn in EVICT_SINK_ALLOWED))
+                self.violations.append(
+                    f"{self.path}:{node.lineno}: assignment to a "
+                    f".evict_sink attribute outside the tier attach "
+                    f"sites (allowed only in {ok}) — a stray handler "
+                    f"could silently redirect or drop demotions")
             elif isinstance(t, (ast.Tuple, ast.List)):
                 self._check_targets(node, t.elts)
 
@@ -218,8 +275,9 @@ def check_file(path: str) -> list[str]:
         tree = ast.parse(src, filename=path)
     except SyntaxError as e:
         return [f"{path}:{e.lineno}: unparseable ({e.msg})"]
-    in_state = path.replace(os.sep, "/").endswith(STATE_FILE)
-    v = _Visitor(path, in_state)
+    norm = path.replace(os.sep, "/")
+    v = _Visitor(path, norm.endswith(STATE_FILE),
+                 norm.endswith(KV_TIER_FILE))
     v.visit(tree)
     return v.violations
 

@@ -144,6 +144,24 @@ def feature_report() -> list[tuple[str, bool, str]]:
         feats.append(("serving: distributed prefix cache", False,
                       str(e)))
 
+    # KV tiering (inference/kvtier.py): HBM → host RAM → NVMe under the
+    # fleet radix — pure host code; probe the spill dir + the rate probe
+    try:
+        from .inference import kvtier as _kvtier
+        rates = _kvtier.measure_tier_rates()
+        feats.append((
+            "inference: KV tiering (HBM → host RAM → NVMe)", True,
+            "prefix-cache eviction demotes chains into a bounded "
+            "host-RAM ring + NVMe spill (kind=\"prefix\" PageBundles, "
+            "crc+length gated, torn-spill-safe); admission misses "
+            "promote via adopt_prefix instead of recomputing; "
+            f"probed RAM rate {rates['ram_bytes_s'] / 1e9:.1f} GB/s; "
+            "engine kv_tier=True / replica cfg kv_tier={...}; "
+            "BENCH_MODE=kv_tier"))
+    except Exception as e:  # pragma: no cover — import breakage only
+        feats.append(("inference: KV tiering (HBM → host RAM → NVMe)",
+                      False, str(e)))
+
     # zero-downtime weight deploys (serving/deploy.py): rolling hot-swap
     # behind the router — pure host logic, availability is an import check
     try:

@@ -195,6 +195,24 @@ class RaggedInferenceConfig:
     #: forces it on (still refuses ring mode; allowed with fp8-KV for
     #: parity work); False disables.
     prefix_cache: bool | None = None
+    #: KV tiering (inference/kvtier.py — Mooncake-style HBM → host RAM →
+    #: NVMe): prefix-cache eviction DEMOTES chains through the
+    #: kind="prefix" PageBundle path into a bounded host-RAM ring with
+    #: an optional NVMe spill behind it, indexed by the same blake2b
+    #: chain hashes placement matches on; an admission miss whose chain
+    #: is tier-resident PROMOTES (adopt_prefix + the page scatter)
+    #: instead of recomputing — recompute stays the always-safe fallback
+    #: on any crc/version-skew/capacity failure. Requires the prefix
+    #: cache (refused otherwise). False (default) = no tier.
+    kv_tier: bool = False
+    #: host-RAM ring payload budget for demoted pages
+    kv_tier_ram_bytes: int = 64 << 20
+    #: NVMe spill directory (None = RAM-only tier, overflow drops)
+    kv_tier_nvme_dir: str | None = None
+    #: total NVMe spill budget (oldest segment dropped past it)
+    kv_tier_nvme_bytes: int = 256 << 20
+    #: shortest tier-resident chain worth promoting (pages)
+    kv_tier_min_pages: int = 1
     #: KV-cache dtype: None = compute dtype (bf16); "fp8" stores the pool
     #: as float8_e4m3 — the TPU-native form of FastGen's quantized KV
     #: (scale-free: e4m3's dynamic range covers K/V activations, so pages
@@ -365,6 +383,26 @@ class InferenceEngineV2:
             from .prefix_cache import PrefixCache
             self._prefix_cache = PrefixCache(cfg.block_size)
             self.state.attach_prefix_cache(self._prefix_cache)
+
+        # --- KV tiering: HBM → host RAM → NVMe (inference/kvtier.py) -----
+        self._kv_tier = None
+        if cfg.kv_tier:
+            if self._prefix_cache is None:
+                raise ValueError(
+                    "kv_tier requires the shared-prefix cache: the tier "
+                    "is an eviction sink under the radix trie (enable "
+                    "prefix_cache, or serve pack-mode linear where auto "
+                    "turns it on)")
+            from .kvtier import KVTier, KVTierConfig
+            self._kv_tier = KVTier(KVTierConfig(
+                ram_bytes=cfg.kv_tier_ram_bytes,
+                nvme_dir=cfg.kv_tier_nvme_dir,
+                nvme_bytes=cfg.kv_tier_nvme_bytes,
+                min_pages=cfg.kv_tier_min_pages))
+            # eviction becomes demotion: the sink gathers reclaimed
+            # chains to host and absorbs them into the tier (best-effort
+            # — a sink failure is counted and eviction proceeds)
+            self._prefix_cache.evict_sink = self._demote_evicted
         # DS_TPU_STATE_AUDIT=1: full-pool ownership/refcount audit after
         # every release (debug mode — O(pool) per flush)
         import os as _os
@@ -598,6 +636,12 @@ class InferenceEngineV2:
                       # (bench zeroes these with the rest of the dict)
                       "prefix_hit_tokens": 0, "prefix_lookup_tokens": 0,
                       "prefix_hit_rate": 0.0,
+                      # KV tiering (kvtier.py): pages demoted on
+                      # eviction, chains promoted on admission misses,
+                      # prompt tokens the tier saved from recompute
+                      "kv_tier_demoted_pages": 0, "kv_tier_promotes": 0,
+                      "kv_tier_promoted_tokens": 0,
+                      "kv_tier_fallbacks": 0,
                       # ring collective-matmul overlap (trace-time deltas
                       # from parallel/tensor.py — see _refresh_tp_stats)
                       "tp_ring_matmuls": 0, "tp_ring_steps": 0,
@@ -2383,6 +2427,13 @@ class InferenceEngineV2:
             raise ValueError("empty prompt")
         if len(toks) + max_new_tokens > self.config.max_seq_len:
             raise ValueError("prompt + max_new_tokens exceeds max_seq_len")
+        if self._kv_tier is not None:
+            # KV tiering: an admission miss whose chain the tier holds
+            # promotes it into the trie FIRST, so the admit below hits
+            # it through the normal match path (recompute on any
+            # failure — promoted pages are unreferenced trie entries,
+            # so can_admit still counts them evictable)
+            self._tier_promote(toks)
         if not self.state.can_admit(len(toks), max_new_tokens):
             raise RuntimeError("cannot schedule: pool/slots exhausted")
         if self._rt.enabled:
@@ -2785,13 +2836,17 @@ class InferenceEngineV2:
             "kv_pull_bytes_out", 0) + bundle.payload_bytes
         return bundle
 
-    def import_prefix(self, bundle: "PageBundle") -> int:
+    def import_prefix(self, bundle: "PageBundle",
+                      source: str = "pull") -> int:
         """Adopt a pulled chain into the local trie: allocate-and-adopt
         through the refcounted API, then scatter the pulled payload into
         exactly the freshly-inserted blocks (dedup'd pages keep the
         cached copy — their device content is already correct). Returns
         the pages now cache-resident; raises (and adopts nothing) on a
-        geometry/dtype mismatch or a pool too full to hold the chain."""
+        geometry/dtype mismatch or a pool too full to hold the chain.
+        ``source`` labels the byte counter: "pull" = a cross-replica
+        radix pull, "tier" = a local KV-tier promote (kvtier.py) riding
+        the same adopt + scatter path."""
         from .migration import MigrationError, version_skew
 
         bundle.validate()
@@ -2830,9 +2885,122 @@ class InferenceEngineV2:
                 page = np.frombuffer(bundle.pages[j],
                                      dtype=dt).reshape(page_shape)
                 self.kv_pool = fn(self.kv_pool, np.int32(block), page)
-        self.stats["kv_pull_bytes_in"] = self.stats.get(
-            "kv_pull_bytes_in", 0) + bundle.payload_bytes
+        key = f"kv_{source}_bytes_in"
+        self.stats[key] = self.stats.get(key, 0) + bundle.payload_bytes
         return bundle.n_full
+
+    # ------------------------------------------------------------------
+    # KV tiering (inference/kvtier.py): HBM → host RAM → NVMe under the
+    # radix. _demote_evicted is the PrefixCache eviction sink (installed
+    # at construction when cfg.kv_tier); _tier_promote runs at admission
+    # and adopts the tier's chain through the SAME refcounted
+    # adopt_prefix + page-scatter path cross-replica pulls use —
+    # bin/check_state_invariants.py pins the tier's absorb/extract
+    # mutators to exactly these two wrappers.
+    # ------------------------------------------------------------------
+    def _demote_evicted(self, chains) -> None:
+        """Serialize each reclaimed chain through the kind="prefix"
+        PageBundle path into the tier. Runs synchronously inside
+        ``PrefixCache.evict`` BEFORE the freed blocks return to the
+        allocator, so one device gather per chain reads the still-intact
+        payloads. A chain whose deepest page is already tier-resident
+        skips entirely (tier residency is contiguous-from-root, so a
+        leaf-first eviction cascade gathers each page once)."""
+        from .migration import PageBundle
+        from .prefix_cache import chain_hashes
+
+        tier = self._kv_tier
+        if tier is None:
+            return
+        bs = self.config.block_size
+        m = self.mcfg
+        page_bytes = (m.num_layers * 2 * m.kv_heads * bs * m.head_dim
+                      * np.dtype(self._kv_dtype).itemsize)
+        demoted = 0
+        for tokens, blocks in chains:
+            chain = chain_hashes(tokens, bs)
+            if not chain or tier.has(chain[-1]):
+                continue
+            with self._telem.span("kv_tier_demote", pages=len(blocks)):
+                pages_h = np.asarray(self.kv_pool[:, :, :, np.asarray(
+                    blocks, np.int32)])
+            blobs = [pages_h[:, :, :, j].tobytes()
+                     for j in range(len(blocks))]
+            bundle = PageBundle.prefix(
+                "", [int(t) for t in tokens], bs,
+                np.dtype(self._kv_dtype).name, page_bytes, blobs,
+                weight_version=dict(self._weight_version))
+            demoted += tier.absorb(bundle)
+        if demoted:
+            self.stats["kv_tier_demoted_pages"] += demoted
+            if self._rt.enabled:
+                self._rt.event(-1, "kv_tier", dir="demote", pages=demoted)
+
+    def _tier_promote(self, tokens) -> int:
+        """Admission-path promote: when the tier holds a DEEPER chain
+        than the HBM trie for this prompt, rebuild it as a prefix bundle
+        and adopt it (``import_prefix`` → ``StateManager.adopt_prefix``
+        + the page scatter) so the admit that follows hits it through
+        the normal match path. Returns pages promoted; 0 — with
+        recompute covering the prompt — on ANY miss, corruption,
+        version skew, or pool-capacity refusal."""
+        tier = self._kv_tier
+        bs = self.config.block_size
+        cap = min(len(tokens) - 1, self.state.max_blocks_per_seq * bs)
+        n_full = cap // bs
+        if tier is None or n_full < 1:
+            return 0
+        aligned = [int(t) for t in tokens[:n_full * bs]]
+        from .prefix_cache import chain_hashes
+
+        chain = chain_hashes(aligned, bs)
+        have = self._prefix_cache.cached_depth(aligned)
+        deep = tier.probe(chain)
+        if deep <= have:
+            return 0                 # HBM already covers the tier's chain
+        t0 = time.perf_counter()
+        bundle = tier.extract(aligned[:deep * bs], bs)
+        if bundle is None:
+            return 0
+        try:
+            pages = self.import_prefix(bundle, source="tier")
+        except (RuntimeError, ValueError) as e:
+            # capacity / skew / geometry: structured refusal — the
+            # admission below recomputes, always safe
+            tier._fallback("adopt")
+            self.stats["kv_tier_fallbacks"] += 1
+            logger.warning(f"engine_v2: tier promote refused ({e}); "
+                           f"recomputing")
+            return 0
+        tier.note_promote_latency(time.perf_counter() - t0)
+        self.stats["kv_tier_promotes"] += 1
+        self.stats["kv_tier_promoted_tokens"] += (deep - have) * bs
+        if self._rt.enabled:
+            self._rt.event(-1, "kv_tier", dir="promote", pages=pages,
+                           tokens=(deep - have) * bs)
+        # the serving_kv_tier_* counter family is emitted in ONE place
+        # (the replica loop's delta sync) so engine-backed and toy
+        # replicas can never double-count; standalone engine users read
+        # stats / kv_tier_stats() directly
+        return pages
+
+    def kv_tier_stats(self) -> dict | None:
+        """Lifetime tier counters (residency bytes/pages per sub-tier,
+        demotes/promotes/fallbacks, torn spill records skipped); None
+        when tiering is off."""
+        return None if self._kv_tier is None else self._kv_tier.stats()
+
+    def kv_tier_digest(self, max_entries: int = 4096) -> list[int] | None:
+        """Chain hashes of tier-resident pages (RAM first) — shipped
+        next to the HBM residency digest in the serving heartbeat so
+        placement sees tier residency."""
+        return None if self._kv_tier is None \
+            else self._kv_tier.residency_digest(max_entries)
+
+    def kv_tier_version(self) -> int:
+        """Tier membership version (heartbeat re-ships the tier digest
+        only when this moved)."""
+        return 0 if self._kv_tier is None else self._kv_tier.version
 
     # ------------------------------------------------------------------
     # Versioned weight hot-swap (the hybrid-engine republish primitive,
@@ -2986,6 +3154,10 @@ class InferenceEngineV2:
         flushed = self.state.flush_prefix_cache()
         if self._prefix_cache is not None:
             self._prefix_cache.set_weight_version(wid)
+        if self._kv_tier is not None:
+            # the tier's records are stale under the new weights too:
+            # invalidate so a post-swap promote can never serve them
+            self._kv_tier.set_weight_version(self._weight_version)
         swap_s = time.perf_counter() - t1
         if self._rt.enabled:
             self._rt.event(-1, "weight_swap", wid=wid, flushed=flushed,

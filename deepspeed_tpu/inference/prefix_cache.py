@@ -123,6 +123,20 @@ class PrefixCache:
         self.inserted_pages = 0
         self.deduped_pages = 0
         self.evicted_pages = 0
+        #: KV-tier eviction sink (inference/kvtier.py): a callable
+        #: ``sink(chains)`` where ``chains`` is a list of
+        #: ``(path tokens, path blocks)`` pairs — the full root chain of
+        #: every current-version page about to be reclaimed. The pool
+        #: owner (engine_v2 / the toy backend) installs it to serialize
+        #: the chains through the kind="prefix" PageBundle path into the
+        #: host-RAM/NVMe tier, turning eviction into DEMOTION instead of
+        #: loss. It runs synchronously inside :meth:`evict`, BEFORE the
+        #: freed blocks return to the allocator, so device payloads are
+        #: still intact when it gathers them. A sink failure is counted
+        #: (``demote_errors``) and never fails the eviction — reclaiming
+        #: blocks is load-bearing, demotion is best-effort.
+        self.evict_sink = None
+        self.demote_errors = 0
         #: per-request lifecycle tracer (telemetry/reqtrace.py, duck-typed)
         #: — engine_v2 attaches it; evictions are pool-level events (the
         #: reclaimed pages had no live owner), so they land in the
@@ -257,6 +271,25 @@ class PrefixCache:
                     f"prefix cache refcount underflow on block {n.block}")
             n.refs -= 1
             n.last_used = self._clock
+
+    def cached_depth(self, tokens, max_tokens: int | None = None) -> int:
+        """READ-ONLY depth (in pages) of the longest cached chain
+        prefixing ``tokens`` — no pins, no LRU touch, no stats. The KV
+        tier's promote gate ("is the tier deeper than HBM?") must not
+        perturb the cache it is about to warm, so this is deliberately
+        not :meth:`match` (which is part of the mutating surface the
+        state-invariant lint pins to StateManager)."""
+        bs = self.block_size
+        limit = len(tokens) if max_tokens is None else min(max_tokens,
+                                                           len(tokens))
+        node, depth = self.root, 0
+        for j in range(limit // bs):
+            child = node.children.get(tuple(tokens[j * bs:(j + 1) * bs]))
+            if child is None or child.wv != self.weight_version:
+                break
+            depth += 1
+            node = child
+        return depth
 
     # -- stale-version subtrees (weight hot-swap skew guard) --------------
     # A node whose ``wv`` stamp trails the cache's current version holds
@@ -439,7 +472,7 @@ class PrefixCache:
         return out, to_free
 
     # -- eviction ---------------------------------------------------------
-    def evict(self, n: int) -> list[int]:
+    def evict(self, n: int, demote: bool = True) -> list[int]:
         """Reclaim up to ``n`` blocks, least-recently-used first, leaf-
         first. Referenced pages (live sequences) are NEVER taken; interior
         pages only fall after their whole subtree has. Returns the freed
@@ -449,7 +482,15 @@ class PrefixCache:
         (release publishes pages instead of freeing, so the free list
         drains toward the trie): one scan seeds a heap of evictable
         leaves, and a parent enters the heap when its last child falls —
-        O(nodes + k log nodes), not a full rescan per reclaimed block."""
+        O(nodes + k log nodes), not a full rescan per reclaimed block.
+
+        With an :attr:`evict_sink` installed (KV tiering,
+        inference/kvtier.py) and ``demote=True``, every current-version
+        victim's full root chain is handed to the sink BEFORE the blocks
+        leave this call — eviction becomes demotion into the host-RAM/
+        NVMe tier instead of loss. ``demote=False`` is the weight-swap
+        flush path (``StateManager.flush_prefix_cache``): stale-version
+        pages must drop, not tier."""
         out: list[int] = []
         if n <= 0:
             return out
@@ -459,8 +500,24 @@ class PrefixCache:
             if node.evictable:
                 heapq.heappush(heap, (node.last_used, tie, node))
                 tie += 1
+        sink = self.evict_sink if demote else None
+        demoting: list[tuple[list[int], list[int]]] = []
         while heap and len(out) < n:
             _, _, victim = heapq.heappop(heap)
+            if sink is not None and victim.wv == self.weight_version:
+                # record the victim's full root chain (tokens + blocks)
+                # while parent links are intact; the sink reads the
+                # device payloads after the loop, before the caller
+                # frees anything
+                path: list[PageNode] = []
+                node = victim
+                while node is not None and node is not self.root:
+                    path.append(node)
+                    node = node.parent
+                path.reverse()
+                demoting.append(
+                    ([t for nd in path for t in nd.key],
+                     [nd.block for nd in path]))
             del victim.parent.children[victim.key]
             self._n_nodes -= 1
             self.evicted_pages += 1
@@ -470,6 +527,19 @@ class PrefixCache:
             if parent is not self.root and parent.evictable:
                 heapq.heappush(heap, (parent.last_used, tie, parent))
                 tie += 1
+        if sink is not None and demoting:
+            try:
+                sink(demoting)
+            except Exception as e:
+                # demotion is best-effort: the eviction must succeed (the
+                # caller is reclaiming blocks under allocation pressure),
+                # so a sink failure degrades to plain eviction — counted,
+                # logged, recompute covers the lost chains
+                self.demote_errors += 1
+                from ..utils.logging import logger
+                logger.warning(f"prefix cache: eviction sink failed "
+                               f"({e}); {len(demoting)} chain(s) evicted "
+                               f"without demotion")
         rt = self.reqtrace
         if rt is not None and rt.enabled and out:
             rt.event(-1, "evict", pages=len(out), cached=self._n_nodes)
@@ -503,4 +573,5 @@ class PrefixCache:
             "inserted_pages": self.inserted_pages,
             "deduped_pages": self.deduped_pages,
             "evicted_pages": self.evicted_pages,
+            "demote_errors": self.demote_errors,
         }

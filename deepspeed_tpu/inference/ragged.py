@@ -240,10 +240,17 @@ class StateManager:
         request's prefill after the swap. Pages pinned by live
         sequences stay — an in-flight sequence keeps its own KV across
         a same-shape update (the hybrid-engine contract) — and fall to
-        the ordinary LRU once released. Returns pages reclaimed."""
+        the ordinary LRU once released. Returns pages reclaimed.
+
+        ``demote=False``: these pages were computed under the OLD
+        weights — serializing them into the KV tier (the eviction sink,
+        inference/kvtier.py) would only store chains the version-skew
+        gate refuses to promote; they drop, the tier invalidates its own
+        stale records via ``KVTier.set_weight_version``."""
         if self.prefix_cache is None:
             return 0
-        reclaimed = self.prefix_cache.evict(len(self.prefix_cache))
+        reclaimed = self.prefix_cache.evict(len(self.prefix_cache),
+                                            demote=False)
         if reclaimed:
             self.allocator.free(reclaimed)
         return len(reclaimed)

@@ -182,6 +182,28 @@ class FaultInjector:
                                              the handshake, so the deploy
                                              health gate must catch it
 
+    KV-tier points (inference/kvtier.py — armed per-slot via the
+    replica config's ``faults`` like the rest; the tier consumes them
+    through its own ``inj`` reference):
+      ``tier_torn_spill`` (int k)            the k-th demoted page's
+                                             spill record is written
+                                             TORN (half the bytes, never
+                                             indexed) — the on-disk
+                                             shape of a crash mid-write;
+                                             the next tier open's crc +
+                                             length gate must count and
+                                             skip it, and the chain's
+                                             promote degrades to
+                                             recompute
+      ``tier_crash_mid_demote`` (int k)      die HARD between the k-th
+                                             demoted page's spill write
+                                             and its index update — the
+                                             restarted replica reopens
+                                             the tier over a torn
+                                             segment and every affected
+                                             request recomputes,
+                                             bit-identical
+
     Router-side points (serving/router.py, armed via
     ``RouterConfig.faults`` and always HARD — the journal chaos matrix
     SIGKILLs the CONTROL PLANE at each journaled phase, all count-based

@@ -35,7 +35,8 @@ from .fleet import Fleet, FleetConfig
 from .journal import (Journal, JournalError, RecoveredState,
                       reduce_router_records)
 from .placement import (StickyMap, best_digest_peer, chain_hashes,
-                        match_pages, pick_replica, pull_beats_recompute)
+                        match_pages, pick_replica, plan_kv_source,
+                        pull_beats_recompute)
 from .protocol import (ChannelClosed, ChannelTimeout, LineChannel,
                        RequestRecord, poll_channels)
 from .router import AdmissionError, Router, RouterConfig
@@ -53,6 +54,6 @@ __all__ = [
     "ScaleAdvisor", "ShmReader", "ShmRing", "SocketChannel",
     "SocketListener", "StickyMap", "TraceConfig", "attach_ring",
     "best_digest_peer", "chain_hashes", "connect_channel", "match_pages",
-    "open_ring", "pick_replica", "poll_channels", "pull_beats_recompute",
-    "synth_trace", "write_toy_checkpoint",
+    "open_ring", "pick_replica", "plan_kv_source", "poll_channels",
+    "pull_beats_recompute", "synth_trace", "write_toy_checkpoint",
 ]

@@ -79,6 +79,11 @@ class ReplicaHandle:
         self.last_msg_t = 0.0
         self.load: dict | None = None
         self.digest: set[int] | None = None
+        #: KV-tier residency (inference/kvtier.py): chain hashes the
+        #: replica's host-RAM/NVMe tier could promote locally — rides
+        #: the heartbeat next to the HBM digest; the router's
+        #: pull-vs-promote-vs-recompute cost model reads it
+        self.tier_digest: set[int] | None = None
         #: the replica's shared-memory page ring segment name (shm
         #: transport, serving/shm.py); None = relay-only peer
         self.shm: str | None = None
@@ -138,7 +143,8 @@ class ReplicaHandle:
             from .transport import connect_channel
 
             self.state = SPAWNING
-            self.load = self.digest = self.shm = self.wv = None
+            self.load = self.digest = self.tier_digest = self.shm = None
+            self.wv = None
             self.rtt_s = self.clock_offset_s = None
             self.last_msg_t = time.monotonic()
             try:
@@ -179,7 +185,8 @@ class ReplicaHandle:
         self.chan = LineChannel(self.proc.stdout.fileno(),
                                 self.proc.stdin.fileno(), own_fds=False)
         self.state = SPAWNING
-        self.load = self.digest = self.shm = self.wv = None
+        self.load = self.digest = self.tier_digest = self.shm = None
+        self.wv = None
         self.rtt_s = self.clock_offset_s = None
         self.last_msg_t = time.monotonic()
         logger.info(f"fleet: slot {self.slot} spawned epoch {self.epoch} "

@@ -120,11 +120,68 @@ def best_digest_peer(chain: list[int], handles, exclude_slot: int = -1,
         if weight_version is not None and hv is not None \
                 and hv != weight_version:
             continue                     # cross-version peer: never pull
-        m = match_pages(chain, h.digest)
+        # a peer can serve a pull from its HBM radix OR its KV tier
+        # (inference/kvtier.py — the export leg promotes/extracts from
+        # the tier when it runs deeper), so residency is the union
+        m = max(match_pages(chain, h.digest),
+                match_pages(chain, getattr(h, "tier_digest", None)))
         if m > pages or (m == pages and m > 0 and best is not None
                          and h.slot < best.slot):
             best, pages = h, m
     return best, pages
+
+
+def transfer_time(pages: int, page_bytes: int, bytes_s: float,
+                  overhead_s: float = 0.0) -> float:
+    """Estimated seconds to move ``pages`` over a transport/tier rated
+    ``bytes_s``, plus a fixed per-transfer overhead (control round
+    trips / file opens). Unknown page geometry (``page_bytes`` 0 — no
+    bundle seen yet) prices only the overhead, mirroring
+    :func:`pull_beats_recompute`'s first-leg optimism."""
+    if pages <= 0:
+        return 0.0
+    return overhead_s + pages * page_bytes / max(bytes_s, 1e-9)
+
+
+def plan_kv_source(chain_pages: int, hit_pages: int, peer_pages: int,
+                   tier_pages: int, page_bytes: int, block_size: int,
+                   prefill_tok_s: float, pull_bytes_s: float,
+                   tier_bytes_s: float, overhead_s: float = 0.0,
+                   min_pages: int = 1) -> str:
+    """The three-way KV-sourcing decision for a placed request:
+    ``"pull"`` (ship the chain from the deepest same-version peer's HBM
+    radix), ``"tier"`` (let the placed replica promote from its own
+    host-RAM/NVMe KV tier — inference/kvtier.py), or ``"recompute"``.
+
+    Each option's cost = transfer time for the pages it covers beyond
+    the placed replica's HBM hit (``hit_pages``) + prefill time for the
+    tokens nothing covers. The tier rate should be the CONSERVATIVE
+    (NVMe) rate — the router cannot see which sub-tier holds the chain,
+    and recompute/tier are both safe while a pull burns fleet messages.
+    Options that do not beat the placed replica's hit by ``min_pages``
+    drop out; exact ties prefer recompute over tier over pull (cheaper
+    machinery first). Recompute stays the always-safe FALLBACK
+    regardless of what this returns — the decision only picks what to
+    TRY first."""
+    bs = max(block_size, 1)
+    chain_pages = max(chain_pages, hit_pages, peer_pages, tier_pages)
+
+    def total(covered: int, rate: float) -> float:
+        xfer = transfer_time(covered - hit_pages, page_bytes, rate,
+                             overhead_s)
+        return xfer + (chain_pages - covered) * bs \
+            / max(prefill_tok_s, 1e-9)
+
+    best, best_t = "recompute", total(hit_pages, 1.0)
+    if tier_pages - hit_pages >= min_pages:
+        t = total(tier_pages, tier_bytes_s)
+        if t < best_t:
+            best, best_t = "tier", t
+    if peer_pages - hit_pages >= min_pages:
+        t = total(peer_pages, pull_bytes_s)
+        if t < best_t:
+            best, best_t = "pull", t
+    return best
 
 
 def pull_beats_recompute(extra_tokens: int, page_bytes: int,
@@ -168,8 +225,13 @@ def pick_replica(candidates: list, chain: list[int],
         pages = match_pages(chain, rep.digest)
         s_pages = sticky_hit[1] \
             if sticky_hit is not None and sticky_hit[0] == rep.slot else 0
+        # KV-tier residency (kvtier.py) breaks ties behind the HBM
+        # signals: a replica that can PROMOTE the chain locally beats
+        # one that must recompute it, but never outranks real HBM pages
+        # or the sticky estimate (promotes cost a host copy)
+        t_pages = match_pages(chain, getattr(rep, "tier_digest", None))
         # digest outranks sticky at any depth (it is ground truth)
-        key = (pages, s_pages, -load_score(rep.load), -rep.slot)
+        key = (pages, s_pages, t_pages, -load_score(rep.load), -rep.slot)
         if best_key is None or key > best_key:
             best, best_key, best_hit = rep, key, max(pages, s_pages)
     return best, best_hit

@@ -45,7 +45,7 @@ import os
 import sys
 import time
 
-from ..inference.prefix_cache import PrefixCache
+from ..inference.prefix_cache import PrefixCache, chain_hashes
 from ..runtime.resilience import FaultInjector
 from ..utils.logging import logger
 from .protocol import (ChannelClosed, ChannelTimeout, LineChannel,
@@ -71,6 +71,20 @@ def _mix(s: int, t: int) -> int:
     return (s * 6364136223846793005 + t + 1442695040888963407) & _MASK
 
 
+def _slot_tier_cfg(cfg: dict) -> dict:
+    """Per-replica KV-tier config: the fleet template names ONE
+    ``nvme_dir``, but spill segments are per-pool state — two replicas
+    appending to one directory would interleave segment ids and reap
+    each other's records. Each slot gets a ``r<slot>`` subdirectory; a
+    respawned incarnation (same slot) reopens ITS OWN spill, which is
+    exactly what the crash-mid-demote recovery drill needs."""
+    tier = dict(cfg.get("kv_tier") or {})
+    if tier.get("nvme_dir"):
+        tier["nvme_dir"] = os.path.join(
+            str(tier["nvme_dir"]), f"r{int(cfg.get('replica_id', 0))}")
+    return tier
+
+
 class ToyBackend:
     """Deterministic token generator + real prefix-cache bookkeeping.
 
@@ -80,7 +94,7 @@ class ToyBackend:
     optionally sleeping ``decode_delay_s`` per token to simulate a loaded
     device for shed/SLO tests."""
 
-    def __init__(self, cfg: dict):
+    def __init__(self, cfg: dict, inj: FaultInjector | None = None):
         self.vocab = int(cfg.get("vocab", 1024))
         self.block_size = int(cfg.get("block_size", 16))
         self.max_live = int(cfg.get("max_live", 8))
@@ -116,15 +130,90 @@ class ToyBackend:
         self.migrations_out = 0
         self.migrations_in = 0
         self.pulled_pages = 0              # radix pages adopted via pulls
+        #: KV tiering (inference/kvtier.py): eviction from this
+        #: backend's radix demotes chains into a host-RAM/NVMe tier
+        #: (toy payloads are chain-derived, so the multiprocess suite
+        #: verifies REAL payload integrity through the tier); an
+        #: admission miss whose chain is tier-resident promotes back
+        #: instead of recomputing. None = no tier.
+        self.kv_tier = None
+        self.tier_promotes = 0
+        if cfg.get("kv_tier"):
+            from ..inference.kvtier import KVTier
+            self.kv_tier = KVTier(_slot_tier_cfg(cfg), inj=inj)
+            self.radix.evict_sink = self._demote_evicted
 
     def has_work(self) -> bool:
         return bool(self.seqs)
+
+    # -- KV tiering (demote on evict / promote on admission miss) --------
+    def _demote_evicted(self, chains) -> None:
+        """Radix eviction sink: serialize each reclaimed chain as a
+        kind="prefix" PageBundle (toy payloads — pure functions of the
+        chain, which is what lets an importer VERIFY them) and absorb it
+        into the tier. Chains whose deepest page is already resident
+        skip (leaf-first cascades demote each page once)."""
+        from ..inference.migration import toy_prefix_bundle
+
+        tier = self.kv_tier
+        for tokens, _blocks in chains:
+            chain = chain_hashes(tokens, self.block_size)
+            if not chain or tier.has(chain[-1]):
+                continue
+            bundle = toy_prefix_bundle(
+                "", tokens, self.block_size,
+                weight_version=dict(self.weight_version))
+            if bundle is not None:
+                tier.absorb(bundle)
+
+    def _tier_promote(self, prompt) -> int:
+        """Admission-path promote: when the tier's chain outruns the
+        radix's, extract it (crc-verified), run the toy payload oracle,
+        and adopt it into the radix so the match below hits it. Any
+        failure — torn record, crc, version skew — returns 0 and the
+        prompt recomputes (always safe)."""
+        from ..inference.migration import MigrationError, toy_verify
+
+        tier = self.kv_tier
+        bs = self.block_size
+        n_full = (len(prompt) - 1) // bs
+        if tier is None or n_full < 1:
+            return 0
+        aligned = [int(t) for t in prompt[:n_full * bs]]
+        chain = chain_hashes(aligned, bs)
+        have = self.radix.cached_depth(aligned)
+        deep = tier.probe(chain)
+        if deep <= have:
+            return 0
+        t0 = time.perf_counter()
+        bundle = tier.extract(aligned[:deep * bs], bs)
+        if bundle is None:
+            return 0
+        try:
+            toy_verify(bundle)        # the payload-integrity oracle
+            nodes, _ = self.radix.adopt(
+                bundle.tokens,
+                [self._fresh_block() for _ in range(bundle.n_full)],
+                bundle.n_full * bs)
+        except (MigrationError, RuntimeError):
+            tier._fallback("adopt")
+            return 0
+        self.radix.release(nodes)
+        tier.note_promote_latency(time.perf_counter() - t0)
+        self.tier_promotes += 1
+        # deliberately NO cache_pages trim here: the caller (put) is
+        # about to match-and-pin exactly these pages — trimming first
+        # would evict the promote before it serves (and re-demote it).
+        # The ordinary release-path trim reclaims them later.
+        return bundle.n_full
 
     def put(self, rec: RequestRecord) -> str | None:
         if rec.trace_id in self.seqs:
             return "duplicate"
         if len(self.seqs) >= self.max_live:
             return "capacity"
+        if self.kv_tier is not None:
+            self._tier_promote(rec.prompt)
         nodes = self.radix.match(rec.prompt, max_tokens=len(rec.prompt) - 1)
         self.radix.acquire(nodes)
         hit = len(nodes) * self.block_size
@@ -292,10 +381,23 @@ class ToyBackend:
         """Export the longest locally-cached chain prefixing ``tokens``
         as a kind="prefix" bundle (or None on a miss). No pin outlives
         this call: payloads are chain-derived, the importer adopts a
-        copy."""
+        copy. With a KV tier attached, a tier-resident chain DEEPER
+        than the radix's serves the export instead — one replica's
+        host-RAM/NVMe tier can warm another replica's HBM (the digest
+        union best_digest_peer matches on)."""
         from ..inference.migration import toy_prefix_bundle
 
         nodes = self.radix.match(tokens)
+        tier = self.kv_tier
+        if tier is not None:
+            bs = self.block_size
+            aligned = [int(t) for t in
+                       tokens[:(len(tokens) // bs) * bs]]
+            if aligned and tier.probe(chain_hashes(aligned, bs)) \
+                    > len(nodes):
+                bundle = tier.extract(aligned, bs)
+                if bundle is not None and bundle.n_full > len(nodes):
+                    return bundle
         if not nodes:
             return None
         return toy_prefix_bundle(
@@ -505,6 +607,13 @@ class ToyBackend:
     def digest_version(self) -> int:
         return self.radix.version
 
+    def tier_digest(self, max_entries: int = 4096) -> list[int]:
+        return [] if self.kv_tier is None \
+            else self.kv_tier.residency_digest(max_entries)
+
+    def tier_version(self) -> int:
+        return 0 if self.kv_tier is None else self.kv_tier.version
+
     # -- versioned weight hot-swap (serving/deploy.py) -------------------
     def swap_weights(self, ckpt: str | None, tag: str | None,
                      wid: int) -> tuple[str | None, dict | None]:
@@ -569,9 +678,13 @@ class ToyBackend:
         cached page — a new request must not prefill from pages the old
         weights computed — and stamp the new version so the digest
         re-ships. Live sequences keep their pins and release without
-        publishing (the ``wv`` guard in :meth:`_finish`)."""
-        self.radix.evict(len(self.radix))
+        publishing (the ``wv`` guard in :meth:`_finish`). The KV tier
+        invalidates its own stale records (never demote them — the
+        version-skew gate would refuse every promote anyway)."""
+        self.radix.evict(len(self.radix), demote=False)
         self.radix.set_weight_version(wid)
+        if self.kv_tier is not None:
+            self.kv_tier.set_weight_version(dict(self.weight_version))
 
     def degrade(self, delay_s: float) -> None:
         """Chaos hook (``swap_canary_degrade``): the canary came up
@@ -588,7 +701,7 @@ class EngineBackend:
     a survivor is bit-identical to the stream the dead replica was
     producing."""
 
-    def __init__(self, cfg: dict):
+    def __init__(self, cfg: dict, inj: FaultInjector | None = None):
         import jax                               # deferred: toy mode never
         from ..models import build_model         # pays the jax/flax import
         from ..inference.engine_v2 import InferenceEngineV2
@@ -600,6 +713,18 @@ class EngineBackend:
         ecfg.setdefault("num_blocks", 128)
         ecfg.setdefault("max_seqs", 4)
         ecfg.setdefault("max_seq_len", 512)
+        tier_cfg = _slot_tier_cfg(cfg) if cfg.get("kv_tier") else None
+        if tier_cfg:
+            # KV tiering rides the engine's own config surface (the
+            # tier lives under the engine's prefix cache)
+            ecfg.setdefault("kv_tier", True)
+            ecfg.setdefault("prefix_cache", True)
+            for src, dst in (("ram_bytes", "kv_tier_ram_bytes"),
+                             ("nvme_dir", "kv_tier_nvme_dir"),
+                             ("nvme_bytes", "kv_tier_nvme_bytes"),
+                             ("min_pages", "kv_tier_min_pages")):
+                if src in tier_cfg:
+                    ecfg.setdefault(dst, tier_cfg[src])
         if str(cfg.get("role", "mixed")) == "prefill":
             # a prefill-role replica hands each sequence off right after
             # its first sampled token: a multi-token decode window would
@@ -624,6 +749,19 @@ class EngineBackend:
         self.migrations_out = 0
         self.migrations_in = 0
         self.pulled_pages = 0
+        if self.kv_tier is not None and inj is not None:
+            # the tier's fault points (tier_torn_spill /
+            # tier_crash_mid_demote) arm from the replica's per-slot
+            # injector, like every other chaos point
+            self.kv_tier.inj = inj
+
+    @property
+    def kv_tier(self):
+        return self.eng._kv_tier
+
+    @property
+    def tier_promotes(self) -> int:
+        return int(self.eng.stats.get("kv_tier_promotes", 0))
 
     @property
     def weight_version(self) -> dict:
@@ -801,13 +939,24 @@ class EngineBackend:
     def kv_export(self, tokens: list[int]):
         """Longest locally-cached chain prefixing ``tokens`` as a
         kind="prefix" bundle (device gather under a gather-scoped pin);
-        None on a miss."""
+        None on a miss. A deeper tier-resident chain serves the export
+        straight from the host tier — no device gather at all."""
         from ..inference.migration import MigrationError
 
         try:
-            return self.eng.export_prefix([int(t) for t in tokens])
+            bundle = self.eng.export_prefix([int(t) for t in tokens])
         except (MigrationError, RuntimeError):
-            return None
+            bundle = None
+        tier = self.kv_tier
+        if tier is not None:
+            bs = self.eng.config.block_size
+            aligned = [int(t) for t in tokens[:(len(tokens) // bs) * bs]]
+            have = bundle.n_full if bundle is not None else 0
+            if aligned and tier.probe(chain_hashes(aligned, bs)) > have:
+                tb = tier.extract(aligned, bs)
+                if tb is not None and tb.n_full > have:
+                    return tb
+        return bundle
 
     def adopt_prefix(self, bundle) -> int:
         """Scatter a pulled chain into the pool + trie through the
@@ -926,6 +1075,12 @@ class EngineBackend:
     def digest_version(self) -> int:
         return self.eng.prefix_cache_version()
 
+    def tier_digest(self, max_entries: int = 4096) -> list[int]:
+        return self.eng.kv_tier_digest(max_entries) or []
+
+    def tier_version(self) -> int:
+        return self.eng.kv_tier_version()
+
     # -- versioned weight hot-swap (serving/deploy.py) -------------------
     def swap_weights(self, ckpt: str | None, tag: str | None,
                      wid: int) -> tuple[str | None, dict | None]:
@@ -950,13 +1105,81 @@ class EngineBackend:
         self._degrade_s = float(delay_s)
 
 
-def _build_backend(cfg: dict):
+def _build_backend(cfg: dict, inj: FaultInjector | None = None):
     kind = cfg.get("backend", "toy")
     if kind == "toy":
-        return ToyBackend(cfg)
+        return ToyBackend(cfg, inj)
     if kind == "engine":
-        return EngineBackend(cfg)
+        return EngineBackend(cfg, inj)
     raise ValueError(f"unknown replica backend {kind!r}")
+
+
+def _sync_tier_metrics(telem, backend, last: dict) -> None:
+    """Fold the backend's KV-tier stats into the telemetry registry at
+    heartbeat cadence: residency gauges set absolute, counters inc by
+    delta since the last sync (``last`` carries the high-water marks, so
+    one emission site serves toy AND engine backends without double
+    counting), and the promote-latency list drains into its histogram.
+    One dict lookup + early return when there is no tier or telemetry —
+    the zero-overhead-when-off property every telemetry hook keeps."""
+    tier = getattr(backend, "kv_tier", None)
+    if telem is None or tier is None:
+        return
+    st = tier.stats()
+    reg = telem.registry
+    for sub in ("ram", "nvme"):
+        reg.gauge("serving_kv_tier_resident_bytes", labels={"tier": sub},
+                  help="payload bytes resident in this KV tier").set(
+            st[f"{sub}_bytes"])
+        reg.gauge("serving_kv_tier_pages", labels={"tier": sub},
+                  help="KV pages resident in this tier").set(
+            st[f"{sub}_pages"])
+    def _delta(key: str) -> int:
+        cur = int(st.get(key, 0))
+        d = cur - last.get(key, 0)
+        last[key] = cur
+        return max(d, 0)
+
+    # literal metric names at the call sites — bin/check_metric_names.py
+    # reads them for the sanitizer gate and the docs/METRICS.md drift
+    # lint, so the family names must never hide behind a variable
+    d = _delta("demoted_pages")
+    if d:
+        reg.counter("serving_kv_tier_demotes_total",
+                    help="pages demoted from the HBM radix into the "
+                         "host-RAM/NVMe tier").inc(d)
+    d = _delta("promotes")
+    if d:
+        reg.counter("serving_kv_tier_promotes_total",
+                    help="chains promoted from the tier instead of "
+                         "recomputed (admission misses + peer "
+                         "exports)").inc(d)
+    d = _delta("probe_hits")
+    if d:
+        reg.counter("serving_kv_tier_hits_total",
+                    help="tier probes that found a promotable "
+                         "chain").inc(d)
+    d = _delta("torn_skipped")
+    if d:
+        reg.counter("serving_kv_tier_torn_skipped_total",
+                    help="torn/truncated spill records detected and "
+                         "skipped (crash mid-demote recovery)").inc(d)
+    for reason, cur in st.get("fallbacks", {}).items():
+        k = f"fb_{reason}"
+        d = int(cur) - last.get(k, 0)
+        if d > 0:
+            reg.counter("serving_kv_tier_fallbacks_total",
+                        labels={"reason": reason},
+                        help="tier promotes that degraded to recompute, "
+                             "by reason").inc(d)
+        last[k] = int(cur)
+    if tier.promote_latencies:
+        hist = reg.histogram("serving_kv_tier_promote_latency_s",
+                             help="wall time of a tier promote (extract "
+                                  "+ adopt + scatter)")
+        for dt in tier.promote_latencies:
+            hist.observe(dt)
+        tier.promote_latencies.clear()
 
 
 def _cleanup_shm(ring, readers: dict) -> None:
@@ -1035,7 +1258,7 @@ class DaemonState:
             time.sleep(float(v))
         if self.inj.countdown("replica_crash_on_start"):
             self.inj.crash_now("replica_crash_on_start", "replica startup")
-        self.backend = _build_backend(cfg)
+        self.backend = _build_backend(cfg, self.inj)
         if cfg.get("ckpt"):
             # the fleet's deployed version: a replica (re)spawned mid- or
             # post-deploy loads the SAME verified checkpoint the template
@@ -1225,6 +1448,8 @@ def serve(cfg: dict, chan: LineChannel,
     attempts = st.attempts               # rid -> router attempt nonce
     last_hb = 0.0
     digest_ver_sent = -1                 # first heartbeat always ships it
+    tier_ver_sent = -1                   # KV-tier residency, same scheme
+    tier_stat_marks: dict = {}           # telemetry delta-sync marks
     stall_until = 0.0
     stalled: list[dict] = []             # stream msgs queued during a stall
     # fleet tracing (telemetry/fleettrace.py): record per-request
@@ -1677,8 +1902,10 @@ def serve(cfg: dict, chan: LineChannel,
                 _send({"t": "resync_ok",
                        "reqs": st.resync_inventory(), "role": role,
                        "wv": dict(backend.weight_version),
-                       "digest": backend.digest(digest_max)})
+                       "digest": backend.digest(digest_max),
+                       "tier_digest": backend.tier_digest(digest_max)})
                 digest_ver_sent = backend.digest_version()
+                tier_ver_sent = backend.tier_version()
             elif t == "re_adopt":
                 # the restarted router re-owns this request under a
                 # fresh attempt nonce: clear its orphan deadline, resume
@@ -1758,6 +1985,12 @@ def serve(cfg: dict, chan: LineChannel,
                     chan.send({"t": "bye"}, timeout=1.0)
                 except (ChannelClosed, ChannelTimeout):
                     pass                 # router already gone: exit anyway
+                tier = getattr(backend, "kv_tier", None)
+                if tier is not None:
+                    # graceful exit: spill the RAM ring so a restarted
+                    # replica's tier reopens warm (a crash loses exactly
+                    # the RAM tier; the spill's scan gate covers the rest)
+                    tier.close(flush=True)
                 _cleanup_shm(ring, readers)
                 return 0
 
@@ -1867,8 +2100,16 @@ def serve(cfg: dict, chan: LineChannel,
             if ver != digest_ver_sent:
                 hb["digest"] = backend.digest(digest_max)
                 digest_ver_sent = ver
+            # KV-tier residency rides the same ship-on-change scheme:
+            # the router's pull-vs-promote-vs-recompute cost model needs
+            # to know what the tier could serve locally
+            tver = backend.tier_version()
+            if tver != tier_ver_sent:
+                hb["tier_digest"] = backend.tier_digest(digest_max)
+                tier_ver_sent = tver
             _send(hb)
             if telem is not None:
+                _sync_tier_metrics(telem, backend, tier_stat_marks)
                 telem.write_snapshot(snap_path)
 
 

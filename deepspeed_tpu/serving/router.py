@@ -61,7 +61,7 @@ from .disagg import (DECODE_CAPABLE, MigrationState, PREFILL_CAPABLE,
                      RebalancePolicy, ScaleAdvisor, role_of)
 from .fleet import DRAINING, Fleet, FleetConfig, QUARANTINED, READY
 from .placement import (StickyMap, best_digest_peer, chain_hashes,
-                        pick_replica, pull_beats_recompute)
+                        match_pages, pick_replica, plan_kv_source)
 from .protocol import ChannelClosed, RequestRecord, poll_channels
 
 #: terminal request states
@@ -130,6 +130,24 @@ class RouterConfig:
     kv_pull_relay_bytes_s: float = 64e6
     kv_pull_shm_bytes_s: float = 2e9
     kv_pull_overhead_s: float = 0.02
+    #: KV tiering (inference/kvtier.py): per-tier byte rates for the
+    #: pull-vs-LOCAL-TIER-PROMOTE-vs-recompute decision
+    #: (placement.plan_kv_source) — a placed replica whose host-RAM/
+    #: NVMe tier already holds the chain promotes it locally instead of
+    #: paying a cross-replica pull. None = seed from the startup
+    #: micro-probe (kv_rate_probe) or the CPU-guessed fallbacks
+    #: (kvtier.GUESS_*); an explicit value always wins. All the
+    #: ``kv_pull_*`` rate constants above are config-overridable the
+    #: same way (router CLI cfg json included).
+    kv_tier_ram_bytes_s: float | None = None
+    kv_tier_nvme_bytes_s: float | None = None
+    #: measure host-RAM and spill-read bandwidth at router startup
+    #: (kvtier.measure_tier_rates — a few MB, a few ms) to seed the
+    #: unset per-tier rates; False pins the guessed fallbacks
+    kv_rate_probe: bool = True
+    #: directory the NVMe-rate micro-probe touches (it writes + reads a
+    #: few MB); None probes RAM only and guesses the NVMe rate
+    kv_rate_probe_dir: str | None = None
     #: transfer-buffer GC: a buffered bundle/pull whose importer never
     #: settles is dropped (and the migration failed) after this long
     migration_buffer_ttl_s: float = 60.0
@@ -278,6 +296,34 @@ class Router:
         self.migration_fallbacks = 0
         self.kv_pulls = 0
         self.kv_pull_fallbacks = 0
+        #: placements where the cost model chose a LOCAL TIER PROMOTE
+        #: over a cross-replica pull (the placed replica's host-RAM/
+        #: NVMe tier already held the chain — kvtier.py)
+        self.kv_tier_locals = 0
+        # resolve the per-tier rates the cost model runs on: explicit
+        # config wins, else the startup micro-probe, else the guessed
+        # fallbacks (kv_pull satellite: the constants were CPU-guessed)
+        from ..inference.kvtier import (GUESS_NVME_BYTES_S,
+                                        GUESS_RAM_BYTES_S,
+                                        measure_tier_rates)
+        ram_s, nvme_s = (self.cfg.kv_tier_ram_bytes_s,
+                         self.cfg.kv_tier_nvme_bytes_s)
+        # the probe only pays off when some replica actually HAS a tier
+        # (the rates' one consumer is plan_kv_source's tier leg) — a
+        # tierless fleet must not spend startup time measuring it
+        fleet_cfg = self.cfg.fleet
+        tiered = bool((fleet_cfg.replica or {}).get("kv_tier")) or any(
+            (s or {}).get("kv_tier")
+            for s in (fleet_cfg.per_slot or {}).values())
+        if (ram_s is None or nvme_s is None) and self.cfg.kv_rate_probe \
+                and tiered:
+            probed = measure_tier_rates(self.cfg.kv_rate_probe_dir)
+            ram_s = probed["ram_bytes_s"] if ram_s is None else ram_s
+            nvme_s = probed["nvme_bytes_s"] if nvme_s is None else nvme_s
+        self._kv_rates = {
+            "ram": ram_s if ram_s is not None else GUESS_RAM_BYTES_S,
+            "nvme": nvme_s if nvme_s is not None else GUESS_NVME_BYTES_S,
+        }
         self.rebalances = 0
         #: cross-version KV transfers refused by the skew guard, by path
         self.version_skews = 0
@@ -553,6 +599,9 @@ class Router:
         if "digest" in msg:
             d = msg["digest"]
             h.digest = set(d) if d else None
+        if "tier_digest" in msg:
+            d = msg["tier_digest"]
+            h.tier_digest = set(d) if d else None
         h.role = str(msg.get("role", h.role))
         if "wv" in msg:
             self._note_wv(h, msg.get("wv"))
@@ -974,6 +1023,11 @@ class Router:
                 # (replicas version it); the router keeps its copy
                 d = msg["digest"]
                 h.digest = set(d) if d else None
+            if "tier_digest" in msg:
+                # KV-tier residency (kvtier.py), same ship-on-change
+                # scheme: what the replica could promote locally
+                d = msg["tier_digest"]
+                h.tier_digest = set(d) if d else None
             if "wv" in msg:
                 self._note_wv(h, msg.get("wv"))
             if self._ftrace is not None and "echo" in msg:
@@ -1646,6 +1700,8 @@ class Router:
                 "state": r.state, "role": role_of(r), "epoch": r.epoch,
                 "live": (r.load or {}).get("live"),
                 "digest_entries": len(r.digest) if r.digest else 0,
+                "tier_entries": len(r.tier_digest) if r.tier_digest
+                else 0,
                 "weight_version": r.wv,
                 "rtt_s": r.rtt_s, "clock_offset_s": r.clock_offset_s}
         assignments = {
@@ -1866,10 +1922,13 @@ class Router:
     # placed replica PULLS the page chain from the peer through the same
     # bundle/chunk protocol migration uses (kind="prefix" bundles, no
     # sequence, no pinned-until-ack — the importer adopts a copy).
-    # Pull-vs-recompute is a cost model (placement.pull_beats_recompute)
-    # and recompute is the always-safe fallback: the puller admits the
-    # held-back request the moment the pull fails, times out, or the
-    # router says kv_fail.
+    # Pull vs LOCAL-TIER PROMOTE vs recompute is a cost model
+    # (placement.plan_kv_source — per-transport and per-tier byte rates,
+    # seeded by the startup micro-probe) and recompute is the
+    # always-safe fallback: the puller admits the held-back request the
+    # moment the pull fails, times out, or the router says kv_fail; a
+    # "tier" decision just skips the pull and lets the placed replica's
+    # admission-path promote (kvtier.py) serve the chain.
 
     def _maybe_pull(self, req: _Req, rep, hit_pages: int):
         rep_wv = getattr(rep, "wv", None)
@@ -1898,10 +1957,35 @@ class Router:
         shm_ok = bool(peer.shm) and not rep.address and not peer.address
         rate = self.cfg.kv_pull_shm_bytes_s if shm_ok \
             else self.cfg.kv_pull_relay_bytes_s
-        if not pull_beats_recompute(
-                extra * bs, self._page_bytes, bs,
-                self.cfg.kv_pull_prefill_tok_s, rate,
-                self.cfg.kv_pull_overhead_s):
+        # three-way (placement.plan_kv_source): the placed replica's
+        # OWN KV tier (kvtier.py) may hold the chain — promoting it
+        # locally beats shipping pages across the fleet. The replica
+        # promotes on admission autonomously, so "tier" here just means
+        # DON'T start a pull (priced at the conservative NVMe rate —
+        # the router cannot see which sub-tier holds the chain).
+        tier_pages = match_pages(req.chain, getattr(rep, "tier_digest",
+                                                    None))
+        plan = plan_kv_source(
+            len(req.chain), hit_pages, pages, tier_pages,
+            self._page_bytes, bs, self.cfg.kv_pull_prefill_tok_s,
+            rate,
+            # conservative tier rate: the slower of RAM and NVMe — the
+            # router cannot see which sub-tier holds the chain, and
+            # recompute/tier are both safe while a pull burns messages
+            min(self._kv_rates["ram"], self._kv_rates["nvme"]),
+            self.cfg.kv_pull_overhead_s,
+            min_pages=self.cfg.kv_pull_min_pages)
+        if plan == "tier":
+            self.kv_tier_locals += 1
+            self._fev(req.rec.trace_id, "tier_local", pages=tier_pages)
+            if self._telem.enabled:
+                self._telem.registry.counter(
+                    "serving_router_kv_tier_locals_total",
+                    help="placements where the cost model chose a local "
+                         "KV-tier promote over a cross-replica "
+                         "pull").inc()
+            return None, 0
+        if plan != "pull":
             return None, 0
         return peer, pages
 

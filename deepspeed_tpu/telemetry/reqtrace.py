@@ -81,6 +81,14 @@ LIFECYCLE_EVENTS = (
     #                     additionally stamps each in-flight request's
     #                     fleet-trace segment so rolling-deploy stalls are
     #                     attributable per request
+    "kv_tier",          # KV tiering (inference/kvtier.py): dir="demote"
+    #                     = evicted chains serialized into the host-RAM/
+    #                     NVMe tier (a pool-level event, uid -1 — the
+    #                     reclaimed pages had no live owner), dir=
+    #                     "promote" = a tier-resident chain adopted back
+    #                     into the trie at an admission miss instead of
+    #                     recomputing (pages + tokens saved ride the
+    #                     event)
 )
 
 #: hard cap on distinct tenant label values per process — the scrape's

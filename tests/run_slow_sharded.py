@@ -63,6 +63,9 @@ HEAVY = [
     # crash-safe router: the engine-daemon crash-recovery test runs
     # THREE router incarnations over two daemon engines (each compiles)
     "test_journal.py",
+    # KV tiering: the engine demote/promote roundtrip compiles a tiny
+    # engine (gather at demote, scatter at promote, greedy parity)
+    "test_kvtier.py",
 ]
 
 

@@ -243,23 +243,36 @@ def _gen_ops(rng, n_ops):
             op = ("spec", int(rng.integers(0, 4)),
                   int(rng.integers(1, 4)), int(rng.integers(0, 5)))
         elif r < 0.89:
+            # allocation-pressure eviction; with the KV-tier sink
+            # attached (kvtier.py) every evicted current-version chain
+            # DEMOTES into the shared tier — the demote half of the
+            # demote/promote op pair
             op = ("evict", int(rng.integers(1, 5)))
-        elif r < 0.94:
+        elif r < 0.935:
             # KV-page migration A -> B: full handoff (export, import,
             # trie seed, ack, release-publish on the source)
             ops.append(("migrate", int(rng.integers(0, 6))))
             continue
-        elif r < 0.97:
+        elif r < 0.96:
             # aborted migration: stage 0 = after export (export_abort),
             # stage 1 = after the importer reserved (abort_import too)
             ops.append(("migrate_abort", int(rng.integers(0, 6)),
                         int(rng.integers(0, 2))))
             continue
-        else:
+        elif r < 0.98:
             # placement-time radix pull B <- A: snapshot_prefix pins A's
             # cached chain (audited mid-pin), adopt_prefix inserts it
             # unreferenced into B (dedup'd against B's own trie)
             ops.append(("peer_pull", int(rng.integers(len(_TEMPLATES))),
+                        int(rng.integers(1, 11))))
+            continue
+        else:
+            # KV-tier promote: extract the longest tier-resident chain
+            # (demoted by earlier evict ops), toy-verify the payloads,
+            # and adopt it into either pool through the refcounted
+            # adopt_prefix — full audit after, pool-full degrades clean
+            ops.append(("tier_promote", int(rng.integers(0, 2)),
+                        int(rng.integers(len(_TEMPLATES))),
                         int(rng.integers(1, 11))))
             continue
         if rng.random() < 0.30:
@@ -294,11 +307,26 @@ def _run_trace(ops):
     plans before ``migrate_out`` (the committed view IS the pool
     content). Both pools run a FULL ``audit()`` + stale-page walk after
     EVERY op, migration stages included."""
+    from deepspeed_tpu.inference.kvtier import KVTier, KVTierConfig
+    from deepspeed_tpu.inference.migration import toy_prefix_bundle
+
+    # one SHARED host tier behind both pools (the fleet shape): every
+    # evict op's reclaimed chains demote into it via the sink, and the
+    # tier_promote op adopts them back into either pool
+    tier = KVTier(KVTierConfig(ram_bytes=1 << 16))
+
+    def _sink(chains):
+        for tokens, _blocks in chains:
+            b = toy_prefix_bundle("", tokens, 4)
+            if b is not None:
+                tier.absorb(b)
+
     pools = []
     for _ in range(2):
         st = StateManager(num_blocks=24, block_size=4, max_seqs=4,
                           max_blocks_per_seq=8)
         st.attach_prefix_cache(PrefixCache(4))
+        st.prefix_cache.evict_sink = _sink
         pools.append({"st": st,
                       "sched": SplitFuseScheduler(st, chunk=8, pack=True),
                       "inflight": []})
@@ -441,12 +469,42 @@ def _run_trace(ops):
             stA.release_prefix(snap["handle"])
         stA.audit()
 
+    def tier_promote(op):
+        """The promote half of the KV-tier op pair: extract the longest
+        tier-resident chain for a template prompt (the demote ops'
+        output), verify the toy payload oracle, and adopt it into the
+        chosen pool through the refcounted pull surface — audited after;
+        a full pool degrades to a clean no-op (recompute fallback)."""
+        from deepspeed_tpu.inference.migration import toy_verify
+        from deepspeed_tpu.inference.prefix_cache import chain_hashes
+
+        _, pick, tmpl, pages = op
+        st = pools[pick % 2]["st"]
+        tokens = list(_TEMPLATES[tmpl][:pages * 4])
+        aligned = tokens[:(len(tokens) // 4) * 4]
+        if not aligned:
+            return
+        deep = tier.probe(chain_hashes(aligned, 4))
+        if deep == 0:
+            return
+        bundle = tier.extract(aligned[:deep * 4], 4)
+        if bundle is None:
+            return
+        toy_verify(bundle)              # payload integrity through the tier
+        try:
+            st.adopt_prefix(bundle.tokens, bundle.n_computed)
+            st.audit()
+        except RuntimeError:
+            pass                        # pool full: recompute fallback
+
     for i, op in enumerate(ops):
         try:
             if op[0] == "b":
                 apply(pools[1], op[1])
             elif op[0] == "peer_pull":
                 peer_pull(op)
+            elif op[0] == "tier_promote":
+                tier_promote(op)
             elif op[0] in ("migrate", "migrate_abort"):
                 migrate(op)
             else:
@@ -512,7 +570,8 @@ def test_interleaving_property_fast():
 @pytest.mark.slow
 def test_interleaving_property_500_plus():
     """The acceptance-criteria run: 600 seeded interleavings x 90 ops of
-    admit/dispatch/commit/flush/evict/spec/migrate/peer_pull over TWO
+    admit/dispatch/commit/flush/evict(=tier demote)/spec/migrate/
+    peer_pull/tier_promote over TWO
     pools
     (speculative provision → accept-or-rollback rounds, mid-tree
     rejections included; migrate_out/migrate_in/abort_migration at both

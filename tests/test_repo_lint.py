@@ -536,3 +536,86 @@ def test_state_invariant_detector_pins_weight_version_to_swap_api(
         "        self._weight_version = {'id': wid}\n")  # flagged
     out = state_lint.check_file(str(ok))
     assert len(out) == 1 and ":7:" in out[0]
+
+
+# --- KV tiering (inference/kvtier.py) ---------------------------------------
+
+def test_deadline_lint_covers_kvtier_waits(tmp_path):
+    """inference/kvtier.py is lint-covered even though it lives outside
+    serving/: the tier runs inside the replica event loop's admission
+    and eviction paths, so an unbounded wait there wedges heartbeats
+    exactly like a serving wait would (check_deadlines.EXTRA_FILES)."""
+    # the real tree must carry the file (a rename would silently
+    # de-cover it — EXTRA_FILES names it, this pins it exists)
+    assert os.path.isfile(os.path.join(
+        ROOT, "deepspeed_tpu", "inference", "kvtier.py"))
+    serving = tmp_path / "deepspeed_tpu" / "serving"
+    serving.mkdir(parents=True)
+    kvt = tmp_path / "deepspeed_tpu" / "inference" / "kvtier.py"
+    kvt.parent.mkdir(parents=True)
+    kvt.write_text(
+        "def read_spill(lock):\n"
+        "    lock.acquire()\n"                     # flagged: unbounded
+        "    lock.acquire(timeout=0.5)\n")         # bounded: ok
+    out = deadline_lint.check_repo(str(tmp_path))
+    assert len(out) == 1 and ":2:" in out[0] and "kvtier" in out[0]
+
+
+def test_state_invariant_detector_pins_tier_mutators(tmp_path):
+    """The KV tier's demote/promote mutators (absorb/extract/
+    set_weight_version/close) are pinned to the wrappers next to the
+    refcounted adopt API; reads (probe/has/stats/digest) stay legal
+    anywhere, and the implementation file itself is exempt."""
+    bad = tmp_path / "deepspeed_tpu" / "serving" / "router.py"
+    bad.parent.mkdir(parents=True)
+    bad.write_text(
+        "def hijack(rep, bundle):\n"
+        "    rep.kv_tier.absorb(bundle)\n"         # flagged
+        "    rep._kv_tier.extract([], 16)\n"       # alias: flagged
+        "    rep.kv_tier.probe([])\n"              # read: ok
+        "    return rep.kv_tier.stats()\n")        # read: ok
+    out = state_lint.check_file(str(bad))
+    assert len(out) == 2, "\n".join(out)
+    assert ":2:" in out[0] and "kv_tier.absorb()" in out[0]
+    assert ":3:" in out[1] and "kv_tier.extract()" in out[1]
+    # the allowlisted wrappers keep their access
+    ok = tmp_path / "deepspeed_tpu" / "inference" / "engine_v2.py"
+    ok.parent.mkdir(parents=True)
+    ok.write_text(
+        "class Engine:\n"
+        "    def _demote_evicted(self, chains):\n"
+        "        self._kv_tier.absorb(chains)\n"       # sink: ok
+        "    def _tier_promote(self, toks):\n"
+        "        return self._kv_tier.extract(toks, 16)\n"   # ok
+        "    def sneaky(self):\n"
+        "        self._kv_tier.close()\n")             # flagged
+    out = state_lint.check_file(str(ok))
+    assert len(out) == 1 and ":7:" in out[0]
+    # kvtier.py itself (the implementation) is exempt
+    impl = tmp_path / "deepspeed_tpu" / "inference" / "kvtier.py"
+    impl.write_text(
+        "class KVTier:\n"
+        "    def helper(self):\n"
+        "        self.kv_tier.absorb(None)\n")
+    assert state_lint.check_file(str(impl)) == []
+
+
+def test_state_invariant_detector_pins_evict_sink_attach(tmp_path):
+    """The prefix cache's eviction sink is the demotion hook: assigning
+    it anywhere outside the attach sites could silently redirect (or
+    drop) demotions — flagged like every other ownership mutation."""
+    bad = tmp_path / "deepspeed_tpu" / "serving" / "workload.py"
+    bad.parent.mkdir(parents=True)
+    bad.write_text(
+        "def hijack(pc):\n"
+        "    pc.evict_sink = None\n"                   # flagged
+        "    s = pc.evict_sink\n")                     # read: ok
+    out = state_lint.check_file(str(bad))
+    assert len(out) == 1 and ":2:" in out[0] and "evict_sink" in out[0]
+    ok = tmp_path / "deepspeed_tpu" / "inference" / "engine_v2.py"
+    ok.parent.mkdir(parents=True)
+    ok.write_text(
+        "class Engine:\n"
+        "    def __init__(self):\n"
+        "        self._prefix_cache.evict_sink = self._demote_evicted\n")
+    assert state_lint.check_file(str(ok)) == []
