@@ -162,6 +162,22 @@ def feature_report() -> list[tuple[str, bool, str]]:
         feats.append(("inference: KV tiering (HBM → host RAM → NVMe)",
                       False, str(e)))
 
+    # gang prefill (serving/router.py + parallel/sequence.py): one long
+    # prompt's prefill sharded across the fleet — pure host logic
+    try:
+        from .serving.placement import plan_gang_prefill as _pgp  # noqa: F401
+        feats.append((
+            "serving: gang prefill (fleet-sharded prompts)", True,
+            "RouterConfig.gang_prefill — long prompts split page-"
+            "aligned across K prefill-role replicas, merged KV staged "
+            "member-to-member over kind=\"prefix\" bundles, first "
+            "token on the final member; cost-model gated, any failure "
+            "collapses to single-replica (bit-identical); "
+            "BENCH_MODE=gang_prefill"))
+    except Exception as e:  # pragma: no cover — import breakage only
+        feats.append(("serving: gang prefill (fleet-sharded prompts)",
+                      False, str(e)))
+
     # zero-downtime weight deploys (serving/deploy.py): rolling hot-swap
     # behind the router — pure host logic, availability is an import check
     try:

@@ -2889,6 +2889,30 @@ class InferenceEngineV2:
         self.stats[key] = self.stats.get(key, 0) + bundle.payload_bytes
         return bundle.n_full
 
+    def gang_prefill_segment(self, uid: int, tokens,
+                             prefix_bundle: "PageBundle | None" = None,
+                             max_new_tokens: int = 1,
+                             trace_id: str | None = None) -> int:
+        """One gang-prefill member's leg (serving/router.py gang_seg):
+        adopt the merged chain from the upstream hop FIRST — the same
+        refcounted ``import_prefix`` path cross-replica pulls ride —
+        then admit ``tokens``. Admission's radix match skips every
+        adopted page, so this engine computes exactly its own segment
+        of the prompt (the math of parallel.sequence.
+        gang_segment_attention, realized here as prefix-hit + ragged
+        prefill over the tail). Member 0 passes no bundle; the FINAL
+        member passes the full prompt with ``max_new_tokens=1`` to
+        sample the first token on the fully-merged chain, after which
+        decode handoff uses the ordinary export_prefix machinery.
+        Returns pages adopted from upstream (0 for member 0); raises
+        MigrationError on skew/geometry mismatch without admitting."""
+        pages = 0
+        if prefix_bundle is not None:
+            pages = self.import_prefix(prefix_bundle, source="pull")
+        self.put(uid, list(tokens), max_new_tokens=max_new_tokens,
+                 trace_id=trace_id)
+        return pages
+
     # ------------------------------------------------------------------
     # KV tiering (inference/kvtier.py): HBM → host RAM → NVMe under the
     # radix. _demote_evicted is the PrefixCache eviction sink (installed

@@ -395,6 +395,7 @@ class KVTier:
         self.dropped_pages = 0           # ring overflow with no spill
         self.promotes = 0
         self.promoted_pages = 0
+        self.promote_ahead_pages = 0     # prefetch(): NVMe → RAM staging
         self.probe_hits = 0
         self.probe_misses = 0
         self.fallbacks: dict[str, int] = {}
@@ -537,6 +538,53 @@ class KVTier:
             self.probe_misses += 1
         return n
 
+    def prefetch(self, chain: list[int]) -> int:
+        """Promote-AHEAD: stage the chain's NVMe-resident records up
+        into the host-RAM ring while the caller is waiting on something
+        slower (the replica holds a put while its peer pull is in
+        flight — that network wait is free time to move local bytes one
+        tier up). No bundle is built and nothing is adopted; the only
+        effect is that a later :meth:`extract` of the same chain reads
+        at RAM rate instead of paying per-page NVMe opens. Walks
+        contiguous-from-root like :meth:`probe` and stops at the first
+        gap, skew, or torn record — every failure is the usual counted
+        degrade (the record simply stays where it was, or drops on a
+        crc fail exactly as a promote would have dropped it). Returns
+        pages staged RAM-ward."""
+        n = 0
+        hits: list[int] = []
+        for h in chain:
+            if self.ring.peek(h) is not None:
+                hits.append(h)            # already hot: nothing to stage
+                continue
+            if self.spill is None or h not in self.spill:
+                break
+            ent = self.spill.read(h)
+            if ent is None:               # counted + dropped by read()
+                self._fallback("crc")
+                break
+            meta, payload = ent
+            if version_skew(meta.get("wv"), self._wv):
+                self._fallback("version_skew")
+                break
+            # the record MOVES (same single-copy rule as extract's
+            # NVMe branch): pop the spill entry so a later ring
+            # eviction re-spills exactly one copy
+            self.spill.pop(h)
+            for oh, om, op in self.ring.put(h, meta, payload):
+                self._respill(oh, om, op)
+            hits.append(h)
+            n += 1
+        # recency DEEPEST first (extract's rule): the root must end
+        # newest so ring eviction keeps trimming from the deep end and
+        # residency stays contiguous-from-root
+        for h in reversed(hits):
+            self.ring.touch(h)
+        if n:
+            self.promote_ahead_pages += n
+        self._note_loss()
+        return n
+
     def extract(self, tokens, block_size: int,
                 trace_id: str = "") -> PageBundle | None:
         """Rebuild the longest tier-resident chain prefixing ``tokens``
@@ -642,6 +690,7 @@ class KVTier:
             "dropped_pages": self.dropped_pages,
             "promotes": self.promotes,
             "promoted_pages": self.promoted_pages,
+            "promote_ahead_pages": self.promote_ahead_pages,
             "probe_hits": self.probe_hits,
             "probe_misses": self.probe_misses,
             "fallbacks": dict(self.fallbacks),

@@ -77,7 +77,12 @@ class PageBundle:
     #: handoff / rebalance: resumes decoding on the importer); "prefix" =
     #: a bare cached page chain (placement-time radix pull: the importer
     #: seeds its trie and the arriving request prefills from it — no
-    #: sequence exists, so every token is computed and page-aligned)
+    #: sequence exists, so every token is computed and page-aligned).
+    #: Gang prefill's member-to-member KV hops (``serving/router.py``)
+    #: ride ``"prefix"`` too: each hop bundles the merged chain so far,
+    #: and ``chain`` carries the full-prompt chain hashes so the next
+    #: member's radix match skips exactly the adopted pages — the merge
+    #: is bit-identical by construction, no new wire form needed.
     kind: str = "seq"
     #: the weight version the pages were computed under —
     #: ``{"id": monotonic int, "digest": manifest digest}`` — stamped at

@@ -418,7 +418,10 @@ class PrefixCache:
         surrendered duplicate blocks)``; the caller (StateManager
         ``import_commit`` — the only legal caller, see
         bin/check_state_invariants.py) points the sequence's table front
-        at the nodes and frees the duplicates."""
+        at the nodes and frees the duplicates. Gang-prefill hops land
+        here too (``engine_v2.import_prefix`` → ``adopt_prefix``): the
+        upstream members' segment pages are adopted before the member
+        prefills its own segment on top of them."""
         bs = self.block_size
         n_full = min(n_tokens, len(tokens)) // bs
         if n_full > len(blocks):

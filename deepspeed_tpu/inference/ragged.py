@@ -745,8 +745,12 @@ class StateManager:
     # A request placed on a replica WITHOUT its prefix can pull the page
     # chain from the peer that holds it instead of recomputing it
     # (serving/router.py decides pull-vs-recompute; the wire form is a
-    # kind="prefix" PageBundle). These three methods are the refcounted
-    # surface for both legs — bin/check_state_invariants.py pins every
+    # kind="prefix" PageBundle). Gang prefill reuses both legs verbatim:
+    # each member exports its merged chain (snapshot_prefix), the next
+    # member adopts it (adopt_prefix) and prefills only its own segment
+    # on top — the prompt's KV grows member-to-member with no new state
+    # machinery here. These three methods are the refcounted surface for
+    # both legs — bin/check_state_invariants.py pins every
     # trie/allocator mutation they need to exactly these sites.
 
     def snapshot_prefix(self, tokens, trace: str | None = None) -> dict | None:

@@ -18,6 +18,10 @@ deepspeed/sequence/cross_entropy.py. Two idioms are provided:
      rotating around the `seq` axis via ``ppermute`` — the long-context path
      the reference does NOT have (SURVEY §2.3: no ring/context parallelism
      upstream); comm rides ICI neighbor links and overlaps with compute.
+   - ``gang_segment_attention``: the same blockwise algebra for ONE
+     contiguous segment of a prompt whose earlier segments' KV was adopted
+     from another replica — the engine-level math under serving gang
+     prefill (serving/router.py), where the "ring" is the fleet itself.
    - ``vocab_parallel_cross_entropy``: stable CE over vocab-sharded logits.
 """
 from __future__ import annotations
@@ -170,6 +174,71 @@ def ring_attention(q, k, v, mesh, *, axis: str = "seq", causal: bool = True,
         mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec,
         check_vma=False)
     return fn(q, k, v)
+
+
+# ---------------------------------------------------------------------------
+# Gang-prefill segment attention (context parallelism across a FLEET)
+# ---------------------------------------------------------------------------
+
+def gang_segment_attention(q, k_prefix, v_prefix, k_own, v_own, *,
+                           scale: float | None = None, block: int = 512):
+    """Causal attention for ONE gang-prefill segment — context
+    parallelism where the "devices" are serving replicas and the
+    "rotation" is the staged KV hop between them (serving/router.py
+    gang prefill).
+
+    ``q``: [B, S_seg, H, D], the segment's queries. ``k_prefix`` /
+    ``v_prefix``: [B, S_pre, KV, D], KV for every EARLIER segment
+    (adopted from the upstream hop; S_pre may be 0 — gang member 0).
+    ``k_own`` / ``v_own``: [B, S_seg, KV, D], this segment's KV.
+    Segments are contiguous, so every prefix key strictly precedes
+    every query: the prefix blocks fold in unmasked and only the own
+    block carries a causal mask. Blockwise online softmax in fp32 —
+    the exact ``_ring_body`` algebra with the ring replaced by a
+    prefix walk — so the result equals rows [S_pre, S_pre + S_seg) of
+    full causal attention over the concatenated sequence, bit-exactly
+    in fp32. GQA folds H into KV groups like the ring path.
+    """
+    B, S, H, D = q.shape
+    KV = k_own.shape[2]
+    G = H // KV
+    if H % KV:
+        raise ValueError(f"heads {H} not divisible by kv heads {KV}")
+    if scale is None:
+        scale = 1.0 / (D ** 0.5)
+    qg = q.astype(jnp.float32).reshape(B, S, KV, G, D)
+
+    m = jnp.full((B, KV, G, S, 1), NEG_INF, jnp.float32)
+    l = jnp.zeros((B, KV, G, S, 1), jnp.float32)
+    acc = jnp.zeros((B, KV, G, S, D), jnp.float32)
+
+    def fold(carry, k_blk, v_blk, allow):
+        m, l, acc = carry
+        s = jnp.einsum("bqhgd,bkhd->bhgqk", qg,
+                       k_blk.astype(jnp.float32)) * scale
+        if allow is not None:
+            s = jnp.where(allow[None, None, None], s, NEG_INF)
+        m_cur = jnp.max(s, axis=-1, keepdims=True)
+        m_new = jnp.maximum(m, m_cur)
+        # guard fully-masked rows (exp(NEG_INF - NEG_INF) would be 1)
+        p = jnp.where(s == NEG_INF, 0.0, jnp.exp(s - m_new))
+        alpha = jnp.where(m == NEG_INF, 0.0, jnp.exp(m - m_new))
+        l = alpha * l + jnp.sum(p, axis=-1, keepdims=True)
+        acc = acc * alpha + jnp.einsum("bhgqk,bkhd->bhgqd", p,
+                                       v_blk.astype(jnp.float32))
+        return m_new, l, acc
+
+    S_pre = 0 if k_prefix is None else k_prefix.shape[1]
+    carry = (m, l, acc)
+    for lo in range(0, S_pre, block):
+        hi = min(lo + block, S_pre)
+        carry = fold(carry, k_prefix[:, lo:hi], v_prefix[:, lo:hi], None)
+    allow = jnp.arange(S)[None, :] <= jnp.arange(S)[:, None]   # [S_q, S_k]
+    m, l, acc = fold(carry, k_own, v_own, allow)
+
+    l_safe = jnp.where(l == 0.0, 1.0, l)
+    out = (acc / l_safe).astype(q.dtype)                 # [B,KV,G,S,D]
+    return out.transpose(0, 3, 1, 2, 4).reshape(B, S, H, D)
 
 
 # ---------------------------------------------------------------------------

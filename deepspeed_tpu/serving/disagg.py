@@ -31,6 +31,14 @@ primitive (``inference/migration.py``):
 :class:`ScaleAdvisor` closes the loop operationally: per-role
 scale-up/down **hints** (gauges only, no actuator) derived from the
 router's queue-wait estimate and the per-role replica load summaries.
+
+Gang prefill (``router.py`` ``_maybe_gang``) is a second consumer of the
+role split: a single long prompt is sharded page-aligned across several
+*prefill-capable* replicas (``role_of`` decides eligibility, exactly as
+for placement), each member prefills its segment concurrently, and the
+merged KV lands on the final member via the same ``kind="prefix"``
+bundle hops — so one prompt's TTFT scales with the prefill pool instead
+of a single replica's throughput.
 """
 from __future__ import annotations
 

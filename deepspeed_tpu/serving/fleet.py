@@ -200,7 +200,19 @@ class ReplicaHandle:
             return False
         grace = self.fcfg.ready_timeout_s if self.state == SPAWNING \
             else hb_timeout
-        return now - self.last_msg_t <= grace
+        if now - self.last_msg_t <= grace:
+            return True
+        # Heartbeat-silence race: ``last_msg_t`` advances only when the
+        # ROUTER consumes a message, and maintain() runs BEFORE the
+        # channel drain each poll tick. A router stalled past
+        # ``hb_timeout`` (CPU contention, a long relay burst) must not
+        # reap a healthy replica whose heartbeats sit unread in the pipe
+        # — unread input is proof of life. The drain that follows
+        # refreshes ``last_msg_t`` from the messages themselves.
+        if self.chan.pending():
+            self.last_msg_t = now
+            return True
+        return False
 
     def kill(self) -> None:
         """Hard-stop the incarnation (wedged or superseded). Bounded:

@@ -204,6 +204,61 @@ def pull_beats_recompute(extra_tokens: int, page_bytes: int,
     return xfer_s < prefill_s
 
 
+def gang_segments(chain_pages: int, k: int) -> list[int]:
+    """Page-aligned cumulative segment ends for a gang of ``k``: member
+    ``i`` prefills pages ``[ends[i-1] .. ends[i])`` (``ends[0]`` from
+    page 0; ``ends[-1] == chain_pages``). A chain too short for ``k``
+    members yields fewer ends — the caller gangs with ``len(ends)``."""
+    seg = -(-max(chain_pages, 0) // max(k, 1))
+    ends, e = [], 0
+    while e < chain_pages:
+        e = min(e + seg, chain_pages)
+        ends.append(e)
+    return ends
+
+
+def plan_gang_prefill(chain_pages: int, hit_pages: int, k_max: int,
+                      page_bytes: int, block_size: int,
+                      prefill_tok_s: float, xfer_bytes_s: float,
+                      overhead_s: float = 0.0) -> int:
+    """Gang-of-K vs single-replica prefill wall-clock: returns the best
+    K, or 1 when no gang strictly beats prefilling on one replica.
+
+    The gang splits the page-aligned prompt chain into K contiguous
+    segments; every member prefills its OWN segment concurrently
+    (segment KV depends causally only on EARLIER segments — the members
+    attend over adopted prefix pages plus their own), then the merged
+    root-contiguous chain grows member to member in K-1 staged hops,
+    hop i shipping pages ``[0 .. end_i)`` forward::
+
+        single  = (chain_pages - hit_pages) * bs / prefill_tok_s
+        gang(K) = ceil(chain_pages / K) * bs / prefill_tok_s
+                  + sum_i xfer(end_i)            # K-1 relay hops
+
+    The estimate deliberately ignores the final pinned put's tail
+    prefill (at most one partial page plus the last token — identical
+    under both plans) and prices hops with the SAME
+    :func:`transfer_time` model pulls use, so the probe/constant rates
+    feed both decisions. ``hit_pages`` (the best single-replica digest
+    hit) only strengthens the single plan: a prompt the fleet has
+    mostly cached must never gang."""
+    if chain_pages <= 0 or k_max < 2:
+        return 1
+    bs = max(block_size, 1)
+    tok_s = max(prefill_tok_s, 1e-9)
+    best_k, best_t = 1, (chain_pages - hit_pages) * bs / tok_s
+    for k in range(2, min(k_max, chain_pages) + 1):
+        ends = gang_segments(chain_pages, k)
+        t = (ends[0] if len(ends) < 2 else max(
+            e - s for s, e in zip([0] + ends, ends))) * bs / tok_s
+        for end_i in ends[:-1]:
+            t += transfer_time(end_i, page_bytes, xfer_bytes_s,
+                               overhead_s)
+        if t < best_t:
+            best_k, best_t = len(ends), t
+    return best_k
+
+
 def pick_replica(candidates: list, chain: list[int],
                  sticky: StickyMap | None = None) -> tuple[object, int]:
     """Choose a replica for a request whose prompt chain is ``chain``.

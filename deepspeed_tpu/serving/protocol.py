@@ -63,6 +63,23 @@ Message vocabulary (``t`` is the type tag)::
                                             on the shm transport)
     {"t":"kv_fail","id":str}                pull dead: admit the held
                                             request and recompute
+    {"t":"gang_seg","id":str,"a":int,"seg":int,"k":int,"tok":[int],
+     "own":int,"pull":{...}?}               gang prefill (router->member
+                                            ``seg`` of ``k``): prefill
+                                            the LAST ``own`` tokens of
+                                            ``tok`` as one segment of a
+                                            sharded long-prompt prefill;
+                                            "pull" means the upstream
+                                            KV chain (everything before
+                                            the segment) arrives via the
+                                            kv_bundle machinery under
+                                            the same gang id — publish
+                                            only after adopting it
+    {"t":"gang_abort","id":str}             the gang collapsed (a member
+                                            died/refused/timed out):
+                                            drop the gang job; pages
+                                            already published stay (they
+                                            are ordinary valid cache)
     {"t":"resync"}                          crash-safe router (journal.py):
                                             a restarted router asks what
                                             this replica still holds —
@@ -141,6 +158,18 @@ Message vocabulary (``t`` is the type tag)::
                                             recompute fallback engaged)
     {"t":"kv_none","id":str,"a":int}        chain not cached here (pull
                                             export miss)
+    {"t":"gang_seg_ok","id":str,"a":int,"seg":int,"pages":int}  this
+                                            gang member finished its
+                                            segment AND adopted the
+                                            upstream chain: it now holds
+                                            ``pages`` root-contiguous
+                                            KV pages of the prompt
+    {"t":"gang_seg_fail","id":str,"a":int,"reason":str}  the member
+                                            refused (capacity, draining,
+                                            version_skew) or its segment
+                                            died — the router collapses
+                                            the gang to single-replica
+                                            prefill on a survivor
     {"t":"swap_ok","wid":int,"wv":{...},"quiesce_s":float,
      "swap_s":float}                        weight swap committed: the
                                             new version serves, with the

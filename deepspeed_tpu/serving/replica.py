@@ -130,6 +130,13 @@ class ToyBackend:
         self.migrations_out = 0
         self.migrations_in = 0
         self.pulled_pages = 0              # radix pages adopted via pulls
+        #: gang prefill (fleet-sharded prompt prefill): gid -> job. A
+        #: member prefills ONE contiguous segment of a long prompt;
+        #: downstream members publish their merged chain only after the
+        #: upstream hop's pages are adopted (adopt_prefix under the
+        #: same "g:"-prefixed id). Jobs never sample — the router's
+        #: pinned put after the merge owns the stream.
+        self._gang_jobs: dict[str, dict] = {}
         #: KV tiering (inference/kvtier.py): eviction from this
         #: backend's radix demotes chains into a host-RAM/NVMe tier
         #: (toy payloads are chain-derived, so the multiprocess suite
@@ -144,7 +151,7 @@ class ToyBackend:
             self.radix.evict_sink = self._demote_evicted
 
     def has_work(self) -> bool:
-        return bool(self.seqs)
+        return bool(self.seqs) or bool(self._gang_jobs)
 
     # -- KV tiering (demote on evict / promote on admission miss) --------
     def _demote_evicted(self, chains) -> None:
@@ -228,6 +235,41 @@ class ToyBackend:
         self.order.append(rec.trace_id)
         return None
 
+    # -- gang prefill (fleet-sharded prompt prefill) ---------------------
+    def gang_put(self, gid: str, tokens: list[int], own: int,
+                 wait_upstream: bool) -> str | None:
+        """Admit one gang segment: prefill the LAST ``own`` tokens of
+        ``tokens`` (the earlier prefix arrives as an upstream KV hop —
+        empty for member 0). Structured refusal reason or None."""
+        if gid in self._gang_jobs or gid in self.seqs:
+            return "duplicate"
+        if len(self.seqs) + len(self._gang_jobs) >= self.max_live:
+            return "capacity"
+        self._gang_jobs[gid] = {
+            "tok": [int(t) for t in tokens],
+            "own_left": max(int(own), 0),
+            "upstream": not wait_upstream,
+            "failed": None,
+            "wv": self.weight_version["id"]}
+        return None
+
+    def gang_upstream(self, gid: str, ok: bool) -> None:
+        """The upstream hop settled: pages adopted (ok) or the hop
+        failed/timed out — without them the segment cannot publish a
+        root-contiguous merged chain."""
+        job = self._gang_jobs.get(gid)
+        if job is None:
+            return
+        if ok:
+            job["upstream"] = True
+        else:
+            job["failed"] = "upstream_lost"
+
+    def gang_abort(self, gid: str) -> None:
+        """Router gave up on the gang: drop the job. Pages already
+        published stay — they are ordinary cache residency."""
+        self._gang_jobs.pop(gid, None)
+
     def cancel(self, rid: str) -> None:
         seq = self.seqs.pop(rid, None)
         if seq is None:
@@ -272,6 +314,45 @@ class ToyBackend:
         ``(rid, kind, toks, off)`` events; ``done`` events carry the FULL
         final stream (the protocol's authoritative result)."""
         events: list[tuple] = []
+        for gid in list(self._gang_jobs):
+            job = self._gang_jobs[gid]
+            if job["failed"]:
+                self._gang_jobs.pop(gid)
+                events.append((gid, "gang_fail", job["failed"], 0))
+                continue
+            if job["own_left"] > 0:
+                if inj.countdown("replica_crash_during_gang_seg"):
+                    inj.crash_now("replica_crash_during_gang_seg",
+                                  f"gang segment {gid}")
+                if self.prefill_delay_s:
+                    time.sleep(self.prefill_delay_s)
+                job["own_left"] -= min(self.prefill_chunk,
+                                       job["own_left"])
+                continue
+            if not job["upstream"]:
+                continue                 # awaiting the upstream hop
+            self._gang_jobs.pop(gid)
+            if job["wv"] != self.weight_version["id"]:
+                # a weight swap raced the gang: this segment's KV is
+                # stale under the new weights — never publish it
+                events.append((gid, "gang_fail", "version_skew", 0))
+                continue
+            tokens = job["tok"]
+            n_full = len(tokens) // self.block_size
+            try:
+                nodes, _ = self.radix.adopt(
+                    tokens,
+                    [self._fresh_block() for _ in range(n_full)],
+                    n_full * self.block_size)
+            except RuntimeError:
+                # a pinned stale-version page blocks the chain
+                events.append((gid, "gang_fail", "publish_failed", 0))
+                continue
+            self.radix.release(nodes)
+            # deliberately NO cache_pages trim: the hop export / pinned
+            # put is about to read exactly these pages — the ordinary
+            # release-path trim reclaims them later
+            events.append((gid, "gang_ok", n_full, 0))
         for rid in list(self.order):
             seq = self.seqs[rid]
             rec = seq["rec"]
@@ -1159,6 +1240,12 @@ def _sync_tier_metrics(telem, backend, last: dict) -> None:
         reg.counter("serving_kv_tier_hits_total",
                     help="tier probes that found a promotable "
                          "chain").inc(d)
+    d = _delta("promote_ahead_pages")
+    if d:
+        reg.counter("serving_kv_tier_promote_ahead_total",
+                    help="pages staged NVMe - host RAM ahead of an "
+                         "admission promote (prefetch during the "
+                         "put's pull wait)").inc(d)
     d = _delta("torn_skipped")
     if d:
         reg.counter("serving_kv_tier_torn_skipped_total",
@@ -1341,7 +1428,11 @@ class DaemonState:
         dl = now + self.orphan_deadline_s
         for rid, entry in list(self.pulls.items()):
             self.pulls.pop(rid, None)
-            self.admit_offline(entry["put"])
+            if entry.get("gang"):
+                # a gang dies with its router: fail the segment out
+                self.backend.gang_upstream(rid, ok=False)
+            else:
+                self.admit_offline(entry["put"])
         for rid in set(self.attempts) | set(self.term_buf):
             self.orphans.setdefault(rid, dl)
 
@@ -1354,7 +1445,10 @@ class DaemonState:
         for rid in [r for r, e in list(self.pulls.items())
                     if now >= e["deadline"]]:
             entry = self.pulls.pop(rid)
-            self.admit_offline(entry["put"])
+            if entry.get("gang"):
+                self.backend.gang_upstream(rid, ok=False)
+            else:
+                self.admit_offline(entry["put"])
         for rid, kind, toks, off in self.backend.step(self.inj):
             if kind == "chunk":
                 self.note_chunk(rid, off, [int(t) for t in toks])
@@ -1520,6 +1614,11 @@ def serve(cfg: dict, chan: LineChannel,
     # per-peer-ring attach results (the transport negotiation cache):
     # name -> ShmReader | None (None = attach failed, relay forever)
     readers = st.readers
+    # gang prefill, member leg: gid -> segment index (echoed in
+    # gang_seg_ok). Deliberately NOT on the daemon state: a gang dies
+    # with its router — on disconnect the pull deadline settles the
+    # upstream wait and the job fails out locally.
+    gang_meta: dict[str, int] = {}
 
     def _send(msg: dict) -> bool:
         """Protocol send that survives a dead router: on failure, drain
@@ -1644,14 +1743,20 @@ def serve(cfg: dict, chan: LineChannel,
     def _settle_pull(rid: str, pages: int, nbytes: int = 0) -> None:
         """A pull resolved (adopted, failed, or timed out): admit the
         deferred put and tell the router how it went (pages=0 = the
-        recompute fallback engaged)."""
+        recompute fallback engaged). A gang member's upstream hop rides
+        the same path but wakes its gang job instead of admitting a put
+        — a failed hop fails the segment (the router collapses the gang
+        to the single-replica fallback)."""
         entry = pulls.pop(rid, None)
         if entry is None:
             return
         _trace_ev(rid, "pull_settle", pages=pages)
         _stream({"t": "kv_ack", "id": rid, "a": attempts.get(rid, 0),
                  "pages": pages, "bytes": nbytes})
-        _admit_put(entry["put"])
+        if entry.get("gang"):
+            backend.gang_upstream(rid, ok=pages > 0)
+        else:
+            _admit_put(entry["put"])
 
     while True:
         busy = backend.has_work()
@@ -1686,6 +1791,19 @@ def serve(cfg: dict, chan: LineChannel,
                         "relay": False,
                         "deadline": time.monotonic() + float(
                             msg["pull"].get("deadline_s", 5.0))}
+                    # promote-AHEAD: the network wait is free time to
+                    # stage this prompt's NVMe-resident tier records up
+                    # into host RAM, so whichever way the pull settles
+                    # (adopt dedup or recompute fallback), the
+                    # admission-time tier promote reads at RAM rate
+                    tier = getattr(backend, "kv_tier", None)
+                    if tier is not None:
+                        bs = backend.block_size
+                        ptoks = [int(x) for x in msg.get("prompt", ())]
+                        n_full = len(ptoks) // bs
+                        if n_full:
+                            tier.prefetch(
+                                chain_hashes(ptoks[:n_full * bs], bs))
                 else:
                     _admit_put(msg)
             elif t == "flush":
@@ -1893,6 +2011,55 @@ def serve(cfg: dict, chan: LineChannel,
                 # the pull died somewhere (peer gone, chain evicted,
                 # router gave up): recompute — the always-safe fallback
                 _settle_pull(str(msg["id"]), 0)
+            elif t == "gang_seg":
+                # gang prefill, member leg: prefill ONE contiguous
+                # segment of a long prompt. Downstream members (a
+                # "pull" rode the message) also await an upstream KV
+                # hop — the kv_* import leg under this same gang id —
+                # before publishing their merged chain.
+                rid = str(msg["id"])
+                a = int(msg.get("a", 0))
+                attempts[rid] = a
+                seg = int(msg.get("seg", 0))
+                _trace_ev(rid, "gang_seg", seg=seg,
+                          own=int(msg.get("own", 0)))
+                if draining:
+                    reason = "draining"
+                elif inj.countdown("gang_refuse_version_skew"):
+                    # deterministic chaos: a member that swapped
+                    # weights between the router's same-version pick
+                    # and this admit must refuse, skew-safe
+                    reason = "version_skew"
+                else:
+                    reason = backend.gang_put(
+                        rid, [int(x) for x in msg.get("tok", ())],
+                        int(msg.get("own", 0)),
+                        wait_upstream="pull" in msg)
+                if reason:
+                    attempts.pop(rid, None)
+                    _trace_ev(rid, "gang_refuse", reason=reason)
+                    _trace_ship(rid)
+                    _stream({"t": "gang_seg_fail", "id": rid, "a": a,
+                             "reason": reason})
+                else:
+                    gang_meta[rid] = seg
+                    if "pull" in msg:
+                        pulls[rid] = {
+                            "put": None, "gang": True, "asm": None,
+                            "shm": None, "relay": False,
+                            "deadline": time.monotonic() + float(
+                                msg["pull"].get("deadline_s", 10.0))}
+            elif t == "gang_abort":
+                # the gang collapsed (the router falls back to a
+                # single-replica prefill): drop the job — published
+                # pages stay, they are ordinary cache residency
+                rid = str(msg["id"])
+                _trace_ev(rid, "gang_abort")
+                _trace_ship(rid)
+                backend.gang_abort(rid)
+                gang_meta.pop(rid, None)
+                pulls.pop(rid, None)
+                attempts.pop(rid, None)
             elif t == "resync":
                 # fleet re-adoption (crash-safe router): a restarted
                 # router asks what this replica still holds — live
@@ -2019,6 +2186,21 @@ def serve(cfg: dict, chan: LineChannel,
                 _trace_ev(rid, "done", n=len(toks))
                 _stream({"t": "done", "id": rid, "a": a, "toks": toks})
                 _trace_ship(rid)
+            elif kind == "gang_ok":
+                attempts.pop(rid, None)
+                seg = gang_meta.pop(rid, 0)
+                _trace_ev(rid, "gang_seg_ok", pages=int(toks))
+                _trace_ship(rid)
+                _stream({"t": "gang_seg_ok", "id": rid, "a": a,
+                         "seg": seg, "pages": int(toks)})
+            elif kind == "gang_fail":
+                attempts.pop(rid, None)
+                gang_meta.pop(rid, None)
+                pulls.pop(rid, None)
+                _trace_ev(rid, "gang_seg_fail", reason=str(toks))
+                _trace_ship(rid)
+                _stream({"t": "gang_seg_fail", "id": rid, "a": a,
+                         "reason": str(toks)})
             else:
                 attempts.pop(rid, None)
                 _trace_ev(rid, "failed", reason=str(toks))

@@ -61,7 +61,8 @@ from .disagg import (DECODE_CAPABLE, MigrationState, PREFILL_CAPABLE,
                      RebalancePolicy, ScaleAdvisor, role_of)
 from .fleet import DRAINING, Fleet, FleetConfig, QUARANTINED, READY
 from .placement import (StickyMap, best_digest_peer, chain_hashes,
-                        match_pages, pick_replica, plan_kv_source)
+                        gang_segments, load_score, match_pages,
+                        pick_replica, plan_gang_prefill, plan_kv_source)
 from .protocol import ChannelClosed, RequestRecord, poll_channels
 
 #: terminal request states
@@ -70,6 +71,11 @@ QUEUED, ASSIGNED = "queued", "assigned"
 #: journal-recovered, waiting for a replica to claim it via resync
 #: (bounded by ``resync_hold_s``, then it requeues and replays)
 RECOVERING = "recovering"
+#: gang prefill in flight: the prompt's prefill is sharded across a
+#: gang of prefill-capable replicas; the request is NOT assigned (no
+#: stream can arrive) until the merged chain lands and it requeues
+#: pinned to the final gang member
+GANG = "gang"
 
 
 class AdmissionError(RuntimeError):
@@ -130,6 +136,24 @@ class RouterConfig:
     kv_pull_relay_bytes_s: float = 64e6
     kv_pull_shm_bytes_s: float = 2e9
     kv_pull_overhead_s: float = 0.02
+    #: gang prefill: shard ONE long prompt's prefill across several
+    #: prefill-capable replicas (contiguous page-aligned segments),
+    #: merge the KV shards forward member-to-member over the kv_pull
+    #: machinery, and land the full merged chain on the final member —
+    #: the request then requeues pinned there and flows through the
+    #: untouched put/handoff/decode path. Engages only when the cost
+    #: model (plan_gang_prefill over the kv_pull_* rates) says a gang
+    #: beats a single prefill; ANY member failing collapses the gang
+    #: back to the ordinary single-replica prefill (bit-identical by
+    #: construction — the gang never samples).
+    gang_prefill: bool = True
+    #: prompts shorter than this never gang (the transfer overhead
+    #: can't win on short prefills regardless of rates)
+    gang_min_tokens: int = 512
+    #: cap on gang size K (cost model may choose fewer)
+    gang_max_members: int = 4
+    #: whole-gang deadline: a gang older than this collapses
+    gang_timeout_s: float = 10.0
     #: KV tiering (inference/kvtier.py): per-tier byte rates for the
     #: pull-vs-LOCAL-TIER-PROMOTE-vs-recompute decision
     #: (placement.plan_kv_source) — a placed replica whose host-RAM/
@@ -246,6 +270,12 @@ class _Req:
     #: request whose slot is not ready stays queued (its submitter's
     #: deadline — the deploy probe timeout — bounds the wait)
     pin_slot: int = -1
+    #: gang prefill (status GANG): members the prompt was sharded over
+    #: (0 = never ganged), whether the merged chain landed, and the
+    #: one-shot guard — a collapsed gang never re-engages
+    gang_k: int = 0
+    gang_merged: bool = False
+    gang_tried: bool = False
     #: rebuilt from the journal by a restarted router incarnation
     recovered: bool = False
     #: claimed by a replica through the resync exchange (its stream
@@ -286,6 +316,15 @@ class Router:
         #: kind="pull"; separate from _Req.mig — a pulled request can
         #: later hand off or rebalance like any other)
         self._pulls: dict[str, MigrationState] = {}
+        #: in-flight gang prefills: tid -> {"members": [(slot, epoch)],
+        #: "ends": [pages], "ends_tok": [tokens], "stage": int,
+        #: "nonce": int, "started_t": float, "stage_t": float,
+        #: "pages": int}; the hop transfer for stage i rides
+        #: ``_pulls["g:" + tid]`` (kind="gang")
+        self._gangs: dict[str, dict] = {}
+        self.gang_plans = 0
+        self.gang_merges = 0
+        self.gang_fallbacks = 0
         #: page geometry learned from the last bundle meta seen (the
         #: pull cost model's bytes-per-page term; 0 until known)
         self._page_bytes = 0
@@ -824,6 +863,7 @@ class Router:
                 # KEPT: its buffered trace segments still need alignment
                 # (ClockSync keys by (slot, epoch) and bounds retention)
             self._fail_pulls_from(r.slot, r.epoch)
+            self._fail_gangs_from(r.slot, r.epoch)
             self._replay_orphans(r.slot, r.epoch, "replica_lost")
         if self._ftrace is not None \
                 and self.fleet.breaker_opens_total > self._seen_breaker_opens:
@@ -891,11 +931,11 @@ class Router:
         loop is bounded NO MATTER WHAT the fleet does). Returns
         :meth:`results`."""
         deadline = time.monotonic() + deadline_s
-        while any(r.status in (QUEUED, ASSIGNED, RECOVERING)
+        while any(r.status in (QUEUED, ASSIGNED, RECOVERING, GANG)
                   for r in self._reqs.values()):
             if time.monotonic() >= deadline:
                 for tid, r in list(self._reqs.items()):
-                    if r.status in (QUEUED, ASSIGNED, RECOVERING):
+                    if r.status in (QUEUED, ASSIGNED, RECOVERING, GANG):
                         self._terminate(tid, FAILED, "router_deadline")
                 break
             self.poll()
@@ -1044,7 +1084,14 @@ class Router:
             self._on_migration(h, msg)
         elif t in ("kv_bundle", "kv_chunk", "kv_eof", "kv_none",
                    "kv_need", "kv_ack"):
-            self._on_pull(h, msg)
+            # gang hop transfers ride the same kv_* vocabulary under a
+            # "g:"-prefixed id — route them to the gang state machine
+            if str(msg.get("id", "")).startswith("g:"):
+                self._on_gang_pull(h, msg)
+            else:
+                self._on_pull(h, msg)
+        elif t in ("gang_seg_ok", "gang_seg_fail"):
+            self._on_gang_seg(h, msg)
         elif t == "bye":
             h.state = DRAINING
 
@@ -1847,6 +1894,8 @@ class Router:
                     help="prompts placed on a decode-role replica for "
                          "lack of a ready prefill-capable slot").inc()
             req = self._reqs[tid]
+            if self._maybe_gang(req, cands, role_fallback, now):
+                continue
             pool = [c for c in cands if c.slot == req.pin_slot] \
                 if req.pin_slot >= 0 else cands
             rep, hit_pages = pick_replica(pool, req.chain, self._sticky)
@@ -2175,6 +2224,371 @@ class Router:
                 help="pulls that fell back to local recompute, by "
                      "structured reason").inc()
 
+    # -- gang prefill (fleet-sharded prompt prefill) ---------------------
+    # One LONG prompt's prefill sharded across a gang of K prefill-
+    # capable replicas: the router splits the page-aligned chain into K
+    # contiguous segments (placement.gang_segments), every member
+    # prefills its OWN segment concurrently (segment KV depends causally
+    # only on earlier segments — members attend over adopted upstream
+    # pages plus their own), and the merged root-contiguous chain grows
+    # member to member in K-1 staged hops over the SAME kv_* bundle
+    # machinery pulls use (kind="prefix" bundles under a "g:"-prefixed
+    # id, chain hashes intact). When the final member holds the full
+    # chain the request requeues PINNED there and flows through the
+    # untouched put/handoff/decode path — the gang never samples a
+    # token, so any member dying/refusing/timing out collapses to the
+    # ordinary single-replica prefill, bit-identical by construction.
+    # Gangs are never journaled and recovered requests never gang: after
+    # a router crash the ordinary replay path owns the request.
+
+    def _gang_id(self, tid: str) -> str:
+        return "g:" + tid
+
+    def _count_gang_plan(self, decision: str) -> None:
+        self.gang_plans += 1
+        if self._telem.enabled:
+            self._telem.registry.counter(
+                "serving_router_gang_plans_total",
+                labels={"decision": decision},
+                help="gang-prefill cost-model decisions at dispatch "
+                     "(engage vs single)").inc()
+
+    def _maybe_gang(self, req: _Req, cands, role_fallback: bool,
+                    now: float) -> bool:
+        """Engage a gang prefill for ``req`` when the cost model
+        (placement.plan_gang_prefill over the kv_pull_* rates) says a
+        gang strictly beats one replica prefilling the whole prompt.
+        True = engaged (the request left the queue into status GANG);
+        False = dispatch places it normally."""
+        cfg = self.cfg
+        if not cfg.gang_prefill or role_fallback or req.gang_tried \
+                or req.pin_slot >= 0 or req.recovered or req.committed \
+                or len(req.rec.prompt) < cfg.gang_min_tokens \
+                or len(req.chain) < 2:
+            return False
+        # a gang must be same-version end to end (KV crosses replicas
+        # K-1 times): largest same-wv candidate group, least loaded first
+        groups: dict[tuple, list] = {}
+        for c in cands:
+            wv = getattr(c, "wv", None) or {}
+            groups.setdefault((wv.get("id"), wv.get("digest")),
+                              []).append(c)
+        group = max(groups.values(), key=len)
+        if len(group) < 2:
+            return False
+        group.sort(key=lambda c: (load_score(c.load), c.slot))
+        hit = max(match_pages(req.chain, getattr(c, "digest", None))
+                  for c in group)
+        bs = group[0].block_size or self._fleet_block_size() or 1
+        shm_ok = all(bool(c.shm) and not c.address for c in group)
+        rate = cfg.kv_pull_shm_bytes_s if shm_ok \
+            else cfg.kv_pull_relay_bytes_s
+        k = plan_gang_prefill(
+            len(req.chain), hit, min(cfg.gang_max_members, len(group)),
+            self._page_bytes, bs, cfg.kv_pull_prefill_tok_s, rate,
+            cfg.kv_pull_overhead_s)
+        if k < 2:
+            self._count_gang_plan("single")
+            return False
+        tid = req.rec.trace_id
+        gid = self._gang_id(tid)
+        members = group[:k]
+        ends = gang_segments(len(req.chain), k)
+        ends_tok = [e * bs for e in ends]
+        req.attempt += 1                 # the whole gang rides ONE nonce
+        nonce = req.attempt
+        sent = []
+        ok = True
+        for i, m in enumerate(members):
+            msg = {"t": "gang_seg", "id": gid, "a": nonce, "seg": i,
+                   "k": k,
+                   "tok": [int(x) for x in req.rec.prompt[:ends_tok[i]]],
+                   "own": ends_tok[i] - (ends_tok[i - 1] if i else 0)}
+            if i:
+                # downstream members also await an upstream KV hop —
+                # bounded by the gang deadline, after which they fail
+                # their segment locally and the gang collapses
+                msg["pull"] = {"deadline_s": cfg.gang_timeout_s}
+            if not m.send(msg):
+                ok = False
+                break
+            sent.append(m)
+        if not ok:
+            # a member's channel is toast: abort what went out, requeue,
+            # and let maintain() reap the slot — nothing was placed, so
+            # no retry burns; gang_tried keeps this one-shot
+            for m in sent:
+                m.send({"t": "gang_abort", "id": gid})
+            req.gang_tried = True
+            self._queues.setdefault(req.rec.priority,
+                                    deque()).appendleft(tid)
+            return True
+        req.status = GANG
+        req.gang_k = k
+        req.gang_tried = True
+        req.last_activity_t = now
+        self._gangs[tid] = {
+            "members": [(m.slot, m.epoch) for m in members],
+            "ends": ends, "ends_tok": ends_tok, "stage": 0,
+            "nonce": nonce, "started_t": now, "stage_t": now,
+            "pages": 0}
+        self._count_gang_plan("engage")
+        self._fev(tid, "gang_start", k=k,
+                  members=[m.slot for m in members],
+                  chain_pages=len(req.chain), hit_pages=hit)
+        if self._telem.enabled:
+            self._telem.registry.counter(
+                "serving_router_gang_segments_total",
+                help="prompt segments dispatched to gang members").inc(k)
+        return True
+
+    def _on_gang_seg(self, h, msg: dict) -> None:
+        gid = str(msg.get("id"))
+        tid = gid[2:] if gid.startswith("g:") else gid
+        g = self._gangs.get(tid)
+        req = self._reqs.get(tid)
+        if g is None or req is None or req.status != GANG \
+                or int(msg.get("a", -1)) != g["nonce"]:
+            self.stale_msgs += 1
+            return
+        member = (h.slot, h.epoch)
+        if msg["t"] == "gang_seg_fail":
+            if member not in g["members"]:
+                self.stale_msgs += 1
+                return
+            reason = str(msg.get("reason", "internal"))
+            if reason == "version_skew":
+                self._count_version_skew("gang")
+            self._collapse_gang(tid, reason)
+            return
+        seg = int(msg.get("seg", -1))
+        if seg != g["stage"] or seg >= len(g["members"]) \
+                or member != g["members"][seg]:
+            self.stale_msgs += 1
+            return
+        now = time.monotonic()
+        req.last_activity_t = now
+        g["pages"] = int(msg.get("pages", 0))
+        if self._telem.enabled:
+            self._telem.registry.histogram(
+                "serving_router_gang_stage_s",
+                buckets=LATENCY_BUCKETS_S,
+                help="per-stage gang wall time (stage entered -> "
+                     "segment ready)").observe(now - g["stage_t"])
+        g["stage_t"] = now
+        if seg == len(g["members"]) - 1:
+            self._finish_gang(tid)
+        else:
+            g["stage"] = seg + 1
+            self._start_gang_hop(tid, seg)
+
+    def _start_gang_hop(self, tid: str, seg: int) -> None:
+        """Ship the merged chain ``[0 .. ends[seg])`` from member
+        ``seg`` to member ``seg + 1`` over the kv_* machinery (the hop
+        state rides ``_pulls[gid]`` with kind="gang")."""
+        g = self._gangs[tid]
+        req = self._reqs[tid]
+        gid = self._gang_id(tid)
+        src_slot, src_epoch = g["members"][seg]
+        if not self._send_to_slot(
+                src_slot, src_epoch,
+                {"t": "kv_req", "id": gid, "a": g["nonce"],
+                 "tok": [int(x)
+                         for x in req.rec.prompt[:g["ends_tok"][seg]]]}):
+            self._collapse_gang(tid, "hop_source_lost")
+            return
+        self._pulls[gid] = MigrationState(
+            meta={}, src_slot=src_slot, src_epoch=src_epoch,
+            started_t=time.monotonic(), kind="gang",
+            tgt_slot=g["members"][seg + 1][0], src_attempt=g["nonce"])
+
+    def _on_gang_pull(self, h, msg: dict) -> None:
+        """Gang-hop mirror of :meth:`_on_pull`: same kv_* legs, but any
+        failure collapses the whole gang (there is no per-hop recompute
+        — the single-replica fallback IS the recompute)."""
+        t = msg["t"]
+        gid = str(msg.get("id"))
+        tid = gid[2:]
+        pull = self._pulls.get(gid)
+        g = self._gangs.get(tid)
+        req = self._reqs.get(tid)
+        if pull is None or g is None or req is None \
+                or req.status != GANG:
+            self.stale_msgs += 1
+            return
+        nonce_ok = int(msg.get("a", -1)) == g["nonce"]
+        src_ok = (h.slot == pull.src_slot and h.epoch == pull.src_epoch
+                  and nonce_ok)
+        tgt_slot, tgt_epoch = g["members"][g["stage"]]
+        tgt_ok = (h.slot == tgt_slot == pull.tgt_slot
+                  and h.epoch == tgt_epoch and nonce_ok)
+        if t == "kv_none":
+            if src_ok:
+                self._collapse_gang(tid, "hop_miss")
+        elif t == "kv_bundle":
+            if src_ok and pull.phase == "recv":
+                pull.meta = msg.get("meta") or {}
+                pull.shm = msg.get("shm")
+                self._page_bytes = int(pull.meta.get(
+                    "page_bytes", self._page_bytes) or self._page_bytes)
+        elif t == "kv_chunk":
+            if not src_ok:
+                return
+            pull.add_chunk(msg)
+            if pull.phase == "xfer":     # relay resend: forward along
+                self._send_to_slot(tgt_slot, tgt_epoch,
+                                   {**msg, "id": gid, "a": g["nonce"]})
+        elif t == "kv_eof":
+            if not src_ok:
+                return
+            if pull.phase == "xfer":     # relay resend complete
+                self._send_to_slot(tgt_slot, tgt_epoch,
+                                   {"t": "kv_eof", "id": gid,
+                                    "a": g["nonce"],
+                                    "chunks": pull.total})
+                return
+            pull.total = int(msg.get("chunks", 0))
+            if not pull.complete:
+                self._collapse_gang(tid, "hop_torn")
+                return
+            tgt = self.fleet.replicas[tgt_slot]
+            if version_skew((pull.meta or {}).get("wv"),
+                            getattr(tgt, "wv", None)):
+                # a member swapped mid-gang (rolling deploy): the merged
+                # chain can't cross versions — fall back, skew-safe
+                self._count_version_skew("gang")
+                self._collapse_gang(tid, "version_skew")
+                return
+            pull.phase = "xfer"
+            ok = self._send_to_slot(
+                tgt_slot, tgt_epoch,
+                {"t": "kv_bundle", "id": gid, "a": g["nonce"],
+                 "meta": pull.meta, "chunks": pull.total,
+                 "shm": pull.shm})
+            for i in range(pull.total if ok else 0):
+                ok = self._send_to_slot(
+                    tgt_slot, tgt_epoch,
+                    {**pull.chunks[i], "id": gid, "a": g["nonce"]})
+                if not ok:
+                    break
+            if ok:
+                self._send_to_slot(
+                    tgt_slot, tgt_epoch,
+                    {"t": "kv_eof", "id": gid, "a": g["nonce"],
+                     "chunks": pull.total})
+            else:
+                self._collapse_gang(tid, "hop_target_lost")
+        elif t == "kv_need":
+            if not tgt_ok or pull.phase != "xfer":
+                return
+            pull.resends += 1
+            if pull.resends > self.cfg.migration_resend_max:
+                self._collapse_gang(tid, "resend_budget")
+                return
+            missing = [int(i) for i in msg.get("missing", ())]
+            if msg.get("relay"):
+                pull.relayed = True
+                if not self._send_to_slot(
+                        pull.src_slot, pull.src_epoch,
+                        {"t": "kv_relay", "id": gid,
+                         "missing": missing}):
+                    self._collapse_gang(tid, "relay_source_lost")
+                return
+            for i in missing:
+                c = pull.chunks.get(i)
+                if c is not None:
+                    self._send_to_slot(tgt_slot, tgt_epoch,
+                                       {**c, "id": gid,
+                                        "a": g["nonce"]})
+            self._send_to_slot(tgt_slot, tgt_epoch,
+                               {"t": "kv_eof", "id": gid,
+                                "a": g["nonce"], "chunks": pull.total})
+        elif t == "kv_ack":
+            if not tgt_ok:
+                return
+            self._pulls.pop(gid, None)
+            req.last_activity_t = time.monotonic()
+            if int(msg.get("pages", 0)) <= 0:
+                # the member adopted nothing (corrupt hop / pool
+                # refusal / its deadline fired): the merge is broken
+                self._collapse_gang(tid, "adopt_failed")
+                return
+            if self._telem.enabled:
+                self._telem.registry.counter(
+                    "serving_router_gang_bytes_total",
+                    help="gang hop payload bytes relayed member to "
+                         "member").inc(pull.payload_bytes)
+            # the hop landed; now await the member's own gang_seg_ok
+            # (own segment done + adopted upstream published)
+
+    def _collapse_gang(self, tid: str, reason: str) -> None:
+        """Any gang failure degrades to the ordinary single-replica
+        prefill: abort every member, requeue WITHOUT burning a retry
+        (the gang never placed the request — collapse is an
+        optimization miss, not a request failure), never gang again."""
+        g = self._gangs.pop(tid, None)
+        if g is None:
+            return
+        gid = self._gang_id(tid)
+        self._pulls.pop(gid, None)
+        for slot, epoch in g["members"]:
+            self._send_to_slot(slot, epoch,
+                               {"t": "gang_abort", "id": gid})
+        self.gang_fallbacks += 1
+        self._fev(tid, "gang_collapse", reason=reason)
+        logger.info(f"router: gang for {tid} collapsed ({reason}); "
+                    f"falling back to single-replica prefill")
+        if self._telem.enabled:
+            self._telem.registry.counter(
+                "serving_router_gang_fallbacks_total",
+                labels={"reason": sanitize_label_value(reason)},
+                help="gangs collapsed to the single-replica fallback, "
+                     "by structured reason").inc()
+        req = self._reqs.get(tid)
+        if req is not None and req.status == GANG:
+            req.status = QUEUED
+            req.last_activity_t = time.monotonic()
+            self._queues.setdefault(req.rec.priority,
+                                    deque()).appendleft(tid)
+
+    def _finish_gang(self, tid: str) -> None:
+        """The final member holds the merged full-prompt chain: requeue
+        the request PINNED there — the ordinary put hits the merged
+        radix chain and prefills only the sub-page tail."""
+        g = self._gangs.pop(tid, None)
+        req = self._reqs.get(tid)
+        if g is None or req is None or req.status != GANG:
+            return
+        self._pulls.pop(self._gang_id(tid), None)
+        req.gang_merged = True
+        req.status = QUEUED
+        req.pin_slot = g["members"][-1][0]
+        req.last_activity_t = time.monotonic()
+        self._queues.setdefault(req.rec.priority,
+                                deque()).appendleft(tid)
+        self.gang_merges += 1
+        self._fev(tid, "gang_merged", slot=req.pin_slot,
+                  pages=g["pages"])
+        if self._telem.enabled:
+            self._telem.registry.counter(
+                "serving_router_gang_merged_total",
+                help="gangs whose merged chain landed on the final "
+                     "member (the request dispatches pinned "
+                     "there)").inc()
+
+    def _fail_gangs_from(self, slot: int, epoch: int) -> None:
+        """A replica died: collapse every gang it was a member of, and
+        unpin gang-merged requests pinned to it — the merged chain died
+        with the radix, so plain placement must own the replay."""
+        for tid in [t for t, g in self._gangs.items()
+                    if any(s == slot and e <= epoch
+                           for s, e in g["members"])]:
+            self._collapse_gang(tid, "member_lost")
+        for req in self._reqs.values():
+            if req.gang_merged and req.pin_slot == slot \
+                    and req.status not in (DONE, FAILED, SHED):
+                req.pin_slot = -1
+
     # -- transfer-buffer GC + hot-replica rebalancing --------------------
     def _sweep_transfers(self, now: float) -> None:
         """Bound the router's transfer buffers: a bundle whose importer
@@ -2206,6 +2620,9 @@ class Router:
             buffered += mig.buffered_bytes
         for tid in list(self._pulls):
             pull = self._pulls[tid]
+            if pull.kind == "gang":
+                buffered += pull.buffered_bytes
+                continue                 # gang hops ride the gang deadline
             req = self._reqs.get(tid)
             if req is None or req.status in (DONE, FAILED, SHED):
                 self._pulls.pop(tid, None)
@@ -2214,6 +2631,10 @@ class Router:
                 self._fail_pull(tid, "timeout")
                 continue
             buffered += pull.buffered_bytes
+        for tid in list(self._gangs):
+            if now - self._gangs[tid]["started_t"] \
+                    > self.cfg.gang_timeout_s:
+                self._collapse_gang(tid, "timeout")
         if self._telem.enabled:
             self._telem.registry.gauge(
                 "serving_router_migration_buffer_bytes",
@@ -2297,6 +2718,12 @@ class Router:
             # source's pages pinned forever
             self._abort_migration(req, f"terminated_{status}")
         self._pulls.pop(tid, None)       # a terminal request pulls nothing
+        g = self._gangs.pop(tid, None)
+        if g is not None:                # gang in flight: tell the members
+            self._pulls.pop("g:" + tid, None)
+            for slot, epoch in g["members"]:
+                self._send_to_slot(slot, epoch,
+                                   {"t": "gang_abort", "id": "g:" + tid})
         if req.status == QUEUED:
             for q in self._queues.values():
                 if tid in q:
@@ -2367,6 +2794,7 @@ class Router:
                 "retries": req.retries, "placed": list(req.placed),
                 "hit_pages": req.hit_pages, "migrated": req.migrated,
                 "pulled_pages": req.pulled_pages,
+                "gang_k": req.gang_k, "gang_merged": req.gang_merged,
                 "rebalanced": req.rebalanced,
                 "ttft_s": (req.first_tok_t - req.submit_t)
                 if req.first_tok_t else None}
@@ -2385,16 +2813,17 @@ class Router:
         self._draining = True
         deadline = time.monotonic() + deadline_s
         drain_sent = False
-        while any(r.status in (QUEUED, ASSIGNED, RECOVERING)
+        while any(r.status in (QUEUED, ASSIGNED, RECOVERING, GANG)
                   for r in self._reqs.values()):
             if not drain_sent and not any(
-                    r.status == QUEUED for r in self._reqs.values()):
+                    r.status in (QUEUED, GANG)
+                    for r in self._reqs.values()):
                 for rep in self.fleet.ready():
                     rep.send({"t": "drain"})
                 drain_sent = True
             if time.monotonic() >= deadline:
                 for tid, r in list(self._reqs.items()):
-                    if r.status in (QUEUED, ASSIGNED, RECOVERING):
+                    if r.status in (QUEUED, ASSIGNED, RECOVERING, GANG):
                         self._terminate(tid, FAILED, "drain_timeout")
                 return False
             self.poll()

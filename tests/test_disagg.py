@@ -427,6 +427,43 @@ def test_disagg_chaos_death_mid_bundle_exactly_once(case):
         router.close()
 
 
+def test_unread_heartbeat_is_proof_of_life():
+    """Pins the ``src_dies_mid_handoff`` flake: ``last_msg_t`` advances
+    only when the ROUTER consumes a message, and ``maintain()`` runs
+    before the channel drain each poll tick — so a router stalled past
+    ``hb_timeout_s`` (CPU contention under concurrent bench load) used
+    to reap a healthy replica whose heartbeats sat unread in the pipe.
+    In the chaos case above that false death re-arms the crash injector
+    on the respawn and burns the request's retry budget. Unread input is
+    proof of life; real silence (empty pipe) still reaps immediately."""
+    from deepspeed_tpu.serving.fleet import READY, Fleet, FleetConfig
+    from deepspeed_tpu.serving.protocol import LineChannel
+
+    fcfg = FleetConfig(n_replicas=1, hb_timeout_s=0.05,
+                       backoff_base_s=30.0,
+                       replica={"address": "unix:/nonexistent"})
+    fleet = Fleet(fcfg)
+    h = fleet.replicas[0]
+    r, w = os.pipe()
+    h.chan = LineChannel(r, None)
+    h.state = READY
+    now = time.monotonic()
+    h.last_msg_t = now - 10.0            # silence way past hb_timeout
+    # a heartbeat sits UNREAD in the pipe: the slot must survive
+    os.write(w, b'{"t":"hb","load":{}}\n')
+    assert fleet.maintain(now) == []
+    assert h.state == READY
+    # the drain that follows maintain() consumes it normally
+    assert h.chan.recv(timeout=0)["t"] == "hb"
+    # with the pipe EMPTY and the silence persisting, the slot really
+    # is wedged: the next maintain reaps it
+    h.last_msg_t = now - 10.0
+    died = fleet.maintain(now)
+    assert [d.slot for d in died] == [0]
+    assert h.state != READY
+    os.close(w)
+
+
 @pytest.mark.multiprocess
 def test_no_decode_capacity_degrades_to_mixed_via_resume():
     """A prefill-only fleet: handoffs find no decode-capable replica, the

@@ -448,6 +448,34 @@ def test_serving_protocol_vocabulary_is_closed():
     assert violations == [], "\n".join(violations)
 
 
+def test_protocol_lint_pins_gang_vocabulary_both_directions():
+    """The gang-prefill vocabulary (PR 16) is wired end to end: the
+    router constructs gang_seg/gang_abort and the replica dispatches
+    them; the replica constructs gang_seg_ok/gang_seg_fail and the
+    router dispatches those — this pin keeps a refactor from quietly
+    orphaning either direction (the lint would fire, but only on the
+    side that ROT; a deleted pair vanishes from both maps and passes)."""
+    sent: dict = {}
+    handled: dict = {}
+    serving = os.path.join(ROOT, "deepspeed_tpu", "serving")
+    for dirpath, _, files in os.walk(serving):
+        for f in sorted(files):
+            if f.endswith(".py"):
+                s, h, errs = protocol_lint.scan_file(
+                    os.path.join(dirpath, f))
+                assert errs == []
+                sent.update(s)
+                handled.update(h)
+    for tag in ("gang_seg", "gang_abort", "gang_seg_ok",
+                "gang_seg_fail"):
+        assert tag in sent, f"{tag} no longer constructed"
+        assert tag in handled, f"{tag} no longer dispatched"
+    assert "router.py" in sent["gang_seg"]
+    assert "replica.py" in handled["gang_seg"]
+    assert "replica.py" in sent["gang_seg_ok"]
+    assert "router.py" in handled["gang_seg_ok"]
+
+
 def test_protocol_detector_flags_dark_sends_and_phantom_handlers(
         tmp_path):
     serving = tmp_path / "deepspeed_tpu" / "serving"
