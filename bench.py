@@ -2203,6 +2203,165 @@ def deploy_main():
         router.close()
 
 
+def paged_attention_main():
+    """``BENCH_MODE=paged_attention``: Pallas paged-attention kernel vs
+    the XLA gather formulation, on the two serving dispatch shapes —
+    plain decode (T=1) and speculative tree-verify (T=BENCH_PA_TREE
+    branchy nodes) — across context lengths. This is the data behind the
+    attn_registry auto-gate: the scorecard records the crossover context
+    per mode (smallest context where the kernel wins).
+
+    Geometry via BENCH_PA_HEADS/KV/D/BS/SEQS, contexts via BENCH_PA_CTX
+    (comma list of token counts), reps via BENCH_PA_REPS. On a CPU host
+    the kernel runs in interpret mode — timings are functional (the
+    artifact's structure is what CI smokes); real crossovers need a TPU.
+    """
+    from deepspeed_tpu.ops.pallas.paged_attention import \
+        paged_ragged_attention
+
+    H = int(os.environ.get("BENCH_PA_HEADS", "8"))
+    KV = int(os.environ.get("BENCH_PA_KV", "8"))
+    D = int(os.environ.get("BENCH_PA_D", "64"))
+    bs = int(os.environ.get("BENCH_PA_BS", "16"))
+    S = int(os.environ.get("BENCH_PA_SEQS", "4"))
+    T_tree = int(os.environ.get("BENCH_PA_TREE", "8"))
+    reps = int(os.environ.get("BENCH_PA_REPS", "5"))
+    ctxs = [int(c) for c in
+            os.environ.get("BENCH_PA_CTX", "64,256,1024").split(",")]
+    on_tpu = jax.default_backend() == "tpu"
+    G = H // KV
+    Ts = max(8, T_tree)
+    if Ts > bs:
+        Ts += (-Ts) % bs
+    rng = np.random.default_rng(0)
+    max_ctx = max(ctxs)
+    nb = max_ctx // bs + 2
+    pool = jnp.asarray(rng.standard_normal((1, 2, KV, nb, bs, D)) * 0.3,
+                       jnp.bfloat16)
+    # branchy tree: two siblings at depth 1, chains below
+    depth = [0] + [1 + (i - 1) // 2 for i in range(1, T_tree)]
+    tmask_np = np.zeros((S, T_tree, T_tree), np.uint8)
+    parents = [-1] + [max(0, i - 2) for i in range(1, T_tree)]
+    for t in range(T_tree):
+        j = t
+        while j != -1:
+            tmask_np[:, t, j] = 1
+            j = parents[j]
+
+    def gather_attn(q, pool, ks, vs, tables, seq_lens, sstart, pos, tmask):
+        """The engine fallback's formulation, shape-for-shape: per-slot
+        [S, ctx] page gather, f32 flat softmax, bf16 PV einsum."""
+        T = q.shape[1]
+        blocks = jnp.repeat(tables, bs, axis=1)          # [S, ctx]
+        offs = jnp.tile(jnp.arange(bs), tables.shape[1])
+        K = pool[0, 0, :, blocks, offs[None, :]]         # [S,ctx,KV,D]
+        V = pool[0, 1, :, blocks, offs[None, :]]
+        K = jnp.concatenate([K.astype(q.dtype),
+                             ks.transpose(0, 2, 1, 3)], axis=1)
+        V = jnp.concatenate([V.astype(q.dtype),
+                             vs.transpose(0, 2, 1, 3)], axis=1)
+        if KV != H:
+            K = jnp.repeat(K, G, axis=2)
+            V = jnp.repeat(V, G, axis=2)
+        scores = jnp.einsum("sthd,schd->shtc", q, K).astype(jnp.float32)
+        scores = scores / (D ** 0.5)
+        ctx_n = blocks.shape[1]
+        cpos = jnp.concatenate(
+            [jnp.broadcast_to(jnp.arange(ctx_n)[None], tables.shape[:1]
+                              + (ctx_n,)),
+             sstart[:, None] + jnp.arange(K.shape[1] - ctx_n)[None]], 1)
+        valid = jnp.concatenate(
+            [cpos[:, :ctx_n] < sstart[:, None],
+             cpos[:, ctx_n:] < seq_lens[:, None]], 1)[:, None, None, :]
+        mask = valid & (cpos[:, None, :] <= pos[:, :, None])[:, None]
+        if tmask is not None:
+            tm = jnp.pad(tmask.astype(bool),
+                         ((0, 0), (0, 0), (0, K.shape[1] - ctx_n - T)))
+            mask = jnp.concatenate([mask[..., :ctx_n],
+                                    tm[:, None]], axis=-1)
+        scores = jnp.where(mask, scores, jnp.finfo(jnp.float32).min)
+        w = jax.nn.softmax(scores, axis=-1).astype(V.dtype)
+        return jnp.einsum("shtc,schd->sthd", w, V)
+
+    def timeit(fn, *args):
+        jax.block_until_ready(fn(*args))                 # compile + warm
+        best = float("inf")
+        for _ in range(reps):
+            t0 = time.perf_counter()
+            jax.block_until_ready(fn(*args))
+            best = min(best, time.perf_counter() - t0)
+        return best * 1e3
+
+    rows = []
+    for mode in ("decode", "tree"):
+        T = 1 if mode == "decode" else T_tree
+        for ctx in ctxs:
+            root = ctx - 1                               # staged tail at ctx
+            n_pages = -(-root // bs)
+            tables = jnp.asarray(
+                np.stack([rng.permutation(np.arange(1, nb))[:n_pages]
+                          for _ in range(S)]), jnp.int32)
+            q = jnp.asarray(rng.standard_normal((S, T, H, D)) * 0.3,
+                            jnp.bfloat16)
+            ks = jnp.asarray(rng.standard_normal((S, KV, Ts, D)) * 0.3,
+                             jnp.bfloat16)
+            vs = jnp.asarray(rng.standard_normal((S, KV, Ts, D)) * 0.3,
+                             jnp.bfloat16)
+            sstart = jnp.full((S,), root, jnp.int32)
+            if mode == "tree":
+                pos = jnp.asarray(
+                    np.broadcast_to(root + np.asarray(depth), (S, T))
+                    .copy(), jnp.int32)
+                lens = jnp.full((S,), root + 1 + max(depth), jnp.int32)
+                tmask = jnp.asarray(tmask_np)
+                t_kw = dict(tree_positions=pos, tree_mask=tmask)
+            else:
+                pos = jnp.full((S, T), root, jnp.int32)
+                lens = jnp.full((S,), root + 1, jnp.int32)
+                tmask, t_kw = None, {}
+
+            pallas_ms = timeit(jax.jit(
+                lambda q, ks, vs, pool, tables, lens, sstart:
+                    paged_ragged_attention(
+                        q, pool, ks, vs, tables, lens, sstart,
+                        sstart, block_size=bs, layer_index=jnp.int32(0),
+                        **t_kw)),
+                q, ks, vs, pool, tables, lens, sstart)
+            gather_ms = timeit(jax.jit(
+                lambda q, ks, vs, pool, tables, lens, sstart, pos:
+                    gather_attn(q, pool, ks, vs, tables, lens, sstart,
+                                pos, tmask)),
+                q, ks, vs, pool, tables, lens, sstart, pos)
+            rows.append({"mode": mode, "ctx": ctx,
+                         "pallas_ms": round(pallas_ms, 3),
+                         "gather_ms": round(gather_ms, 3),
+                         "speedup": round(gather_ms / pallas_ms, 3)
+                         if pallas_ms else 0.0})
+    crossover = {}
+    for mode in ("decode", "tree"):
+        won = [r["ctx"] for r in rows
+               if r["mode"] == mode and r["speedup"] > 1.0]
+        crossover[mode] = min(won) if won else None
+    tail = [r for r in rows if r["mode"] == "tree"][-1]
+    print(json.dumps({
+        "metric": f"paged-attention kernel vs XLA gather, decode+tree "
+                  f"H{H}/KV{KV}/D{D}/bs{bs}/S{S}/T{T_tree} "
+                  f"({_devices()[0].device_kind})",
+        "value": tail["pallas_ms"],
+        "unit": f"ms/dispatch (tree verify @ ctx {tail['ctx']}"
+                + ("" if on_tpu else ", interpret-mode") + ")",
+        "vs_baseline": tail["speedup"],
+        "detail": {
+            "rows": rows,
+            "crossover_ctx": crossover,
+            "formulation": "mosaic" if on_tpu else "interpret (CPU smoke)",
+            "baseline": "XLA per-slot page gather + flat f32 softmax "
+                        "(engine_v2 fallback formulation); vs_baseline = "
+                        "gather/pallas at the longest tree-verify context",
+        },
+    }), flush=True)
+
+
 def main():
     if os.environ.get("BENCH_MODE") == "router":
         # multi-process CPU harness (toy replicas by default): no local
@@ -2226,6 +2385,8 @@ def main():
     # tunnel must produce a structured JSON error line, never a hang
     # (round 5 lost both driver artifacts to exactly that)
     _bring_up_backend()
+    if os.environ.get("BENCH_MODE") == "paged_attention":
+        return paged_attention_main()
     if os.environ.get("BENCH_MODE") == "tp_matmul":
         return tp_matmul_main()
     if os.environ.get("BENCH_MODE") == "prefix_cache":

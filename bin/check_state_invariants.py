@@ -282,6 +282,106 @@ def check_file(path: str) -> list[str]:
     return v.violations
 
 
+#: attention-formulation registry pin (inference/attn_registry.py): the
+#: engine's kernel-vs-gather decision is the registry's static per-mode
+#: selection, consulted in exactly ONE forward dispatch site. History:
+#: per-call-site `if self._pallas_decode` conditionals are how the
+#: tree-verify path silently pinned the gather formulation — this check
+#: makes that regression structural.
+ENGINE_FILE = "deepspeed_tpu/inference/engine_v2.py"
+#: where the kernel entrypoint may be CALLED inside the engine
+ATTN_KERNEL_CALL_ALLOWED = {"_ragged_forward"}
+#: where the registry selections may be READ (dispatch + the counter +
+#: the init-time config-pin composition)
+ATTN_SEL_READ_ALLOWED = {"_ragged_forward", "_emit_attn_kernel", "__init__"}
+#: where they may be ASSIGNED / computed
+ATTN_SEL_WRITE_ALLOWED = {"__init__"}
+
+
+class _AttnVisitor(ast.NodeVisitor):
+    """Engine-file walk for the registry pin: flags ad-hoc second
+    dispatch sites (kernel calls or selection reads outside the
+    allowlisted functions) and stray selection rebinds."""
+
+    def __init__(self, path: str):
+        self.path = path
+        self.violations: list[str] = []
+        self._func_stack: list[str] = []
+
+    def _visit_fn(self, node):
+        self._func_stack.append(node.name)
+        self.generic_visit(node)
+        self._func_stack.pop()
+
+    visit_FunctionDef = _visit_fn
+    visit_AsyncFunctionDef = _visit_fn
+
+    def _in(self, allowed: set) -> bool:
+        return any(f in allowed for f in self._func_stack)
+
+    def visit_Call(self, node: ast.Call):
+        name = node.func.attr if isinstance(node.func, ast.Attribute) \
+            else node.func.id if isinstance(node.func, ast.Name) else ""
+        if name == "paged_ragged_attention" \
+                and not self._in(ATTN_KERNEL_CALL_ALLOWED):
+            self.violations.append(
+                f"{self.path}:{node.lineno}: paged_ragged_attention() "
+                f"called outside {sorted(ATTN_KERNEL_CALL_ALLOWED)} — "
+                f"the registry-routed forward is the ONLY kernel "
+                f"dispatch site")
+        elif name == "select_attention" \
+                and not self._in(ATTN_SEL_WRITE_ALLOWED):
+            self.violations.append(
+                f"{self.path}:{node.lineno}: select_attention() called "
+                f"outside {sorted(ATTN_SEL_WRITE_ALLOWED)} — the "
+                f"selection is static per engine; consult "
+                f"_attn_decode_sel/_attn_tree_sel instead")
+        self.generic_visit(node)
+
+    def visit_Attribute(self, node: ast.Attribute):
+        if node.attr in ("_attn_decode_sel", "_attn_tree_sel"):
+            if isinstance(node.ctx, ast.Store):
+                if not self._in(ATTN_SEL_WRITE_ALLOWED):
+                    self.violations.append(
+                        f"{self.path}:{node.lineno}: {node.attr} "
+                        f"assigned outside "
+                        f"{sorted(ATTN_SEL_WRITE_ALLOWED)} — the "
+                        f"registry selection is computed once at init")
+            elif not self._in(ATTN_SEL_READ_ALLOWED):
+                self.violations.append(
+                    f"{self.path}:{node.lineno}: {node.attr} read "
+                    f"outside {sorted(ATTN_SEL_READ_ALLOWED)} — no "
+                    f"ad-hoc second dispatch site; route through "
+                    f"_ragged_forward / _emit_attn_kernel")
+        self.generic_visit(node)
+
+
+def check_attn_registry(root: str) -> list[str]:
+    """Pin engine_v2's kernel-vs-gather routing to the attention
+    registry (see _AttnVisitor). Also requires the tree branch to
+    actually consult the registry: a forward that reads NEITHER
+    selection would mean dispatch regressed to an inline conditional."""
+    path = os.path.join(root, *ENGINE_FILE.split("/"))
+    if not os.path.exists(path):
+        return []
+    with open(path, encoding="utf-8") as f:
+        src = f.read()
+    try:
+        tree = ast.parse(src, filename=path)
+    except SyntaxError as e:
+        return [f"{path}:{e.lineno}: unparseable ({e.msg})"]
+    v = _AttnVisitor(path)
+    v.visit(tree)
+    out = v.violations
+    if "_attn_tree_sel" not in src or "_attn_decode_sel" not in src:
+        out.append(
+            f"{path}:1: _ragged_forward no longer consults the "
+            f"attention registry selections (_attn_decode_sel/"
+            f"_attn_tree_sel) — kernel-vs-gather must route through "
+            f"inference/attn_registry.py")
+    return out
+
+
 def check_repo(root: str) -> list[str]:
     out: list[str] = []
     pkg = os.path.join(root, "deepspeed_tpu")
@@ -291,6 +391,7 @@ def check_repo(root: str) -> list[str]:
                     if f.endswith(".py")]
     for path in sorted(targets):
         out += check_file(path)
+    out += check_attn_registry(root)
     return out
 
 

@@ -95,6 +95,31 @@ def feature_report() -> list[tuple[str, bool, str]]:
     except Exception as e:  # pragma: no cover — import breakage only
         feats.append(("inference: speculative decoding", False, str(e)))
 
+    # serving attention formulation (inference/attn_registry.py): which
+    # path a representative engine geometry would dispatch, per mode,
+    # WITH the fallback reason — the report-level mirror of the
+    # serving_attn_kernel_total{path,mode} counter
+    try:
+        from .inference.attn_registry import select_attention
+        from .ops.pallas.paged_attention import paged_attention_usable
+
+        geo = dict(num_heads=8, kv_heads=8, head_dim=64, block_size=64)
+        usable = paged_attention_usable(**geo)
+        parts = []
+        for mode, kw in (("decode", {}),
+                         ("tree", {"tree_nodes": 8, "stage_rows": 8})):
+            sel = select_attention(
+                mode=mode, use_pallas=usable,
+                reason_not_usable="" if usable else "kernel gate off "
+                "(pltpu/head geometry)", **geo, **kw)
+            parts.append(f"{mode}={sel.path}" +
+                         (f" ({sel.reason})" if sel.reason else ""))
+        feats.append(("serving: attention formulation", usable,
+                      "; ".join(parts) +
+                      ("" if on_tpu else " [interpret-mode on CPU]")))
+    except Exception as e:  # pragma: no cover — import breakage only
+        feats.append(("serving: attention formulation", False, str(e)))
+
     # serving tier (serving/): router + replica fleet are pure stdlib
     # multiprocessing over the engine — availability is an import check
     try:

@@ -211,8 +211,12 @@ class RaggedInferenceConfig:
     kv_tier_nvme_dir: str | None = None
     #: total NVMe spill budget (oldest segment dropped past it)
     kv_tier_nvme_bytes: int = 256 << 20
-    #: shortest tier-resident chain worth promoting (pages)
-    kv_tier_min_pages: int = 1
+    #: shortest tier-resident chain worth promoting (pages). None = auto:
+    #: sized at startup from the measured tier byte rates
+    #: (kvtier.measure_tier_rates micro-probe) against the prefill
+    #: recompute rate — the smallest chain where promoting beats
+    #: recomputing (kvtier.auto_min_pages). An explicit int always wins.
+    kv_tier_min_pages: int | None = None
     #: KV-cache dtype: None = compute dtype (bf16); "fp8" stores the pool
     #: as float8_e4m3 — the TPU-native form of FastGen's quantized KV
     #: (scale-free: e4m3's dynamic range covers K/V activations, so pages
@@ -284,6 +288,15 @@ class RaggedInferenceConfig:
     #: adapt per-tenant draft depth from the acceptance-rate EMA
     #: (scheduler.SpecAcceptTracker); False pins spec_depth for everyone
     spec_adapt: bool = True
+    #: speculative VERIFY attention formulation. None = auto (the kernel
+    #: registry picks Pallas whenever the geometry allows — see
+    #: attn_registry.select_attention). False pins the XLA gather
+    #: formulation: under bf16 compute the two formulations round greedy
+    #: near-ties differently (sub-ulp logit gaps), so streams calibrated
+    #: bit-exact against a gather-verified baseline should pin False.
+    #: True requires the kernel and refuses construction when the
+    #: geometry can't serve it.
+    spec_verify_pallas: bool | None = None
     #: serving-SLO telemetry (telemetry/): TTFT / time-between-tokens /
     #: queue-wait histograms, per-step occupancy, KV-page utilization,
     #: host spans around dispatch/drain. True enables the PROCESS-WIDE
@@ -393,12 +406,27 @@ class InferenceEngineV2:
                     "is an eviction sink under the radix trie (enable "
                     "prefix_cache, or serve pack-mode linear where auto "
                     "turns it on)")
-            from .kvtier import KVTier, KVTierConfig
+            from .kvtier import (KVTier, KVTierConfig, auto_min_pages,
+                                 measure_tier_rates)
+            min_pages = cfg.kv_tier_min_pages
+            if min_pages is None:
+                # size the promote threshold from MEASURED tier rates
+                # instead of a guessed constant: one page's demoted
+                # payload is its full cross-layer K/V slab
+                m0 = self.mcfg
+                kv_bytes = 1 if cfg.kv_cache_dtype == "fp8" \
+                    else jnp.dtype(cfg.dtype).itemsize
+                page_bytes = int(2 * m0.num_layers * m0.kv_heads *
+                                 cfg.block_size * m0.head_dim * kv_bytes)
+                min_pages = auto_min_pages(
+                    measure_tier_rates(nvme_dir=cfg.kv_tier_nvme_dir),
+                    page_bytes=page_bytes, block_size=cfg.block_size,
+                    nvme=cfg.kv_tier_nvme_dir is not None)
             self._kv_tier = KVTier(KVTierConfig(
                 ram_bytes=cfg.kv_tier_ram_bytes,
                 nvme_dir=cfg.kv_tier_nvme_dir,
                 nvme_bytes=cfg.kv_tier_nvme_bytes,
-                min_pages=cfg.kv_tier_min_pages))
+                min_pages=min_pages))
             # eviction becomes demotion: the sink gathers reclaimed
             # chains to host and absorbs them into the tier (best-effort
             # — a sink failure is counted and eviction proceeds)
@@ -532,6 +560,50 @@ class InferenceEngineV2:
         self._pallas_decode = pallas_ok if cfg.use_pallas_decode is None \
             else cfg.use_pallas_decode
 
+        # ---- attention-formulation registry (attn_registry.py) ----------
+        # ONE static selection per dispatch mode: every hot-path dispatch
+        # consults these (and counts against them — see _emit_attn_kernel)
+        # instead of carrying its own kernel-vs-gather conditional. The
+        # reason string names WHY the gather fallback serves, for
+        # ds_report and debugging silent perf regressions.
+        from .attn_registry import select_attention
+        if self._pallas_decode:
+            no_pallas = ""
+        elif cfg.use_pallas_decode is False:
+            no_pallas = "use_pallas_decode=False (config pin)"
+        elif m.position_embedding == "alibi":
+            no_pallas = "alibi positional bias runs in the XLA path only"
+        elif not (topology.mesh.size == 1 or tp_ok):
+            no_pallas = (f"head counts ({m.num_heads}q/{m.kv_heads}kv) do "
+                         f"not divide the tensor axis ({tp})")
+        else:
+            no_pallas = ("kernel-unusable geometry (needs head_dim in "
+                         "{64,128,256}, block_size % 8 == 0, even GQA "
+                         "groups, and pltpu importable)")
+        # tree-verify stage width: _ragged_forward pads T nodes to
+        # max(8, T) rows, rounded up to a page multiple past one page
+        T_tree = max(cfg.spec_max_nodes, 1)
+        Ts_tree = max(8, T_tree)
+        if Ts_tree > cfg.block_size and Ts_tree % cfg.block_size:
+            Ts_tree += cfg.block_size - Ts_tree % cfg.block_size
+        sel_kw = dict(num_heads=m.num_heads, kv_heads=m.kv_heads,
+                      head_dim=m.head_dim, block_size=cfg.block_size,
+                      use_pallas=self._pallas_decode,
+                      reason_not_usable=no_pallas)
+        self._attn_decode_sel = select_attention(mode="decode", **sel_kw)
+        self._attn_tree_sel = select_attention(
+            mode="tree", tree_nodes=T_tree, stage_rows=Ts_tree, **sel_kw)
+        if cfg.spec_verify_pallas is False:
+            # formulation pin for gather-calibrated greedy streams: bf16
+            # verify rounds sub-ulp near-ties differently per formulation
+            from .attn_registry import AttnSelection
+            self._attn_tree_sel = AttnSelection(
+                "gather", "tree", "spec_verify_pallas=False (config pin)")
+        elif cfg.spec_verify_pallas and not self._attn_tree_sel.is_pallas:
+            raise ValueError(
+                "spec_verify_pallas=True but the tree-verify kernel can't "
+                f"serve this setup: {self._attn_tree_sel.reason}")
+
         # ---- ring collective-matmul TP (latency-hiding overlap) ----------
         # static geometry gate; programs whose row count doesn't divide the
         # axis additionally fall back per-program inside _ragged_forward
@@ -655,6 +727,12 @@ class InferenceEngineV2:
                       "spec_rounds": 0, "spec_verifies": 0,
                       "spec_proposed": 0, "spec_accepted": 0,
                       "spec_steps_saved": 0, "spec_accept_rate": 0.0,
+                      # attention-formulation split (attn_registry.py):
+                      # every decode/tree-verify dispatch counts against
+                      # the registry's selected path — a nonzero gather
+                      # count IS the visible fallback signal
+                      "attn_pallas_decode": 0, "attn_gather_decode": 0,
+                      "attn_pallas_tree": 0, "attn_gather_tree": 0,
                       # KV-page migration (inference/migration.py):
                       # disaggregated prefill/decode handoffs through
                       # this engine's pool, both directions + payload
@@ -1036,10 +1114,13 @@ class InferenceEngineV2:
         mask; the paged pool below the root stays position-causal).
         Returns ((k_ys, v_ys), logits[S, T, V]) — ALL-node logits, no
         pool merge: the caller merges only the ACCEPTED path's staged
-        rows, so rejected candidates never reach the pool. Always runs
-        the XLA gather formulation — the Pallas kernel's online softmax
-        is positional (tree-mask kernel support is a ROADMAP item) — and
-        never rings (all-position logits need the full residual stream).
+        rows, so rejected candidates never reach the pool. The Pallas
+        kernel serves tree mode too (per-node stage positions + the
+        ancestors mask ride into the kernel) whenever the registry's
+        tree selection picks it (attn_registry.select_attention —
+        geometry gates on top of the decode gate); the XLA gather
+        formulation is the counted fallback. Tree mode never rings
+        (all-position logits need the full residual stream).
         """
         m = self.mcfg
         cfg = self.config
@@ -1342,7 +1423,27 @@ class InferenceEngineV2:
             ring = self._ring_tokens
             li_dev = jnp.asarray(li, jnp.int32)
             q_starts = positions[:, 0]
-            if self._pallas_decode and not tree_mode:
+            # kernel-vs-gather comes from the attention registry's static
+            # per-mode selection (attn_registry.py) — the ONLY dispatch
+            # decision point, pinned by check_attn_registry in
+            # bin/check_state_invariants.py
+            sel = self._attn_tree_sel if tree_mode else self._attn_decode_sel
+            if sel.is_pallas:
+                # tree-verify stages ride two extra replicated operands:
+                # per-node absolute positions (root+depth) and the
+                # ancestors-only mask over the stage columns
+                t_ops = (positions, tree_mask) if tree_mode else ()
+                t_specs = (P(None, None), P(None, None, None)) \
+                    if tree_mode else ()
+
+                def _kernel(qq, pp, ks, vs, bt, sl, qs, ss, lr, *t):
+                    return paged_ragged_attention(
+                        qq, pp, ks, vs, bt, sl, qs, ss,
+                        block_size=bs, layer_index=lr, window=win,
+                        ring_tokens=ring,
+                        tree_positions=t[0] if t else None,
+                        tree_mask=t[1] if t else None)
+
                 mesh = self.topology.mesh
                 if mesh.size > 1:
                     # per-shard over the tensor axis: q on query heads, the
@@ -1350,28 +1451,22 @@ class InferenceEngineV2:
                     from jax import shard_map
 
                     o = shard_map(
-                        lambda qq, pp, ks, vs, bt, sl, qs, ss, lr:
-                        paged_ragged_attention(
-                            qq, pp, ks, vs, bt, sl, qs, ss,
-                            block_size=bs, layer_index=lr, window=win,
-                            ring_tokens=ring),
+                        _kernel,
                         mesh=mesh,
                         in_specs=(P(None, None, "tensor", None),
                                   P(None, None, "tensor", None, None, None),
                                   P(None, "tensor", None, None),
                                   P(None, "tensor", None, None),
                                   P(None, None), P(None), P(None), P(None),
-                                  P()),
+                                  P(), *t_specs),
                         out_specs=P(None, None, "tensor", None),
                         check_vma=False,
                     )(q, ro_pool, k_st, v_st, block_tables, seq_lens,
-                      q_starts, stage_starts, li_dev)
+                      q_starts, stage_starts, li_dev, *t_ops)
                 else:
-                    o = paged_ragged_attention(
-                        q, ro_pool, k_st, v_st, block_tables,
-                        seq_lens, q_starts, stage_starts,
-                        block_size=bs, layer_index=li_dev, window=win,
-                        ring_tokens=ring)
+                    o = _kernel(q, ro_pool, k_st, v_st, block_tables,
+                                seq_lens, q_starts, stage_starts, li_dev,
+                                *t_ops)
             else:
                 # fallback (alibi / odd geometries): gather each slot's
                 # pool pages (valid < stage_starts) and append the stage.
@@ -1981,6 +2076,7 @@ class InferenceEngineV2:
         self.stats["plan_s"] += time.perf_counter() - t0
 
         t0 = time.perf_counter()
+        self._emit_attn_kernel("decode")
         with self._telem.span("dispatch", kind="window", W=W):
             fn = self._window_program(W)
             self._rng, sub = jax.random.split(self._rng)
@@ -2172,6 +2268,9 @@ class InferenceEngineV2:
             self.stats["plan_s"] += time.perf_counter() - t0
 
             t0 = time.perf_counter()
+            # no-silent-fallback contract: EVERY verify dispatch counts
+            # against the registry's tree selection (pallas or gather)
+            self._emit_attn_kernel("tree")
             with self._telem.span("dispatch", kind="spec_verify", T=T):
                 fn = self._spec_program(T)
                 self._rng, sub = jax.random.split(self._rng)
@@ -2332,6 +2431,7 @@ class InferenceEngineV2:
         else:
             self.stats["decode_steps"] += 1
             self.stats["decode_tokens"] += n_tok
+            self._emit_attn_kernel("decode")
         if self._telem.enabled:
             self._record_dispatch_telemetry(
                 plan.kind, n_tok, int(np.prod(plan.token_ids.shape)),
@@ -3195,6 +3295,22 @@ class InferenceEngineV2:
                     f"swap {swap_s * 1e3:.1f}ms")
         return {"wv": self.weight_version(),
                 "quiesce_s": quiesce_s, "swap_s": swap_s}
+
+    def _emit_attn_kernel(self, mode: str) -> None:
+        """Count one decode/tree-verify dispatch against the attention
+        formulation the registry selected (attn_registry.py). The stats
+        split is unconditional — no silent fallback: a spec-verify round
+        served by the gather path ALWAYS shows as attn_gather_tree — and
+        the labeled counter rides telemetry when enabled."""
+        sel = self._attn_tree_sel if mode == "tree" else self._attn_decode_sel
+        self.stats[f"attn_{sel.path}_{mode}"] += 1
+        if self._telem.enabled:
+            self._telem.registry.counter(
+                "serving_attn_kernel_total",
+                labels={"path": sel.path, "mode": mode},
+                help="decode/tree-verify dispatches by the attention "
+                     "formulation the registry selected (pallas kernel "
+                     "vs XLA gather fallback)").inc()
 
     def _record_dispatch_telemetry(self, kind: str, useful: int,
                                    budget: int, uids) -> None:

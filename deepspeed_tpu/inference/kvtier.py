@@ -770,6 +770,49 @@ def measure_tier_rates(nvme_dir: str | None = None,
     return out
 
 
+#: fixed per-promote overhead the sizing model amortizes over the
+#: chain: the admit-time probe walk, adopt_prefix bookkeeping, and ONE
+#: device scatter dispatch — costs that do NOT scale with chain length
+#: (the per-page payload copy is what the tier-rate probe prices)
+PROMOTE_FIXED_S = 1e-3
+
+#: conservative prefill-rate guess (tokens/s) when the caller has no
+#: measured rate — the same default the router's pull-vs-recompute cost
+#: model ships (serving/router.RouterConfig.kv_pull_prefill_tok_s)
+GUESS_PREFILL_TOK_S = 2000.0
+
+
+def auto_min_pages(rates: dict, *, page_bytes: int, block_size: int,
+                   nvme: bool = False,
+                   prefill_tok_s: float = GUESS_PREFILL_TOK_S,
+                   fixed_s: float = PROMOTE_FIXED_S,
+                   cap: int = 64) -> int:
+    """Size :attr:`KVTierConfig.min_pages` from MEASURED tier rates
+    (:func:`measure_tier_rates`) instead of a guessed constant.
+
+    Promoting an n-page chain costs ``fixed_s + n * page_bytes / rate``;
+    recomputing it costs ``n * block_size / prefill_tok_s``. The
+    break-even chain length is the smallest integer n where promoting
+    wins — shorter tier hits are cheaper to just recompute, so min_pages
+    filters them out of the admit probe. When the per-page promote cost
+    alone exceeds the per-page recompute cost no chain length ever wins:
+    return ``cap`` so only very deep chains promote (never 0 — a zero
+    threshold would "promote" empty probe results).
+
+    ``nvme`` selects which measured rate bounds the promote: a spilled
+    chain reads at NVMe speed, a RAM-resident one at copy speed.
+    """
+    rate = float(rates.get("nvme_bytes_s" if nvme else "ram_bytes_s")
+                 or 0.0)
+    t_promote_page = page_bytes / max(rate, 1e-9)
+    t_recompute_page = block_size / max(prefill_tok_s, 1e-9)
+    if t_promote_page >= t_recompute_page:
+        return cap
+    import math
+    n = fixed_s / (t_recompute_page - t_promote_page)
+    return max(1, min(cap, math.ceil(n)))
+
+
 def scale_sidecar_encode(arr_bytes: bytes) -> str:
     """Base64 form for per-page quant-scale sidecars riding tier
     records / prefix bundles (the engine's fp8-KV pool is scale-free, so

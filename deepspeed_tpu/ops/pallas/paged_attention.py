@@ -139,7 +139,7 @@ def _ragged_attn_kernel(tables_ref, lens_ref, qst_ref, sst_ref, layer_ref,
                         scale: float, G: int, window: int,
                         ring_tokens: int, n_stage_pages: int,
                         page_group: int, n_pool: int,
-                        p_scale: float = 1.0):
+                        p_scale: float = 1.0, tree: bool = False):
     """Read-only-pool ragged attention, ALL kv heads per grid step.
 
     Round-4 redesign of :func:`_paged_attn_kernel` driven by two measured
@@ -165,16 +165,32 @@ def _ragged_attn_kernel(tables_ref, lens_ref, qst_ref, sst_ref, layer_ref,
        steps ~page_group-fold; tail/invalid sub-pages map to the trash
        block so the pipeline elides their re-fetch.
 
+    ``tree`` (the speculative-verify form): each query row is a
+    candidate-tree NODE, not a token of a contiguous chunk. Two extra
+    VMEM inputs ride along — per-row absolute positions (root + depth;
+    siblings share one, so the row-index ramp can't recover them) and
+    the ancestors-only visibility mask over the stage columns. Pool
+    pages keep the positional-causal walk (every node descends from the
+    committed context, with positions read from the input instead of
+    the ramp); stage columns take the tree mask VERBATIM, replacing the
+    positional mask — exactly the gather formulation's split in
+    inference/engine_v2.py `_ragged_forward`.
+
     Grid (S, q-tiles, ceil(n_pool/page_group) + n_stage_pages).
-    ``refs`` = (q, k_0..k_{Gp-1}, v_0..v_{Gp-1}, k_stage, v_stage, o,
-    m_scr, l_scr, acc_scr).
+    ``refs`` = (q, k_0..k_{Gp-1}, v_0..v_{Gp-1}, k_stage, v_stage,
+    [tpos, tmask when tree,] o, m_scr, l_scr, acc_scr).
     """
     del layer_ref
     Gp = page_group
     q_ref = refs[0]
     kp_refs = refs[1:1 + Gp]
     vp_refs = refs[1 + Gp:1 + 2 * Gp]
-    ks_ref, vs_ref, o_ref, m_scr, l_scr, acc_scr = refs[1 + 2 * Gp:]
+    ks_ref, vs_ref = refs[1 + 2 * Gp:3 + 2 * Gp]
+    if tree:
+        tpos_ref, tmask_ref = refs[3 + 2 * Gp:5 + 2 * Gp]
+        o_ref, m_scr, l_scr, acc_scr = refs[5 + 2 * Gp:]
+    else:
+        o_ref, m_scr, l_scr, acc_scr = refs[3 + 2 * Gp:]
     s = pl.program_id(0)
     tq = pl.program_id(1)          # query-row tile (VMEM-bounds long chunks)
     j = pl.program_id(2)
@@ -193,7 +209,7 @@ def _ragged_attn_kernel(tables_ref, lens_ref, qst_ref, sst_ref, layer_ref,
     is_stage = j >= n_grp
     tqb = m_scr.shape[1]           # query rows per tile
 
-    def online_update(scores, ctx, valid, v):
+    def online_update(scores, ctx, valid, v, tree_cols=False):
         """Shared online-softmax step. scores [KV, TQB, W]; ctx [KV,TQB,W]
         absolute key positions; valid bool; v [KV, W, D].
 
@@ -205,12 +221,26 @@ def _ragged_attn_kernel(tables_ref, lens_ref, qst_ref, sst_ref, layer_ref,
         SAME scale keeps the final acc/l division exact while every fp8
         code stays normal out to ~200k-token contexts. Constant across all
         grid steps of a program (pool and stage alike) so the online
-        alpha-rescaling algebra is unchanged."""
-        qpos = qstart + (tq * tqb + jax.lax.broadcasted_iota(
-            jnp.int32, scores.shape, 1)) // G
-        mask = valid & (ctx <= qpos)
-        if window:
-            mask &= ctx > qpos - window
+        alpha-rescaling algebra is unchanged.
+
+        ``tree_cols``: the stage columns of a tree-verify step — ``valid``
+        IS the ancestors-only mask and replaces the positional mask
+        outright (the tree mask already encodes reachability; window/
+        causal checks would wrongly prune sibling-position nodes)."""
+        if tree_cols:
+            mask = valid
+        else:
+            if tree:
+                # tree nodes sit at root+depth, siblings SHARING a
+                # position — unrecoverable from the row ramp, so the
+                # positions ride a VMEM input ([1, TQB] rows t*G+g)
+                qpos = tpos_ref[0][None, :, None]
+            else:
+                qpos = qstart + (tq * tqb + jax.lax.broadcasted_iota(
+                    jnp.int32, scores.shape, 1)) // G
+            mask = valid & (ctx <= qpos)
+            if window:
+                mask &= ctx > qpos - window
         scores = jnp.where(mask, scores, NEG_INF)
         m_prev = m_scr[:]                                  # [KV, TQB, 1]
         m_new = jnp.maximum(m_prev,
@@ -288,8 +318,16 @@ def _ragged_attn_kernel(tables_ref, lens_ref, qst_ref, sst_ref, layer_ref,
     # ---- stage steps (this program's fresh tokens, page-sized tiles) -----
     sp = jnp.maximum(j - n_grp, 0)           # stage page index
     srows = ks_ref.shape[2]                  # rows per stage page
+    if tree:
+        # every stage row is a candidate NODE — a branchy tree packs more
+        # nodes than its depth, so seq_len (root+1+max_depth) undercounts
+        # the live stage rows; the ancestors mask governs visibility, the
+        # gate only skips fully-empty slots
+        run_stage = is_stage & (seq_len > 0)
+    else:
+        run_stage = is_stage & (sstart + sp * srows < seq_len)
 
-    @pl.when(is_stage & (sstart + sp * srows < seq_len))
+    @pl.when(run_stage)
     def _stage_step():
         q = q_ref[0]                                       # [KV, TQB, D]
         k = ks_ref[0]                                      # [KV, srows, D]
@@ -299,7 +337,15 @@ def _ragged_attn_kernel(tables_ref, lens_ref, qst_ref, sst_ref, layer_ref,
             preferred_element_type=jnp.float32) * scale
         ctx = sstart + sp * srows + jax.lax.broadcasted_iota(
             jnp.int32, scores.shape, 2)
-        online_update(scores, ctx, ctx < seq_len, v)
+        if tree:
+            # stage rows are the candidate nodes themselves: visibility is
+            # the prebuilt ancestors-only mask ([1, TQB, srows] tile for
+            # this stage page), NOT position order — sibling nodes share a
+            # position but must not see each other
+            online_update(scores, ctx, tmask_ref[0][None] > 0, v,
+                          tree_cols=True)
+        else:
+            online_update(scores, ctx, ctx < seq_len, v)
 
     @pl.when(j == nj - 1)
     def _finalize():
@@ -315,6 +361,7 @@ def paged_ragged_attention(q, pool, k_stage, v_stage, block_tables,
                            window: int | None = None,
                            ring_tokens: int | None = None,
                            page_group: int | None = None,
+                           tree_positions=None, tree_mask=None,
                            interpret: bool | None = None):
     """Ragged attention over a READ-ONLY paged pool plus a staged tail.
 
@@ -329,6 +376,14 @@ def paged_ragged_attention(q, pool, k_stage, v_stage, block_tables,
     block_tables: [S, max_pages] int32 (pad with the trash block 0)
     seq_lens:     [S] — total valid context incl. staged tokens
     layer_index:  scalar — which pool layer this call reads
+
+    Tree-verify form (speculative decoding): pass ``tree_positions``
+    [S, T] int32 (absolute position of each candidate node, root+depth —
+    siblings share one) and ``tree_mask`` [S, T, T] (nonzero where node
+    row may attend node column: ancestors + self). The T query rows are
+    then tree NODES whose K/V sit in the stage at rows 0..T-1; pool
+    pages keep the positional-causal walk using the per-node positions,
+    stage columns take the mask verbatim. Both args come together.
     Returns [S, T, H, D].
     """
     S, T, H, D = q.shape
@@ -340,6 +395,18 @@ def paged_ragged_attention(q, pool, k_stage, v_stage, block_tables,
     G = H // KV
     Ts = k_stage.shape[2]
     max_pages = block_tables.shape[1]
+    tree = tree_positions is not None
+    if tree != (tree_mask is not None):
+        raise ValueError("tree_positions and tree_mask come together")
+    if tree:
+        if tree_positions.shape != (S, T):
+            raise ValueError(f"tree_positions {tree_positions.shape} != "
+                             f"{(S, T)}")
+        if tree_mask.shape != (S, T, T):
+            raise ValueError(f"tree_mask {tree_mask.shape} != {(S, T, T)}")
+        if Ts < T:
+            raise ValueError(f"stage rows {Ts} must cover the {T} tree "
+                             f"nodes")
     if ring_tokens and not window:
         raise ValueError("ring buffer requires a sliding window")
     if scale is None:
@@ -403,6 +470,26 @@ def paged_ragged_attention(q, pool, k_stage, v_stage, block_tables,
             lambda s, tq, j, t, ln, qs, ss, lr:
                 (s, 0, jnp.maximum(j - n_grp, 0), 0))
 
+    tree_ops = ()
+    tree_specs = []
+    if tree:
+        # per-ROW node positions: expand [S, T] to the kernel's t*G+g row
+        # layout so row r's position is tpos[r // G]; the mask expands the
+        # same way on rows and zero-pads columns out to the stage width
+        # (padding columns are invisible — ancestor_mask already zeroes
+        # past-tree columns, and zero mask == masked out)
+        tpos = jnp.repeat(tree_positions.astype(jnp.int32), G, axis=1)
+        tmsk = jnp.repeat(tree_mask.astype(jnp.int32), G, axis=1)
+        tmsk = jnp.pad(tmsk, ((0, 0), (0, 0), (0, Ts - T)))
+        tree_ops = (tpos, tmsk)
+        tree_specs = [
+            pl.BlockSpec((1, TQB),
+                         lambda s, tq, j, t, ln, qs, ss, lr: (s, tq)),
+            pl.BlockSpec((1, TQB, srows),
+                         lambda s, tq, j, t, ln, qs, ss, lr:
+                             (s, tq, jnp.maximum(j - n_grp, 0))),
+        ]
+
     grid_spec = pltpu.PrefetchScalarGridSpec(
         num_scalar_prefetch=5,
         grid=(S, TG // TQB, n_grp + nsp),
@@ -413,6 +500,7 @@ def paged_ragged_attention(q, pool, k_stage, v_stage, block_tables,
             *[pool_spec(1, i) for i in range(Gp)],
             stage_spec(),
             stage_spec(),
+            *tree_specs,
         ],
         out_specs=pl.BlockSpec((1, KV, TQB, D),
                                lambda s, tq, j, t, ln, qs, ss, lr:
@@ -432,14 +520,14 @@ def paged_ragged_attention(q, pool, k_stage, v_stage, block_tables,
                           scale=float(scale), G=G, window=int(window or 0),
                           ring_tokens=int(ring_tokens or 0),
                           n_stage_pages=nsp, page_group=Gp, n_pool=n_pool,
-                          p_scale=p_scale),
+                          p_scale=p_scale, tree=tree),
         grid_spec=grid_spec,
         out_shape=jax.ShapeDtypeStruct((S, KV, TG, D), q.dtype),
         interpret=interpret,
     )(block_tables.astype(jnp.int32), seq_lens.astype(jnp.int32),
       q_starts.astype(jnp.int32), stage_starts.astype(jnp.int32),
       jnp.asarray(layer_index, jnp.int32).reshape(1),
-      qg, *([pool] * Gp), *([pool] * Gp), k_stage, v_stage)
+      qg, *([pool] * Gp), *([pool] * Gp), k_stage, v_stage, *tree_ops)
     return (out.reshape(S, KV, T, G, D).transpose(0, 2, 1, 3, 4)
             .reshape(S, T, H, D))
 

@@ -818,3 +818,32 @@ def test_toy_page_payload_stable():
     # functions of the chain hash
     assert toy_page_payload(7) == toy_page_payload(7)
     assert toy_page_payload(7) != toy_page_payload(8)
+
+
+def test_auto_min_pages_break_even_and_cap():
+    """auto_min_pages sizes the promote-vs-recompute break-even from the
+    measured byte rates: fast tiers admit short chains, slow tiers push
+    the threshold up, and a tier whose per-page promote can never beat
+    the recompute returns the cap (never 0 — an empty probe must not
+    'promote')."""
+    from deepspeed_tpu.inference.kvtier import auto_min_pages
+
+    kw = dict(page_bytes=1 << 16, block_size=64, prefill_tok_s=2000.0,
+              fixed_s=1e-2)
+    # fast RAM: per-page promote (65536/1e9 = 65us) << recompute (32ms)
+    # -> the fixed cost amortizes after a single page
+    fast = auto_min_pages({"ram_bytes_s": 1e9}, **kw)
+    assert fast == 1
+    # slower tier -> higher threshold, still finite
+    slow = auto_min_pages({"ram_bytes_s": 2.2e6}, **kw)
+    assert fast < slow < 64
+    # nvme flag selects the NVMe rate
+    nv = auto_min_pages({"ram_bytes_s": 1e9, "nvme_bytes_s": 2.2e6},
+                        nvme=True, **kw)
+    assert nv == slow
+    # promote-per-page >= recompute-per-page: no break-even, cap wins
+    assert auto_min_pages({"ram_bytes_s": 1e3}, **kw) == 64
+    assert auto_min_pages({}, **kw) == 64          # missing rate == dead
+    # explicit cap respected on the no-win path and the clamp path
+    assert auto_min_pages({"ram_bytes_s": 1e3}, cap=7, **{k: v for k, v
+                          in kw.items()}) == 7

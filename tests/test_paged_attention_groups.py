@@ -123,3 +123,47 @@ def test_fp8_pool_p_scaling_matches_fp32_long_context():
     # relative effect, NOT a long-context collapse
     assert np.abs(a - b).max() < 0.08
     assert np.abs(a - b).mean() < 0.02
+
+
+def test_page_group_matches_on_tree_verify():
+    """Tree-verify queries (speculative decoding) through the grouped
+    path: the stage columns carry the ancestors-only mask while the pool
+    walk keeps positional causality from the per-node positions, so the
+    grouped schedule must reproduce the ungrouped one on BOTH masking
+    regimes at once. Branchy tree: two depth-1 siblings share a position,
+    a chain hangs under one of them."""
+    rng = np.random.default_rng(13)
+    T = 6
+    pool, q, ks, vs, tables = _inputs(rng, T=T, Ts=8)
+    parents = [-1, 0, 0, 1, 2, 3]
+    depth = [0, 1, 1, 2, 2, 3]
+    S = q.shape[0]
+    pos = np.zeros((S, T), np.int32)
+    mask = np.zeros((S, T, T), np.uint8)
+    lens, sst = np.zeros((S,), np.int32), np.zeros((S,), np.int32)
+    for s in range(S):
+        root = 18 - s * 7
+        pos[s] = [root + d for d in depth]
+        for i in range(T):
+            j = i
+            while j != -1:
+                mask[s, i, j] = 1
+                j = parents[j]
+        lens[s] = root + 1 + max(depth)
+        sst[s] = root
+    tree = dict(tree_positions=jnp.asarray(pos), tree_mask=jnp.asarray(mask))
+
+    def run(pg, window=None):
+        return paged_ragged_attention(
+            q, pool, ks, vs, tables, jnp.asarray(lens),
+            jnp.asarray(pos[:, 0].copy()), jnp.asarray(sst), block_size=8,
+            layer_index=jnp.int32(1), window=window, page_group=pg,
+            interpret=True, **tree)
+
+    for window in (None, 9):
+        base = run(None, window)
+        for pg in (2, 4):
+            np.testing.assert_allclose(np.asarray(run(pg, window)),
+                                       np.asarray(base),
+                                       rtol=1e-5, atol=1e-5,
+                                       err_msg=f"pg={pg} window={window}")

@@ -647,3 +647,66 @@ def test_state_invariant_detector_pins_evict_sink_attach(tmp_path):
         "    def __init__(self):\n"
         "        self._prefix_cache.evict_sink = self._demote_evicted\n")
     assert state_lint.check_file(str(ok)) == []
+
+
+def test_repo_attn_dispatch_routes_through_registry():
+    """Tree-verify dispatch pin: the kernel-vs-gather decision for BOTH
+    decode and tree modes is attn_registry's static per-engine selection,
+    consulted in exactly one forward site. Ad-hoc conditionals are how
+    the tree branch silently pinned the gather formulation for 10 PRs."""
+    violations = state_lint.check_attn_registry(ROOT)
+    assert violations == [], "\n".join(violations)
+
+
+def test_attn_registry_detector_flags_adhoc_dispatch(tmp_path):
+    eng = tmp_path / "deepspeed_tpu" / "inference" / "engine_v2.py"
+    eng.parent.mkdir(parents=True)
+    eng.write_text(
+        "class Engine:\n"
+        "    def __init__(self):\n"
+        "        self._attn_decode_sel = select_attention(mode='x')\n"
+        "        self._attn_tree_sel = select_attention(mode='y')\n"
+        "    def _sneaky(self):\n"
+        "        self._attn_tree_sel = select_attention(mode='z')\n"  # call + store
+        "        if self._attn_decode_sel.is_pallas:\n"              # read
+        "            return paged_ragged_attention()\n")             # kernel call
+    out = state_lint.check_attn_registry(str(tmp_path))
+    assert len(out) == 4, "\n".join(out)
+    assert ":6:" in out[0] and "_attn_tree_sel" in out[0] \
+        and "assigned" in out[0]
+    assert ":6:" in out[1] and "select_attention()" in out[1]
+    assert ":7:" in out[2] and "_attn_decode_sel" in out[2] \
+        and "read" in out[2]
+    assert ":8:" in out[3] and "paged_ragged_attention()" in out[3]
+    # the blessed shape is clean
+    eng.write_text(
+        "class Engine:\n"
+        "    def __init__(self):\n"
+        "        self._attn_decode_sel = select_attention(mode='x')\n"
+        "        self._attn_tree_sel = select_attention(mode='y')\n"
+        "        if self._attn_tree_sel.is_pallas:\n"   # init pin compose
+        "            pass\n"
+        "    def _ragged_forward(self):\n"
+        "        sel = self._attn_tree_sel\n"
+        "        if sel.is_pallas:\n"
+        "            return paged_ragged_attention()\n"
+        "    def _emit_attn_kernel(self, mode):\n"
+        "        return self._attn_decode_sel.path\n")
+    assert state_lint.check_attn_registry(str(tmp_path)) == []
+    # no engine file at all (foreign checkout): not this lint's problem
+    assert state_lint.check_attn_registry(str(tmp_path / "nope")) == []
+
+
+def test_attn_registry_detector_requires_selection_reads(tmp_path):
+    """A forward that consults NEITHER selection means dispatch regressed
+    to an inline conditional — flagged even with zero other violations."""
+    eng = tmp_path / "deepspeed_tpu" / "inference" / "engine_v2.py"
+    eng.parent.mkdir(parents=True)
+    eng.write_text(
+        "class Engine:\n"
+        "    def _ragged_forward(self):\n"
+        "        if self._use_pallas:\n"
+        "            return paged_ragged_attention()\n")
+    out = state_lint.check_attn_registry(str(tmp_path))
+    assert len(out) == 1, "\n".join(out)
+    assert "no longer consults the attention registry" in out[0]
