@@ -231,6 +231,21 @@ def feature_report() -> list[tuple[str, bool, str]]:
         feats.append(("serving: crash-safe router (journal + resync)",
                       False, str(e)))
 
+    # elastic fleet actuators (serving/elastic.py): scale hints become
+    # journaled drain/spawn/re-role — pure host logic, import check
+    try:
+        from .serving import elastic as _elastic  # noqa: F401
+        feats.append((
+            "serving: elastic fleet (drain/spawn/re-role)", True,
+            "RouterConfig.elastic=True — sustained scale hints drive "
+            "journaled deadline-bounded drain/retire (KV-tier flush), "
+            "spawn with peer pre-warm, prefill<->decode re-role; "
+            "SIGTERM / GCE maintenance preemption exits 83 (classified, "
+            "no breaker); BENCH_MODE=elastic"))
+    except Exception as e:  # pragma: no cover — import breakage only
+        feats.append(("serving: elastic fleet (drain/spawn/re-role)",
+                      False, str(e)))
+
     # telemetry / monitor backends (telemetry/ + monitor/): which push
     # backends can actually activate, and where the pull endpoint +
     # flight recorder would land for this process

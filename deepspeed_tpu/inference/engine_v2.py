@@ -3096,7 +3096,12 @@ class InferenceEngineV2:
             logger.warning(f"engine_v2: tier promote refused ({e}); "
                            f"recomputing")
             return 0
-        tier.note_promote_latency(time.perf_counter() - t0)
+        tier.note_promote_latency(time.perf_counter() - t0, pages=pages)
+        if self.config.kv_tier_min_pages is None:
+            # auto-sized threshold: once enough promotes were observed
+            # end-to-end, the LIVE latency record re-sizes the break-even
+            # (an explicit config value is never second-guessed)
+            tier.refine_min_pages(block_size=bs)
         self.stats["kv_tier_promotes"] += 1
         self.stats["kv_tier_promoted_tokens"] += (deep - have) * bs
         if self._rt.enabled:

@@ -79,6 +79,17 @@ SPILL_SUFFIX = ".seg"
 GUESS_RAM_BYTES_S = 8e9
 GUESS_NVME_BYTES_S = 1.2e9
 
+#: fixed per-promote overhead the sizing model amortizes over the
+#: chain: the admit-time probe walk, adopt_prefix bookkeeping, and ONE
+#: device scatter dispatch — costs that do NOT scale with chain length
+#: (the per-page payload copy is what the tier-rate probe prices)
+PROMOTE_FIXED_S = 1e-3
+
+#: conservative prefill-rate guess (tokens/s) when the caller has no
+#: measured rate — the same default the router's pull-vs-recompute cost
+#: model ships (serving/router.RouterConfig.kv_pull_prefill_tok_s)
+GUESS_PREFILL_TOK_S = 2000.0
+
 
 class KVTierError(RuntimeError):
     """A tier operation failed (callers degrade to recompute)."""
@@ -402,6 +413,12 @@ class KVTier:
         #: recent promote wall-times, drained into the telemetry
         #: histogram at heartbeat cadence (bounded)
         self.promote_latencies: list[float] = []
+        #: CUMULATIVE promote-latency accumulator — the live refinement
+        #: of ``min_pages`` (:meth:`refine_min_pages`) reads this, NOT
+        #: ``promote_latencies`` (that list is drained-and-cleared into
+        #: the telemetry histogram, so it cannot carry a running rate)
+        self.promote_obs = {"count": 0, "total_s": 0.0, "pages": 0}
+        self.min_pages_refinements = 0
         # loss high-water marks (_note_loss): ANY record loss — ring
         # drop, spill cap eviction, torn/crc drop — must bump `version`
         # so the heartbeat re-ships the SHRUNK digest (a stale digest
@@ -664,9 +681,46 @@ class KVTier:
         self.promoted_pages += len(pages)
         return bundle
 
-    def note_promote_latency(self, dt_s: float) -> None:
+    def note_promote_latency(self, dt_s: float, pages: int = 0) -> None:
         if len(self.promote_latencies) < 512:
             self.promote_latencies.append(float(dt_s))
+        self.promote_obs["count"] += 1
+        self.promote_obs["total_s"] += float(dt_s)
+        self.promote_obs["pages"] += max(int(pages), 0)
+
+    def refine_min_pages(self, *, block_size: int,
+                         prefill_tok_s: float = GUESS_PREFILL_TOK_S,
+                         fixed_s: float = PROMOTE_FIXED_S, cap: int = 64,
+                         min_samples: int = 16) -> int | None:
+        """Re-size the promote threshold from the LIVE promote-latency
+        record instead of the startup micro-probe's byte-rate break-even
+        (:func:`auto_min_pages`): the probe prices raw tier reads, but a
+        real promote also pays crc checks, payload verification and the
+        adopt/scatter — all of which :meth:`note_promote_latency`
+        observed end to end. Once ``min_samples`` promotes accumulated,
+        the observed per-page promote time replaces the probed rate in
+        the same break-even (amortizing each promote's fixed overhead
+        into the per-page figure, which biases ``min_pages`` slightly
+        HIGH — the safe side: recompute is always correct). Cheap enough
+        for heartbeat cadence; returns the applied value, or None while
+        the sample budget is unmet. An explicitly configured
+        ``min_pages`` stays authoritative — callers only wire this up
+        when the startup value was itself auto-sized."""
+        obs = self.promote_obs
+        if obs["count"] < max(int(min_samples), 1) or obs["pages"] <= 0:
+            return None
+        t_promote_page = obs["total_s"] / obs["pages"]
+        t_recompute_page = block_size / max(float(prefill_tok_s), 1e-9)
+        if t_promote_page >= t_recompute_page:
+            n = int(cap)
+        else:
+            import math
+            n = max(1, min(int(cap), math.ceil(
+                fixed_s / (t_recompute_page - t_promote_page))))
+        if n != self.cfg.min_pages:
+            self.cfg.min_pages = n
+            self.min_pages_refinements += 1
+        return n
 
     # -- introspection ----------------------------------------------------
     def residency_digest(self, max_entries: int = 4096) -> list[int]:
@@ -694,6 +748,9 @@ class KVTier:
             "probe_hits": self.probe_hits,
             "probe_misses": self.probe_misses,
             "fallbacks": dict(self.fallbacks),
+            "min_pages": self.cfg.min_pages,
+            "min_pages_refinements": self.min_pages_refinements,
+            "promote_obs_count": self.promote_obs["count"],
             "torn_skipped": (self.spill.torn_skipped
                              if self.spill else 0),
             "spill_evicted_pages": (self.spill.evicted_pages
@@ -768,18 +825,6 @@ def measure_tier_rates(nvme_dir: str | None = None,
             except OSError:
                 pass
     return out
-
-
-#: fixed per-promote overhead the sizing model amortizes over the
-#: chain: the admit-time probe walk, adopt_prefix bookkeeping, and ONE
-#: device scatter dispatch — costs that do NOT scale with chain length
-#: (the per-page payload copy is what the tier-rate probe prices)
-PROMOTE_FIXED_S = 1e-3
-
-#: conservative prefill-rate guess (tokens/s) when the caller has no
-#: measured rate — the same default the router's pull-vs-recompute cost
-#: model ships (serving/router.RouterConfig.kv_pull_prefill_tok_s)
-GUESS_PREFILL_TOK_S = 2000.0
 
 
 def auto_min_pages(rates: dict, *, page_bytes: int, block_size: int,

@@ -204,6 +204,21 @@ class FaultInjector:
                                              request recomputes,
                                              bit-identical
 
+    Elastic points (serving/elastic.py + the replica preemption path;
+    armed per-slot via the replica config's ``faults``):
+      ``replica_crash_mid_drain_flush`` (int k)  die HARD between the
+                                             k-th drained chain's tier
+                                             spill and the retire exit —
+                                             the torn record is skipped
+                                             on the next open and the
+                                             router replays the in-flight
+                                             requests elsewhere
+      ``preempt_ignore_deadline`` (bool)     a preempted replica keeps
+                                             decoding past its emergency
+                                             deadline (the misbehaving-
+                                             worker shape: the router's
+                                             liveness timeout reaps it)
+
     Router-side points (serving/router.py, armed via
     ``RouterConfig.faults`` and always HARD — the journal chaos matrix
     SIGKILLs the CONTROL PLANE at each journaled phase, all count-based
@@ -227,6 +242,12 @@ class FaultInjector:
                                              deploy sits in its canary
                                              phase (recovery rolls the
                                              fleet back deterministically)
+      ``router_crash_mid_elastic`` (int k)   die right after journaling
+                                             the k-th elastic transition
+                                             (restart must neither
+                                             resurrect a retiring
+                                             replica nor forget a
+                                             half-spawned one)
 
     Crashes raise :class:`InjectedFault` (catchable in-process), or hard-kill
     the process with ``os._exit(INJECTED_CRASH_EXIT_CODE)`` when
@@ -387,6 +408,86 @@ class PreemptionHandler:
 
     def clear(self) -> None:
         self._requested = None
+
+
+class GceMaintenancePoller:
+    """GCE ``maintenance-event`` metadata poller — the pluggable hook the
+    :class:`PreemptionHandler` was built for. On GCE/TPU-VM hosts the
+    metadata server announces host maintenance (live migration or
+    termination) on
+    ``/computeMetadata/v1/instance/maintenance-event`` minutes before
+    the SIGTERM lands; polling it turns preemption from a signal race
+    into a planned drain (training: priority checkpoint; serving: the
+    elastic drain-flush-exit path in serving/replica.py).
+
+    The poller is a callable returning falsy (no event / error / rate
+    limit) or the event string (truthy → ``request("maintenance:<ev>")``
+    via the handler's hook protocol). ``base_url`` is the test seam: a
+    fake metadata HTTP server stands in for
+    ``http://metadata.google.internal`` (real-TPU validation stays on
+    the ROADMAP's blocked list). Every fetch carries ``timeout_s`` —
+    a wedged metadata server must never wedge a step boundary — and
+    ``interval_s`` rate-limits the HTTP round-trips (between polls the
+    hook returns the cached verdict's falsy side, never a stale event).
+    """
+
+    METADATA_PATH = "/computeMetadata/v1/instance/maintenance-event"
+    #: metadata values that mean "nothing scheduled"
+    QUIET = ("", "NONE")
+
+    def __init__(self, base_url: str = "http://metadata.google.internal",
+                 interval_s: float = 1.0, timeout_s: float = 0.5):
+        self.base_url = str(base_url).rstrip("/")
+        self.interval_s = float(interval_s)
+        self.timeout_s = float(timeout_s)
+        self.polls = 0
+        self.errors = 0
+        self._next_t = 0.0
+
+    def _fetch(self) -> str | None:
+        """One metadata GET; None on any transport failure (counted)."""
+        import urllib.error
+        import urllib.request
+
+        req = urllib.request.Request(
+            self.base_url + self.METADATA_PATH,
+            headers={"Metadata-Flavor": "Google"})
+        try:
+            with urllib.request.urlopen(
+                    req, timeout=self.timeout_s) as resp:
+                return resp.read(1024).decode("utf-8", "replace").strip()
+        except (urllib.error.URLError, OSError, ValueError):
+            self.errors += 1
+            return None
+
+    def __call__(self) -> str | None:
+        now = time.monotonic()
+        if now < self._next_t:
+            return None
+        self._next_t = now + self.interval_s
+        self.polls += 1
+        ev = self._fetch()
+        if ev is None or ev.upper() in self.QUIET:
+            return None
+        return ev
+
+    @classmethod
+    def install_from(cls, cfg: dict | None,
+                     handler: "PreemptionHandler | None" = None
+                     ) -> "GceMaintenancePoller | None":
+        """Wire a poller into the handler from a config dict (the shared
+        seam: the training latch's resilience config and the serving
+        replica's ``preempt`` block both pass their dict here). Returns
+        the poller, or None when ``metadata_url`` is absent/falsy."""
+        url = (cfg or {}).get("metadata_url")
+        if not url:
+            return None
+        poller = cls(
+            base_url=str(url),
+            interval_s=float((cfg or {}).get("poll_interval_s", 1.0)),
+            timeout_s=float((cfg or {}).get("poll_timeout_s", 0.5)))
+        (handler or PreemptionHandler.instance()).register_hook(poller)
+        return poller
 
 
 # --------------------------------------------------------------------------

@@ -162,6 +162,10 @@ class ScaleAdvisor:
         self._busy_t: dict[str, float] = {}
         #: last computed hints: (role, direction) -> 0/1
         self.hints: dict[tuple[str, str], int] = {}
+        #: when each hint flipped to 1 and stayed there — the elastic
+        #: controller acts only on hints SUSTAINED past its hold (one
+        #: noisy sample must not drain a replica)
+        self.hint_since: dict[tuple[str, str], float] = {}
         #: set by the router when a handoff had no decode-capable target
         self.decode_starved = False
 
@@ -214,6 +218,13 @@ class ScaleAdvisor:
             hints[(ROLE_DECODE, "up")] = 1
         self.decode_starved = False
         self.hints = hints
+        for key, v in hints.items():
+            if v:
+                self.hint_since.setdefault(key, now)
+            else:
+                self.hint_since.pop(key, None)
+        for key in [k for k in self.hint_since if k not in hints]:
+            del self.hint_since[key]       # role vanished from the fleet
         if registry is not None:
             for (role, direction), v in hints.items():
                 registry.gauge(
@@ -224,6 +235,13 @@ class ScaleAdvisor:
                          "scale-down on sustained idle — signals only, "
                          "no actuator").set(v)
         return hints
+
+    def sustained(self, role: str, direction: str, now: float,
+                  hold_s: float) -> bool:
+        """True when the (role, direction) hint has been continuously 1
+        for at least ``hold_s`` — the elastic controller's act gate."""
+        t0 = self.hint_since.get((role, direction))
+        return t0 is not None and now - t0 >= hold_s
 
 
 class RebalancePolicy:

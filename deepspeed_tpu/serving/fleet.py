@@ -34,10 +34,13 @@ from dataclasses import dataclass, field
 from ..utils.logging import logger
 from .protocol import ChannelClosed, ChannelTimeout, LineChannel
 
-# replica lifecycle states (gauge value = index)
-SPAWNING, READY, DRAINING, DEAD, QUARANTINED = (
-    "spawning", "ready", "draining", "dead", "quarantined")
-STATE_CODES = {SPAWNING: 0, READY: 1, DRAINING: 2, DEAD: 3, QUARANTINED: 4}
+# replica lifecycle states (gauge value = index). RETIRED is terminal
+# until an elastic spawn revives the slot: no respawn, no breaker — the
+# slot was drained on purpose (serving/elastic.py).
+SPAWNING, READY, DRAINING, DEAD, QUARANTINED, RETIRED = (
+    "spawning", "ready", "draining", "dead", "quarantined", "retired")
+STATE_CODES = {SPAWNING: 0, READY: 1, DRAINING: 2, DEAD: 3, QUARANTINED: 4,
+               RETIRED: 5}
 
 
 @dataclass
@@ -110,6 +113,13 @@ class ReplicaHandle:
         #: replica daemon (transport.connect_channel) instead of spawning
         #: a subprocess; restart policy = reconnect with backoff
         self.address = cfg.get("address")
+        #: elastic lifecycle (serving/elastic.py): ``retiring`` marks a
+        #: slot whose next death is a PLANNED drain/retire (no breaker,
+        #: no respawn); ``preempt_latched`` is set when the replica's
+        #: ``preempt`` notice arrives, so even an address (dialed) slot —
+        #: whose exit code the router cannot see — classifies correctly
+        self.retiring = False
+        self.preempt_latched = False
         self.deaths: deque[float] = deque()      # breaker window
         self.next_spawn_t = 0.0
         self.breaker_open_until = 0.0
@@ -135,6 +145,8 @@ class ReplicaHandle:
         if self.proc is not None or self.chan is not None:
             self.kill()          # never orphan a previous incarnation
         self.epoch += 1
+        self.retiring = False
+        self.preempt_latched = False
         if self.address:
             # remote slot: dial the daemon. A failed dial leaves the slot
             # SPAWNING with no channel — the next maintain() tick
@@ -267,6 +279,7 @@ class Fleet:
         self._telem = telemetry
         self.restarts_total = 0
         self.breaker_opens_total = 0
+        self.preemptions_total = 0
 
     # -- queries ---------------------------------------------------------
     def ready(self) -> list[ReplicaHandle]:
@@ -286,28 +299,72 @@ class Fleet:
     def start(self) -> None:
         """Idempotent: a slot that already has an incarnation (any state
         but DEAD/QUARANTINED) is left alone — double-start must not
-        orphan live worker processes."""
+        orphan live worker processes. RETIRED slots stay retired: only
+        an explicit :meth:`revive` brings them back."""
         for r in self.replicas:
-            if (r.proc is None and r.chan is None) or r.state == DEAD:
+            if r.state != RETIRED and (
+                    (r.proc is None and r.chan is None)
+                    or r.state == DEAD):
                 r.spawn()
 
     def maintain(self, now: float) -> list[ReplicaHandle]:
         """Reap the dead, open/close breakers, respawn eligible slots.
         Returns slots that transitioned to DEAD this call."""
+        from ..runtime.resilience import PREEMPTED_EXIT_CODE
+
         died: list[ReplicaHandle] = []
         for r in self.replicas:
             if r.state in (READY, DRAINING, SPAWNING) \
                     and not r.alive(now, self.cfg.hb_timeout_s):
+                code = r.proc.poll() if r.proc is not None else None
+                preempted = r.preempt_latched \
+                    or code == PREEMPTED_EXIT_CODE
                 if r.address:
                     cause = "disconnected"
-                elif r.proc is None or r.proc.poll() is not None:
+                elif r.proc is None or code is not None:
                     cause = "exited"
                 else:
                     cause = "unresponsive"
+                if r.retiring:
+                    # a PLANNED drain/retire finishing: terminal until an
+                    # elastic spawn revives the slot — no failure budget,
+                    # no backoff, no breaker accounting at all
+                    logger.info(f"fleet: slot {r.slot} epoch {r.epoch} "
+                                f"retired")
+                    r.kill()
+                    r.state = RETIRED
+                    r.retiring = False
+                    r.load = r.digest = r.tier_digest = None
+                    died.append(r)
+                    continue
+                if preempted:
+                    # the replica drained against its preemption deadline
+                    # and exited 83 (or latched via its preempt notice):
+                    # a planned event, not a crash — the death never
+                    # burns the breaker window's failure budget
+                    logger.warning(f"fleet: slot {r.slot} epoch "
+                                   f"{r.epoch} preempted")
+                    r.kill()
+                    r.state = DEAD
+                    r.preempt_latched = False
+                    r.load = r.digest = r.tier_digest = None
+                    r.next_spawn_t = now + self.cfg.backoff_base_s
+                    died.append(r)
+                    self.preemptions_total += 1
+                    if self._telem is not None and self._telem.enabled:
+                        self._telem.registry.counter(
+                            "serving_replica_preemptions_total",
+                            labels={"replica": str(r.slot)},
+                            help="replica incarnations that exited via "
+                                 "the preemption drain path (SIGTERM / "
+                                 "maintenance event; never a breaker "
+                                 "hit)").inc()
+                    continue
                 logger.warning(f"fleet: slot {r.slot} epoch {r.epoch} "
                                f"died ({cause})")
                 r.kill()
                 r.state = DEAD
+                r.load = r.digest = r.tier_digest = None
                 r.deaths.append(now)
                 died.append(r)
                 # half-open probe died: straight back to quarantine
@@ -382,6 +439,45 @@ class Fleet:
         next maintain() observes the death and runs the normal policy)."""
         self.replicas[slot].kill()
 
+    # -- elastic actuators (serving/elastic.py) --------------------------
+    def retire(self, slot: int) -> ReplicaHandle:
+        """Mark the slot's NEXT death as a planned retirement: when the
+        drained replica exits, maintain() parks it RETIRED (no breaker,
+        no respawn) instead of running the crash policy. The controller
+        owns the drain sequencing; this just flips the classification."""
+        r = self.replicas[slot]
+        r.retiring = True
+        return r
+
+    def revive(self, slot: int, role: str | None = None) -> ReplicaHandle:
+        """Bring a RETIRED (or DEAD) slot back: optionally re-role it via
+        a per-slot override (the spawn config template reads it), then
+        spawn a fresh incarnation immediately. The ordinary
+        ready/breaker machinery takes over from there — a revived slot
+        that crash-loops is quarantined exactly like any other."""
+        r = self.replicas[slot]
+        if role is not None:
+            self.cfg.per_slot.setdefault(str(slot), {})["role"] = role
+            r.role = str(role)
+        if r.state in (RETIRED, DEAD):
+            r.state = DEAD
+            r.next_spawn_t = 0.0
+            r.spawn()
+            self.restarts_total += 1
+        return r
+
+    def add_slot(self, overrides: dict | None = None) -> ReplicaHandle:
+        """Append a brand-new slot (elastic scale-up past the configured
+        fleet size) without spawning it — the caller revives it, so the
+        spawn is journaled before the process exists."""
+        slot = len(self.replicas)
+        if overrides:
+            self.cfg.per_slot[str(slot)] = dict(overrides)
+        r = ReplicaHandle(slot, self.cfg)
+        r.state = RETIRED                # parked until revive()
+        self.replicas.append(r)
+        return r
+
     def abandon(self) -> None:
         """Chaos/bench hook (the router-crash emulation): drop every
         channel with no shutdown message and no kill. Daemon (address)
@@ -428,7 +524,8 @@ class Fleet:
                 "serving_router_replica_state",
                 labels={"replica": str(r.slot)},
                 help="replica slot state code (0 spawning, 1 ready, "
-                     "2 draining, 3 dead, 4 quarantined)").set(
+                     "2 draining, 3 dead, 4 quarantined, "
+                     "5 retired)").set(
                 STATE_CODES[r.state])
         for s, n in counts.items():
             self._telem.registry.gauge(

@@ -65,7 +65,7 @@ _SEG_PREFIX, _SEG_SUFFIX = "wal-", ".log"
 #: check_protocol_msgs.py does NOT govern these — they are file records,
 #: not wire messages)
 RECORD_KINDS = ("boot", "admit", "place", "requeue", "prog", "term",
-                "deploy", "snap")
+                "deploy", "elastic", "snap")
 
 
 class JournalError(RuntimeError):
@@ -293,6 +293,11 @@ class RecoveredState:
     #: a deploy record (terminal or not) appeared at all — the CLI uses
     #: this to avoid re-starting a deploy the journal already carries
     saw_deploy: bool = False
+    #: the last journaled elastic transition (serving/elastic.py) with no
+    #: terminal outcome — a restart mid-drain must neither resurrect a
+    #: retiring replica nor forget a half-spawned one, so the controller
+    #: re-adopts this action instead of re-deriving it from hints
+    elastic: dict | None = None
     boots: int = 0
 
     @property
@@ -344,6 +349,7 @@ def reduce_router_records(records: list[dict]) -> RecoveredState:
                     r.result = [int(x) for x in e["toks"]]
                 st.reqs[r.rec.trace_id] = r
             st.deploy = rec.get("deploy") or None
+            st.elastic = rec.get("elastic") or None
             st.boots = max(st.boots, int(rec.get("boots", 0)))
             if st.deploy or rec.get("saw_deploy"):
                 st.saw_deploy = True
@@ -359,6 +365,11 @@ def reduce_router_records(records: list[dict]) -> RecoveredState:
             if k == "deploy":
                 st.saw_deploy = True
                 st.deploy = None if rec.get("outcome") else dict(rec)
+                continue
+            if k == "elastic":
+                # same shape as deploy: a terminal outcome clears the
+                # in-flight action, anything else IS the action to resume
+                st.elastic = None if rec.get("outcome") else dict(rec)
                 continue
             if req is None or req.status != OPEN:
                 continue

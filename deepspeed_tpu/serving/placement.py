@@ -61,13 +61,34 @@ class StickyMap:
     def __init__(self, cap: int = 4096):
         self.cap = cap
         self._m: OrderedDict[int, int] = OrderedDict()
+        #: chain-head hash -> times noted/hit. Deliberately NOT cleared
+        #: by forget_slot: hotness belongs to the PREFIX, not the slot
+        #: that held it — it ranks elastic pre-warm pushes after the
+        #: slot is gone (serving/elastic.py).
+        self.hits: OrderedDict[int, int] = OrderedDict()
+
+    def _heat_bump(self, h: int) -> None:
+        self.hits[h] = self.hits.pop(h, 0) + 1
+        while len(self.hits) > self.cap:
+            self.hits.popitem(last=False)
 
     def note(self, chain: list[int], slot: int) -> None:
         for h in chain:
             self._m.pop(h, None)
             self._m[h] = slot
+        if chain:
+            self._heat_bump(chain[-1])
         while len(self._m) > self.cap:
             self._m.popitem(last=False)
+
+    def heat(self, chain: list[int]) -> int:
+        """Hotness of the deepest remembered hash on ``chain`` (0 =
+        never seen) — the pre-warm ranking signal."""
+        for j in range(len(chain) - 1, -1, -1):
+            n = self.hits.get(chain[j])
+            if n:
+                return n
+        return 0
 
     def lookup(self, chain: list[int],
                allowed: set[int] | None = None) -> tuple[int, int] | None:
@@ -85,6 +106,7 @@ class StickyMap:
         for j in range(len(chain) - 1, -1, -1):
             slot = self._m.get(chain[j])
             if slot is not None and (allowed is None or slot in allowed):
+                self._heat_bump(chain[j])
                 return slot, j + 1
         return None
 

@@ -101,6 +101,26 @@ Message vocabulary (``t`` is the type tag)::
                                             answer swap_ok/swap_fail;
                                             ckpt null = revert to the
                                             template ("init") weights
+    {"t":"retire"}                          elastic drain/retire
+                                            (serving/elastic.py): the
+                                            slot is leaving the fleet on
+                                            purpose — flush the radix
+                                            into the KV tier (evict-sink
+                                            path, deepest-first), spill
+                                            the tier warm, send "bye",
+                                            exit 0
+    {"t":"re_role","role":str}              flip this replica's serving
+                                            role at a quiesce boundary
+                                            (prefill<->decode, no process
+                                            restart); answered with
+                                            "re_role_ok"
+    {"t":"prewarm","id":str,"tok":[int],"deadline_s":float}  pre-warm a
+                                            fresh spawn: adopt the chain
+                                            prefixing ``tok`` arriving
+                                            via the kv_bundle machinery
+                                            under this id (no put is
+                                            held; the deadline settles a
+                                            dead transfer silently)
 
   replica -> router
     {"t":"ready","pid":int,"block_size":int,"max_live":int,"epoch":int,
@@ -192,6 +212,19 @@ Message vocabulary (``t`` is the type tag)::
                                             the restarted router's
                                             placement state rebuilds in
                                             one exchange
+    {"t":"preempt","cause":str}             the host latched a preemption
+                                            notice (SIGTERM / GCE
+                                            maintenance-event): the
+                                            replica is emergency-draining
+                                            against a hard deadline, will
+                                            flush its radix into the KV
+                                            tier and exit 83 — classify
+                                            as preempted (no breaker hit,
+                                            no failure budget)
+    {"t":"re_role_ok","role":str}           role flip committed at the
+                                            quiesce boundary; the next
+                                            heartbeat carries a fresh
+                                            digest for the new role
     {"t":"bye"}                             clean shutdown ack
 
 Deadlines are LAW here (bin/check_deadlines.py lints this package): every

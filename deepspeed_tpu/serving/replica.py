@@ -46,7 +46,7 @@ import sys
 import time
 
 from ..inference.prefix_cache import PrefixCache, chain_hashes
-from ..runtime.resilience import FaultInjector
+from ..runtime.resilience import PREEMPTED_EXIT_CODE, FaultInjector
 from ..utils.logging import logger
 from .protocol import (ChannelClosed, ChannelTimeout, LineChannel,
                        RequestRecord)
@@ -206,7 +206,8 @@ class ToyBackend:
             tier._fallback("adopt")
             return 0
         self.radix.release(nodes)
-        tier.note_promote_latency(time.perf_counter() - t0)
+        tier.note_promote_latency(time.perf_counter() - t0,
+                                  pages=bundle.n_full)
         self.tier_promotes += 1
         # deliberately NO cache_pages trim here: the caller (put) is
         # about to match-and-pin exactly these pages — trimming first
@@ -1382,6 +1383,23 @@ class DaemonState:
         self.orphan_deadline_s = float(cfg.get("orphan_deadline_s", 30.0))
         self.stream_log_cap = int(cfg.get("stream_log_cap", 256))
         self.term_buf_cap = int(cfg.get("term_buf_cap", 128))
+        # elastic preemption latch (runtime/resilience.py), installed
+        # once per process: SIGTERM and/or a GCE maintenance-event
+        # poller flip a flag the serve loop consumes — emergency drain
+        # against the grace deadline, radix flush into the KV tier,
+        # exit PREEMPTED_EXIT_CODE. Gated behind an explicit "preempt"
+        # config block so plain fleets keep default signal semantics.
+        self.preempt_cfg = dict(cfg.get("preempt") or {})
+        self.preempt_h = None
+        if self.preempt_cfg:
+            from ..runtime.resilience import (GceMaintenancePoller,
+                                              PreemptionHandler)
+            self.preempt_h = PreemptionHandler.install(
+                [str(s) for s in
+                 self.preempt_cfg.get("signals", ["SIGTERM"])])
+            self.preempt_h.clear()       # never inherit a stale latch
+            GceMaintenancePoller.install_from(self.preempt_cfg,
+                                              self.preempt_h)
 
     # -- stream bookkeeping ---------------------------------------------
     def note_chunk(self, rid: str, off: int, toks: list[int]) -> None:
@@ -1431,7 +1449,7 @@ class DaemonState:
             if entry.get("gang"):
                 # a gang dies with its router: fail the segment out
                 self.backend.gang_upstream(rid, ok=False)
-            else:
+            elif entry.get("put") is not None:
                 self.admit_offline(entry["put"])
         for rid in set(self.attempts) | set(self.term_buf):
             self.orphans.setdefault(rid, dl)
@@ -1447,7 +1465,7 @@ class DaemonState:
             entry = self.pulls.pop(rid)
             if entry.get("gang"):
                 self.backend.gang_upstream(rid, ok=False)
-            else:
+            elif entry.get("put") is not None:
                 self.admit_offline(entry["put"])
         for rid, kind, toks, off in self.backend.step(self.inj):
             if kind == "chunk":
@@ -1508,6 +1526,30 @@ class DaemonState:
         return out
 
 
+def _drain_flush(backend, inj) -> int:
+    """Elastic drain-flush: push every unpinned cached chain into the
+    KV tier — block-at-a-time eviction WITH demotion drives the
+    evict-sink absorb path (deepest pages cascade leaf-first, each
+    demoted once) — then spill the tier's RAM ring so the pages survive
+    this process. The per-block crash point is the chaos seam: a
+    SIGKILL mid-flush leaves at most a torn tail record, which the
+    tier's scan gate skips on the next open. Returns blocks flushed."""
+    n = 0
+    radix = getattr(backend, "radix", None)
+    tier = getattr(backend, "kv_tier", None)
+    if radix is not None and tier is not None:
+        while len(radix):
+            if not radix.evict(1):
+                break                    # only pinned pages remain
+            n += 1
+            if inj.countdown("replica_crash_mid_drain_flush"):
+                inj.crash_now("replica_crash_mid_drain_flush",
+                              f"drain flush after {n} pages")
+    if tier is not None:
+        tier.close(flush=True)
+    return n
+
+
 def serve(cfg: dict, chan: LineChannel,
           state: DaemonState | None = None) -> int:
     """The replica event loop. Returns 0 on an explicit shutdown message
@@ -1539,6 +1581,15 @@ def serve(cfg: dict, chan: LineChannel,
                "epoch": int(cfg.get("epoch", 0))}, timeout=send_t)
 
     draining = False
+    # elastic actuators (serving/elastic.py): "retire" drains then
+    # flushes the radix into the KV tier and exits cleanly; a latched
+    # preemption does the same under a hard grace deadline and exits
+    # PREEMPTED_EXIT_CODE so the fleet classifies it (no breaker hit)
+    retiring = False
+    retire_deadline = float("inf")
+    preempt_h = st.preempt_h
+    preempt_deadline: float | None = None
+    preempt_grace_s = float(st.preempt_cfg.get("deadline_s", 5.0))
     attempts = st.attempts               # rid -> router attempt nonce
     last_hb = 0.0
     digest_ver_sent = -1                 # first heartbeat always ships it
@@ -1553,6 +1604,13 @@ def serve(cfg: dict, chan: LineChannel,
     # entry point below is one `trace_on` check.
     trace_on = bool(cfg.get("fleet_trace"))
     trace_max = int(cfg.get("fleet_trace_max_events", 64))
+    # live refinement of the tier's min-pages promote threshold
+    # (inference/kvtier.py): observed promote latencies beat the startup
+    # break-even guess once enough samples land. An explicitly pinned
+    # "min_pages" stays authoritative unless refinement is asked for.
+    _tier_cfg = cfg.get("kv_tier") or {}
+    tier_refine = isinstance(_tier_cfg, dict) and bool(
+        _tier_cfg.get("refine_min_pages", "min_pages" not in _tier_cfg))
     rtrace: dict[str, dict] = {}         # rid -> {ev, sent, dropped}
     # injected clock skew (chaos/tests): shifts every timestamp this
     # replica reports — trace events AND the heartbeat echo clocks — so
@@ -1755,10 +1813,30 @@ def serve(cfg: dict, chan: LineChannel,
                  "pages": pages, "bytes": nbytes})
         if entry.get("gang"):
             backend.gang_upstream(rid, ok=pages > 0)
+        elif entry.get("prewarm"):
+            # elastic pre-warm: the adopted chain IS the result — the
+            # kv_ack page count above tells the router how warm we got
+            attempts.pop(rid, None)
         else:
             _admit_put(entry["put"])
 
     while True:
+        if preempt_h is not None and preempt_deadline is None:
+            cause = preempt_h.check()
+            if cause:
+                # the host is taking this machine: stop admissions,
+                # race the grace window to finish in-flight decodes,
+                # then flush-and-exit. The router classifies via this
+                # notice (and the exit code): no breaker hit, no
+                # failure budget, sticky/digest state dropped eagerly.
+                draining = True
+                grace = float("inf") \
+                    if inj.value("preempt_ignore_deadline") \
+                    else preempt_grace_s
+                preempt_deadline = time.monotonic() + grace
+                logger.warning(f"replica: preemption latched "
+                               f"({cause}); draining for {grace:.1f}s")
+                _send({"t": "preempt", "cause": str(cause)})
         busy = backend.has_work()
         try:
             msg = chan.recv(timeout=0.001 if busy else
@@ -2136,6 +2214,39 @@ def serve(cfg: dict, chan: LineChannel,
                     last_hb = 0.0    # ship the new version immediately
             elif t == "drain":
                 draining = True
+            elif t == "retire":
+                # elastic retire (serving/elastic.py): stop admissions,
+                # finish what's still in flight (deadline-bounded — the
+                # router already rebalanced what it could), then flush
+                # the radix into the KV tier and leave cleanly; the
+                # fleet classifies this exit as retired, not a death
+                draining = True
+                retiring = True
+                retire_deadline = time.monotonic() + float(
+                    msg.get("deadline_s", 10.0))
+            elif t == "re_role":
+                # elastic re-role: flip prefill<->decode at this quiesce
+                # boundary — the loop sits between step() calls, so
+                # in-flight sequences simply continue under the new
+                # role's policies (no process restart, cache intact)
+                role = str(msg.get("role", role))
+                backend.role = role
+                _send({"t": "re_role_ok", "role": role})
+                last_hb = 0.0            # fresh load/digest right away
+            elif t == "prewarm":
+                # elastic pre-warm (fresh spawn): register a pull-import
+                # entry with NO held put — the kv_bundle/kv_chunk/kv_eof
+                # leg arriving under this id adopts the chain into the
+                # radix before traffic lands; the deadline settles a
+                # dead transfer silently (kv_ack pages=0 = warm missed)
+                rid = str(msg["id"])
+                if not draining:
+                    attempts[rid] = int(msg.get("a", 0))
+                    pulls[rid] = {
+                        "put": None, "prewarm": True, "asm": None,
+                        "shm": None, "relay": False,
+                        "deadline": time.monotonic() + float(
+                            msg.get("deadline_s", 5.0))}
             elif t == "trace_req":
                 # breach sampling: the router wants this request's LIVE
                 # timeline segment now (fin=False — the rest ships at
@@ -2251,6 +2362,32 @@ def serve(cfg: dict, chan: LineChannel,
                         if now_p >= e["deadline"]]:
                 _settle_pull(rid, 0)
 
+        if preempt_deadline is not None and (
+                backend.drain_done()
+                or time.monotonic() >= preempt_deadline):
+            # grace window closed (or the drain finished early):
+            # whatever still runs is orphaned work the router replays
+            # on a surviving replica — flush what the cache holds and
+            # get off the machine
+            pages = _drain_flush(backend, inj)
+            logger.warning(f"replica: preempted; flushed {pages} pages "
+                           f"into the tier, exiting "
+                           f"{PREEMPTED_EXIT_CODE}")
+            _cleanup_shm(ring, readers)
+            return PREEMPTED_EXIT_CODE
+
+        if retiring and (backend.drain_done()
+                         or time.monotonic() >= retire_deadline):
+            pages = _drain_flush(backend, inj)
+            logger.info(f"replica: retiring; flushed {pages} pages "
+                        f"into the tier")
+            try:
+                chan.send({"t": "bye"}, timeout=1.0)
+            except (ChannelClosed, ChannelTimeout):
+                pass
+            _cleanup_shm(ring, readers)
+            return 0
+
         if stalled and time.monotonic() >= stall_until:
             # stall expired: deliver the queued stream late — the router
             # has usually reassigned by now and must drop these as stale
@@ -2290,6 +2427,10 @@ def serve(cfg: dict, chan: LineChannel,
                 hb["tier_digest"] = backend.tier_digest(digest_max)
                 tier_ver_sent = tver
             _send(hb)
+            if tier_refine:
+                tier = getattr(backend, "kv_tier", None)
+                if tier is not None:
+                    tier.refine_min_pages(block_size=backend.block_size)
             if telem is not None:
                 _sync_tier_metrics(telem, backend, tier_stat_marks)
                 telem.write_snapshot(snap_path)
@@ -2328,6 +2469,7 @@ def main(argv: list[str]) -> int:
             max_s=float(cfg.get("accept_backoff_max_s", 2.0)),
             seed=int(cfg.get("seed", 0) or 0)
             ^ int(cfg.get("replica_id", 0) or 0))
+        offline_preempt_t: float | None = None
         try:
             while True:
                 # the accept's select IS the idle sleep: a busy daemon
@@ -2339,6 +2481,21 @@ def main(argv: list[str]) -> int:
                 chan = listener.accept_channel(timeout=timeout)
                 if chan is None:
                     state.offline_tick()
+                    # a preemption latched with no router connected
+                    # still drains against the grace window, flushes
+                    # the radix into the tier, and exits 83 — the
+                    # respawning fleet reads the code, not the socket
+                    if state.preempt_h is not None \
+                            and state.preempt_h.check():
+                        if offline_preempt_t is None:
+                            offline_preempt_t = time.monotonic() \
+                                + float(state.preempt_cfg.get(
+                                    "deadline_s", 5.0))
+                        if state.backend.drain_done() or \
+                                time.monotonic() >= offline_preempt_t:
+                            _drain_flush(state.backend, state.inj)
+                            _cleanup_shm(state.ring, state.readers)
+                            return PREEMPTED_EXIT_CODE
                     continue
                 backoff.reset()
                 try:
@@ -2350,9 +2507,12 @@ def main(argv: list[str]) -> int:
                     rc = None
                 finally:
                     chan.close()
-                if rc == 0:
+                if rc in (0, PREEMPTED_EXIT_CODE):
+                    # explicit shutdown/retire (0) or a latched
+                    # preemption (83): the daemon's life is over either
+                    # way — the exit code is the fleet's classifier
                     _cleanup_shm(state.ring, state.readers)
-                    return 0             # explicit shutdown message
+                    return rc
         except KeyboardInterrupt:
             return 0
         finally:

@@ -56,6 +56,7 @@ from ..runtime.resilience import FaultInjector
 from ..utils.logging import logger
 from .deploy import DeployConfig, DeployError, DeployManager, \
     verify_deploy_target
+from .elastic import ElasticController
 from .journal import Journal, OPEN, reduce_router_records
 from .disagg import (DECODE_CAPABLE, MigrationState, PREFILL_CAPABLE,
                      RebalancePolicy, ScaleAdvisor, role_of)
@@ -224,12 +225,42 @@ class RouterConfig:
     #: them via resync (extended on each replica ready) before falling
     #: back to the ordinary retry-with-replay path
     resync_hold_s: float = 3.0
+    #: elastic fleet actuators (serving/elastic.py): act on sustained
+    #: ``serving_router_scale_hint`` signals — drain/retire idle
+    #: replicas (radix flushed tier-warm), spawn + pre-warm new ones,
+    #: flip roles at quiesce boundaries. Off (the default) the advisor
+    #: stays signals-only, exactly the pre-elastic router.
+    elastic: bool = False
+    #: never retire below this many READY replicas
+    elastic_min_replicas: int = 1
+    #: hard cap on fleet size for scale-up (0 = never ADD slots; spawn
+    #: then only revives previously retired ones)
+    elastic_max_replicas: int = 0
+    #: a hint must hold continuously this long before the controller
+    #: acts on it (the one-noisy-sample guard)
+    elastic_sustain_s: float = 1.0
+    #: quiet period between settled actions
+    elastic_cooldown_s: float = 5.0
+    #: drain budget: in-flight work asked off / finished within this,
+    #: then the victim is told to flush-and-exit regardless
+    elastic_drain_deadline_s: float = 10.0
+    #: spawn-to-READY budget before the action settles "timeout"
+    elastic_spawn_deadline_s: float = 30.0
+    #: hottest distinct prefix chains pushed into a fresh replica
+    elastic_prewarm_chains: int = 4
+    #: per-transfer (and whole prewarm phase) budget — best-effort: the
+    #: deadline settles the action "ok" either way
+    elastic_prewarm_deadline_s: float = 5.0
+    #: allow prefill<->decode re-role when one role wants up and the
+    #: other down simultaneously (cheaper than retire + spawn)
+    elastic_re_role: bool = True
     #: deterministic router-side chaos (runtime/resilience.py
     #: FaultInjector, always HARD — a real no-unwind os._exit):
     #: router_crash_after_admit / router_crash_after_place /
     #: router_crash_before_relay_ack / router_crash_mid_kv_pull /
-    #: router_crash_mid_deploy_canary, count-based like the replica
-    #: points — the journal chaos matrix drives these
+    #: router_crash_mid_deploy_canary / router_crash_mid_elastic,
+    #: count-based like the replica points — the journal chaos matrix
+    #: drives these
     faults: dict = field(default_factory=dict)
 
 
@@ -418,8 +449,17 @@ class Router:
         #: bench scorecard's recovery-time headline); None until observed
         self.recovery_first_chunk_s: float | None = None
         self._recover_t0 = time.monotonic()
+        self._recovered_elastic: dict | None = None
         if self.cfg.journal_dir:
             self._open_journal()
+        #: the scale-hint actuator (serving/elastic.py) — constructed
+        #: AFTER journal recovery (it adopts a half-done action, and a
+        #: retire that reached its flush phase must park the slot
+        #: RETIRED before fleet.start() can resurrect it) and BEFORE
+        #: start() is ever called
+        self._elastic = ElasticController(
+            self, recovered=self._recovered_elastic) \
+            if self.cfg.elastic else None
 
     # -- crash safety: journal + recovery (serving/journal.py) -----------
     def _open_journal(self) -> None:
@@ -432,6 +472,7 @@ class Router:
         self._journal.snapshot_fn = self._journal_snapshot
         self.journal_saw_deploy = state.saw_deploy
         self._recovered_deploy = state.deploy
+        self._recovered_elastic = state.elastic
         bs = self._fleet_block_size()
         for tid, r in state.reqs.items():
             req = _Req(rec=r.rec,
@@ -515,6 +556,9 @@ class Router:
             dep = self._recovered_deploy
         return {"reqs": reqs, "terms": terms, "deploy": dep,
                 "saw_deploy": self.journal_saw_deploy,
+                "elastic": self._elastic.journal_payload()
+                if self._elastic is not None
+                else self._recovered_elastic,
                 "boots": self._boots}
 
     def _jrec(self, kind: str, data: dict,
@@ -864,6 +908,11 @@ class Router:
                 # (ClockSync keys by (slot, epoch) and bounds retention)
             self._fail_pulls_from(r.slot, r.epoch)
             self._fail_gangs_from(r.slot, r.epoch)
+            if self._elastic is not None:
+                self._elastic.note_slot_died(r)
+            # retired slots normally drained clean (no-op replay);
+            # drain-deadline stragglers and preempted streams replay
+            # through the ordinary orphan path
             self._replay_orphans(r.slot, r.epoch, "replica_lost")
         if self._ftrace is not None \
                 and self.fleet.breaker_opens_total > self._seen_breaker_opens:
@@ -924,6 +973,10 @@ class Router:
         # (disagg.RebalancePolicy) so it can never flap
         if self.cfg.rebalance:
             self._maybe_rebalance(now)
+        # elastic fleet-shape actuators last: they read the freshly
+        # updated hints and the post-dispatch assignment counts
+        if self._elastic is not None:
+            self._elastic.tick(now)
 
     def run(self, deadline_s: float = 60.0) -> dict:
         """Poll until every submitted request is terminal, or fail the
@@ -1085,13 +1138,37 @@ class Router:
         elif t in ("kv_bundle", "kv_chunk", "kv_eof", "kv_none",
                    "kv_need", "kv_ack"):
             # gang hop transfers ride the same kv_* vocabulary under a
-            # "g:"-prefixed id — route them to the gang state machine
-            if str(msg.get("id", "")).startswith("g:"):
+            # "g:"-prefixed id, elastic pre-warm pushes under "w:" —
+            # route each to its own state machine
+            rid = str(msg.get("id", ""))
+            if rid.startswith("g:"):
                 self._on_gang_pull(h, msg)
+            elif rid.startswith("w:"):
+                if self._elastic is not None:
+                    self._elastic.on_kv(h, msg)
             else:
                 self._on_pull(h, msg)
         elif t in ("gang_seg_ok", "gang_seg_fail"):
             self._on_gang_seg(h, msg)
+        elif t == "preempt":
+            # the replica latched a preemption notice: it is flushing
+            # its radix tier-ward and will exit 83 — classify eagerly
+            # (fleet.maintain spares it the breaker) and drop routing
+            # state NOW, not when the process dies
+            h.preempt_latched = True
+            if self._elastic is not None:
+                self._elastic.on_preempt(h)
+            else:
+                self._sticky.forget_slot(h.slot)
+                h.digest = None
+                h.tier_digest = None
+            logger.warning(f"router: slot {h.slot} preempted "
+                           f"({msg.get('cause')})")
+        elif t == "re_role_ok":
+            if self._elastic is not None:
+                self._elastic.on_re_role_ok(h, msg)
+            else:
+                h.role = str(msg.get("role", h.role))
         elif t == "bye":
             h.state = DRAINING
 
@@ -2933,6 +3010,11 @@ def main(argv: list[str]) -> int:
             "deploy_status": router.deploy_status(),
             "fleet_wv": {str(h.slot): h.wv
                          for h in router.fleet.replicas},
+            "fleet_states": {str(h.slot): h.state
+                             for h in router.fleet.replicas},
+            "preemptions": router.fleet.preemptions_total,
+            "elastic": router._elastic.stats()
+            if router._elastic is not None else None,
             "journal": router.journal_stats(),
         }
     finally:

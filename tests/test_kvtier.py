@@ -847,3 +847,35 @@ def test_auto_min_pages_break_even_and_cap():
     # explicit cap respected on the no-win path and the clamp path
     assert auto_min_pages({"ram_bytes_s": 1e3}, cap=7, **{k: v for k, v
                           in kw.items()}) == 7
+
+
+def test_refine_min_pages_histogram_driven_value_wins():
+    """Live promote-latency refinement (PR-18 regression pin): once the
+    sample budget is met, the OBSERVED per-page promote time — crc,
+    verify and adopt included — replaces the startup probe's raw
+    byte-rate in the break-even, and the refined value overwrites the
+    auto-sized ``min_pages``. Under the budget nothing moves."""
+    tier = KVTier(KVTierConfig(ram_bytes=1 << 20, min_pages=2))
+    # 8 samples: under min_samples=16 → no refinement, cfg untouched
+    for _ in range(8):
+        tier.note_promote_latency(0.5, pages=1)
+    assert tier.refine_min_pages(block_size=16) is None
+    assert tier.cfg.min_pages == 2 and tier.min_pages_refinements == 0
+    # 16 pathologically slow promotes (0.5 s/page vs 8 ms recompute):
+    # promoting never wins → the histogram drives min_pages to the cap
+    for _ in range(8):
+        tier.note_promote_latency(0.5, pages=1)
+    assert tier.refine_min_pages(block_size=16, cap=64) == 64
+    assert tier.cfg.min_pages == 64
+    assert tier.min_pages_refinements == 1
+    # fast promotes dominate the record → the threshold comes back down
+    for _ in range(4000):
+        tier.note_promote_latency(1e-5, pages=4)
+    n = tier.refine_min_pages(block_size=16, cap=64)
+    assert n is not None and 1 <= n < 64
+    assert tier.cfg.min_pages == n
+    assert tier.min_pages_refinements == 2
+    # idempotent at the same observations: no spurious refinement churn
+    assert tier.refine_min_pages(block_size=16, cap=64) == n
+    assert tier.min_pages_refinements == 2
+    tier.close(flush=False)

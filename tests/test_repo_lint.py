@@ -440,6 +440,24 @@ def test_deadline_lint_covers_journal_waits(tmp_path):
     assert len(out) == 1 and ":2:" in out[0]
 
 
+def test_deadline_lint_covers_elastic_controller(tmp_path):
+    """serving/elastic.py ticks inside the router poll loop: an
+    unbounded wait in a drain/spawn/re-role actuator would stall every
+    replica's heartbeat, so the deadline lint must sweep it like the
+    rest of serving/ — no carve-out for new control-plane files."""
+    serving = tmp_path / "deepspeed_tpu" / "serving"
+    serving.mkdir(parents=True)
+    (serving / "elastic.py").write_text(
+        "def drain(proc, lock):\n"
+        "    lock.acquire()\n"                     # flagged: unbounded
+        "    proc.join(timeout=2.0)\n")            # bounded: ok
+    out = deadline_lint.check_repo(str(tmp_path))
+    assert len(out) == 1 and ":2:" in out[0]
+    real = os.path.join(ROOT, "deepspeed_tpu", "serving", "elastic.py")
+    assert os.path.exists(real)
+    assert deadline_lint.check_repo(ROOT) == []
+
+
 def test_serving_protocol_vocabulary_is_closed():
     """Every literal {"t": ...} message sent in serving/ has a receiver
     dispatch branch and vice versa (bin/check_protocol_msgs.py) — the
@@ -474,6 +492,36 @@ def test_protocol_lint_pins_gang_vocabulary_both_directions():
     assert "replica.py" in handled["gang_seg"]
     assert "replica.py" in sent["gang_seg_ok"]
     assert "router.py" in handled["gang_seg_ok"]
+
+
+def test_protocol_lint_pins_elastic_vocabulary_both_directions():
+    """The elastic-actuator vocabulary (PR 18) is wired end to end: the
+    router constructs retire/re_role/prewarm and the replica dispatches
+    them; the replica constructs preempt/re_role_ok and the router
+    dispatches those.  Same rationale as the gang pin above — a pair
+    deleted from BOTH sides vanishes from both maps and would pass the
+    generic closure check."""
+    sent: dict = {}
+    handled: dict = {}
+    serving = os.path.join(ROOT, "deepspeed_tpu", "serving")
+    for dirpath, _, files in os.walk(serving):
+        for f in sorted(files):
+            if f.endswith(".py"):
+                s, h, errs = protocol_lint.scan_file(
+                    os.path.join(dirpath, f))
+                assert errs == []
+                sent.update(s)
+                handled.update(h)
+    for tag in ("retire", "re_role", "prewarm", "preempt",
+                "re_role_ok"):
+        assert tag in sent, f"{tag} no longer constructed"
+        assert tag in handled, f"{tag} no longer dispatched"
+    for tag in ("retire", "re_role", "prewarm"):
+        assert "replica.py" in handled[tag]
+    assert "replica.py" in sent["preempt"]
+    assert "router.py" in handled["preempt"]
+    assert "replica.py" in sent["re_role_ok"]
+    assert "router.py" in handled["re_role_ok"]
 
 
 def test_protocol_detector_flags_dark_sends_and_phantom_handlers(
