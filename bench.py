@@ -1271,7 +1271,11 @@ def router_main():
             slo_ttft_s=slo_ttft if slo_shed else None,
             request_timeout_s=60.0, max_retries=3, telemetry=True,
             fleet_trace=True, fleet_trace_slo_ttft_s=slo_ttft,
-            fleet_trace_dir=f"/tmp/ds_bench_router/{name}/blackbox")
+            fleet_trace_dir=f"/tmp/ds_bench_router/{name}/blackbox",
+            # fleet watchtower: metric history + anomaly alerts ride the
+            # bench run, so a regression artifact carries its own trends
+            watchtower=True,
+            watchtower_dir=f"/tmp/ds_bench_router/{name}/ts")
         sheds: dict[str, int] = {}
         t0 = time.perf_counter()
         router = Router(cfg)
@@ -1342,6 +1346,13 @@ def router_main():
                 # fleet tracing: postmortem pointers for this scenario
                 "fleet_health": router.fleet_health(),
                 "blackbox_dumps": router.blackbox_dumps,
+                # watchtower: what the alerting layer saw during the run
+                "watchtower": {
+                    "store": router._watch.stats(),
+                    "alerts_fired": int(_ctr("serving_alerts_total")),
+                    "firing": [a.fingerprint
+                               for a in router._alerts.firing()],
+                },
             }
             return out
         finally:

@@ -56,6 +56,11 @@ SERVING_DIR = os.path.join("deepspeed_tpu", "serving")
 #: wait would
 EXTRA_FILES = [
     os.path.join("deepspeed_tpu", "inference", "kvtier.py"),
+    # the watchtower runs ON the router poll tick (timeseries sampling +
+    # alert evaluation) and its sampler thread must stay stoppable — an
+    # unbounded wait in either wedges the control loop it observes
+    os.path.join("deepspeed_tpu", "telemetry", "timeseries.py"),
+    os.path.join("deepspeed_tpu", "telemetry", "alerts.py"),
 ]
 
 #: zero-arg calls that block forever without a timeout kwarg

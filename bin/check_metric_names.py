@@ -200,6 +200,92 @@ def check_cardinality_cap(root: str) -> list[str]:
             f"cardinality bound is gone"]
 
 
+# --- watchtower alert rules (telemetry/alerts.py) ---------------------------
+
+#: where the rule pack + severity vocabulary live
+ALERTS_FILE = "deepspeed_tpu/telemetry/alerts.py"
+#: the allowed severity vocabulary — also pinned as the SEVERITIES tuple
+#: literal in ALERTS_FILE (rule severities become the ``severity`` label
+#: on serving_alerts_{firing,total} and the /alerts JSON)
+ALERT_SEVERITIES = ("info", "warning", "critical")
+
+
+def check_alert_rules(root: str) -> list[str]:
+    """Watchtower drift-pins, same discipline as the tag lint:
+
+    - every literal ``name=`` on an ``AlertRule(...)`` call must survive
+      ``sanitize_label_value`` unchanged (rule names become the ``rule``
+      label on ``serving_alerts_*`` and the fingerprints in ``/alerts`` —
+      a name the runtime rewrites breaks dashboard queries AND dedup);
+    - every literal ``severity=`` must be in ALERT_SEVERITIES;
+    - every literal ``metric=`` must name a family actually emitted
+      somewhere with a literal name (a rule watching a renamed metric
+      would silently never fire — the nastiest observability failure);
+    - the ``SEVERITIES`` tuple in alerts.py must literally equal
+      ALERT_SEVERITIES (the runtime validator and this lint must agree).
+    """
+    path = os.path.join(root, *ALERTS_FILE.split("/"))
+    if not os.path.exists(path):
+        return [f"{path}:0: watchtower rules file missing"]
+    with open(path, encoding="utf-8") as f:
+        try:
+            tree = ast.parse(f.read(), filename=path)
+        except SyntaxError as e:
+            return [f"{path}:{e.lineno}: unparseable ({e.msg})"]
+    out: list[str] = []
+    fams = set(collect_metric_families(root))
+    sev_pinned = False
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Assign):
+            for t in node.targets:
+                if isinstance(t, ast.Name) and t.id == "SEVERITIES":
+                    v = node.value
+                    vals = tuple(
+                        e.value for e in getattr(v, "elts", [])
+                        if isinstance(e, ast.Constant)) \
+                        if isinstance(v, (ast.Tuple, ast.List)) else None
+                    if vals != ALERT_SEVERITIES:
+                        out.append(
+                            f"{path}:{node.lineno}: SEVERITIES must be the "
+                            f"literal tuple {ALERT_SEVERITIES!r} (the lint "
+                            f"and the runtime validator must agree), found "
+                            f"{vals!r}")
+                    sev_pinned = True
+        if not (isinstance(node, ast.Call) and (
+                (isinstance(node.func, ast.Name)
+                 and node.func.id == "AlertRule")
+                or (isinstance(node.func, ast.Attribute)
+                    and node.func.attr == "AlertRule"))):
+            continue
+        kwargs = {kw.arg: kw.value for kw in node.keywords}
+        name_v = kwargs.get("name") or (node.args[0] if node.args else None)
+        if isinstance(name_v, ast.Constant) and isinstance(name_v.value, str):
+            lit = name_v.value
+            if sanitize_label_value(lit) != lit:
+                out.append(
+                    f"{path}:{node.lineno}: alert rule name {lit!r} would "
+                    f"be rewritten by sanitize_label_value() — it is the "
+                    f"'rule' label value and the fingerprint prefix")
+        sev_v = kwargs.get("severity")
+        if isinstance(sev_v, ast.Constant) and isinstance(sev_v.value, str) \
+                and sev_v.value not in ALERT_SEVERITIES:
+            out.append(
+                f"{path}:{node.lineno}: alert severity {sev_v.value!r} not "
+                f"in {ALERT_SEVERITIES!r}")
+        met_v = kwargs.get("metric")
+        if isinstance(met_v, ast.Constant) and isinstance(met_v.value, str) \
+                and met_v.value.startswith(DOC_PREFIXES) \
+                and met_v.value not in fams:
+            out.append(
+                f"{path}:{node.lineno}: alert rule watches metric "
+                f"{met_v.value!r}, which is not emitted with a literal "
+                f"name anywhere — the rule would silently never fire")
+    if not sev_pinned:
+        out.append(f"{path}:0: SEVERITIES tuple not found — the severity "
+                   f"vocabulary pin is gone")
+    return out
+
+
 def _targets(root: str) -> list[str]:
     targets = []
     for dirpath, _, files in os.walk(os.path.join(root, "deepspeed_tpu")):
@@ -341,7 +427,8 @@ def main(argv: list[str]) -> int:
             f.write(render_metrics_doc(root))
         print(f"wrote {path}")
         return 0
-    violations = check_repo(root) + check_metrics_doc(root)
+    violations = check_repo(root) + check_metrics_doc(root) \
+        + check_alert_rules(root)
     for v in violations:
         print(v)
     if violations:

@@ -285,6 +285,24 @@ def feature_report() -> list[tuple[str, bool, str]]:
     except Exception as e:  # pragma: no cover — import breakage only
         feats.append(("fleet tracing (cross-replica postmortems)", False,
                       str(e)))
+    # fleet watchtower (telemetry/timeseries.py + alerts.py + bin/ds_top):
+    # time-series store, anomaly alerting, live ops console — pure host
+    # logic, so availability is an import check; the detail row names the
+    # knob, the retention defaults, and the loaded default-rule pack
+    try:
+        from .telemetry import timeseries as _ts
+        from .telemetry.alerts import default_fleet_rules as _dfr
+        _rules = _dfr()
+        _names = ", ".join(r.name for r in _rules[:3])
+        feats.append((
+            "fleet watchtower (store/alerts/ds_top)", True,
+            f"RouterConfig(watchtower=True) — on-disk time-series store "
+            f"(retention {_ts.DEFAULT_RETENTION_BYTES >> 20} MiB), "
+            f"{len(_rules)} default rules ({_names}, ...), /alerts + "
+            f"/series endpoints, bin/ds_top console"))
+    except Exception as e:  # pragma: no cover — import breakage only
+        feats.append(("fleet watchtower (store/alerts/ds_top)", False,
+                      str(e)))
     fr = os.environ.get("DS_TPU_FLIGHT_RECORDER")
     feats.append(("flight recorder", True,
                   f"dumps to {fr}" if fr

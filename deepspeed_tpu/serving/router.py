@@ -42,6 +42,7 @@ files into one fleet scrape.
 """
 from __future__ import annotations
 
+import json
 import os
 import time
 from collections import deque
@@ -207,6 +208,28 @@ class RouterConfig:
     #: robust z-score past which a replica's latency distributions mark
     #: it degraded (straggler detection — signals only)
     straggler_z: float = 3.0
+    #: fleet watchtower (telemetry/timeseries.py + alerts.py): on the
+    #: poll tick the router samples its own registry plus every
+    #: replica's heartbeat-shipped snapshot into one time-series store
+    #: tagged by slot, evaluates the alert rules against it, cuts a
+    #: black-box dump on newly-firing CRITICAL alerts, and feeds firing
+    #: warning alerts to the elastic controller as hint signals.
+    #: Off (the default) none of it exists: no store, no sampler, no
+    #: rules — zero overhead by absence like fleet_trace.
+    watchtower: bool = False
+    #: history directory (segmented crc'd frames; None = memory-only)
+    watchtower_dir: str | None = None
+    #: sample + alert-evaluation cadence
+    watchtower_interval_s: float = 1.0
+    watchtower_segment_bytes: int = 1 << 20
+    watchtower_retention_bytes: int = 8 << 20
+    #: alert rules (telemetry.alerts.AlertRule list); None = the default
+    #: fleet pack scaled to watchtower_interval_s
+    watchtower_rules: list | None = None
+    #: retention caps for the black-box dump directory
+    #: (fleet_trace_dir), oldest-out past either bound
+    fleet_dump_max_files: int = 64
+    fleet_dump_max_bytes: int = 256 << 20
     #: crash-safe control plane (serving/journal.py): a directory here
     #: write-ahead-journals every router state transition (admits,
     #: placements, committed-chunk progress, terminals, deploy phases)
@@ -417,6 +440,31 @@ class Router:
             self._straggler = StragglerScorer(
                 z_threshold=self.cfg.straggler_z)
             self.cfg.fleet.replica.setdefault("fleet_trace", True)
+        # fleet watchtower (telemetry/timeseries.py + alerts.py): same
+        # zero-overhead-by-absence discipline — off means no store, no
+        # alert manager, no sampling branch beyond one None check
+        self._watch = None
+        self._alerts = None
+        self._last_watch_sample = 0.0
+        if self.cfg.watchtower:
+            from ..telemetry.alerts import AlertManager, default_fleet_rules
+            from ..telemetry.timeseries import TimeSeriesStore
+            self._watch = TimeSeriesStore(
+                self.cfg.watchtower_dir,
+                segment_bytes=self.cfg.watchtower_segment_bytes,
+                retention_bytes=self.cfg.watchtower_retention_bytes)
+            rules = self.cfg.watchtower_rules
+            if rules is None:
+                rules = default_fleet_rules(
+                    sample_interval_s=self.cfg.watchtower_interval_s,
+                    slo_ttft_s=self.cfg.fleet_trace_slo_ttft_s
+                    if self.cfg.fleet_trace_slo_ttft_s is not None
+                    else self.cfg.slo_ttft_s)
+            self._alerts = AlertManager(
+                rules,
+                registry=telem.registry if telem.enabled else None)
+            telem.attach_watchtower(alerts_fn=self._alerts_payload,
+                                    series_fn=self._series_payload)
         self._last_clock_ping = 0.0
         self._last_bb_dump = 0.0
         self._bb_dumped: set[str] = set()
@@ -748,6 +796,11 @@ class Router:
         self.fleet.shutdown()
         if self._journal is not None:
             self._journal.close()
+        if self._watch is not None:
+            self._watch.close()
+            # detach /alerts + /series so a later router in this process
+            # doesn't serve this (now dead) router's state
+            self._telem.attach_watchtower(None, None)
 
     def abandon(self) -> None:
         """Chaos/bench hook: the in-process emulation of a router crash.
@@ -949,6 +1002,10 @@ class Router:
             if now - self._last_straggler_gauges >= 1.0:
                 self._last_straggler_gauges = now
                 self._update_straggler_gauges()
+        if self._watch is not None and now - self._last_watch_sample \
+                >= self.cfg.watchtower_interval_s:
+            self._last_watch_sample = now
+            self._watchtower_tick(now)
         if self._deploy is not None and self._deploy.active:
             if self._deploy.phase in ("canary_probe", "canary_soak") \
                     and self._inj.countdown(
@@ -1850,7 +1907,10 @@ class Router:
         """One atomic flight-recorder dump: trigger + merged clock-
         aligned timeline + clock table + fleet state + health rollup."""
         tid = trigger.get("trace_id")
-        timeline = self._ftrace.assemble(tid) if tid else None
+        # watchtower alert dumps fire with or without fleet tracing —
+        # without it there is no timeline/clock to attach, only state
+        timeline = self._ftrace.assemble(tid) \
+            if (self._ftrace is not None and tid) else None
         path = None
         if self.cfg.fleet_trace_dir:
             os.makedirs(self.cfg.fleet_trace_dir, exist_ok=True)
@@ -1864,10 +1924,22 @@ class Router:
             extra={"fleet": {
                 "trigger": trigger,
                 "timeline": timeline,
-                "clock": self._ftrace.clock.to_dict(),
+                "clock": self._ftrace.clock.to_dict()
+                if self._ftrace is not None else {},
                 "fleet_state": self._fleet_state(),
                 "health": self.fleet_health()}})
         self.blackbox_dumps += 1
+        if path is not None:
+            # breach/alert storms age out their own history instead of
+            # filling the disk (telemetry_dumps_pruned_total counts)
+            from ..telemetry.recorder import prune_dump_dir
+            prune_dump_dir(
+                self.cfg.fleet_trace_dir,
+                max_files=self.cfg.fleet_dump_max_files,
+                max_bytes=self.cfg.fleet_dump_max_bytes,
+                prefix="fleet_blackbox_",
+                registry=self._telem.registry if self._telem.enabled
+                else None)
         if self._telem.enabled:
             self._telem.registry.counter(
                 "serving_router_blackbox_dumps_total",
@@ -1889,6 +1961,91 @@ class Router:
                      "threshold vs the fleet (signals only, no "
                      "actuation)").set(int(degraded.get(r.slot, False)))
 
+    # -- fleet watchtower ------------------------------------------------
+    def _watchtower_tick(self, now: float) -> None:
+        """One sample + alert-evaluation pass (watchtower_interval_s
+        cadence on the poll tick). Samples the router registry plus every
+        replica's heartbeat-shipped snapshot file into the store tagged
+        by slot, evaluates the rules, black-boxes newly-firing critical
+        alerts, and feeds firing warning hints to the ScaleAdvisor."""
+        wall = time.time()
+        # per-slot occupancy gauge FIRST so this tick's sample carries
+        # it: the stall rule's guard ("router still believes the replica
+        # holds live sequences") and ds_top's fleet table both read it
+        if self._telem.enabled:
+            for r in self.fleet.replicas:
+                self._telem.registry.gauge(
+                    "serving_router_replica_live",
+                    labels={"replica": str(r.slot)},
+                    help="live sequences on each replica per its latest "
+                         "heartbeat (watchtower occupancy sample)").set(
+                    float((r.load or {}).get("live") or 0))
+        snaps = {"router": self._telem.registry.snapshot()}
+        snap_dir = self.cfg.fleet.snapshot_dir
+        if snap_dir:
+            for r in self.fleet.replicas:
+                p = os.path.join(snap_dir, f"replica{r.slot}.json")
+                try:
+                    with open(p, encoding="utf-8") as f:
+                        snaps[f"replica{r.slot}"] = json.load(f)
+                except (OSError, ValueError):
+                    continue   # not written yet / torn: next tick
+        self._watch.sample_many(snaps, now=wall)
+        if self._telem.enabled:
+            self._telem.registry.counter(
+                "serving_watch_samples_total",
+                help="watchtower sample ticks (router registry + replica "
+                     "snapshots folded into the time-series store)").inc()
+        for alert in self._alerts.evaluate(self._watch, now=wall):
+            logger.warning(
+                f"watchtower alert FIRING [{alert.severity}] "
+                f"{alert.fingerprint} value={alert.value}")
+            if alert.severity == "critical":
+                # an anomaly captures its own postmortem: the standard
+                # rate-limited black-box path, trigger carries the
+                # fingerprint so the dump and the alert correlate
+                self._blackbox({"kind": "alert", "rule": alert.rule,
+                                "severity": alert.severity,
+                                "fingerprint": alert.fingerprint,
+                                "source": alert.source,
+                                "value": alert.value})
+        # firing warning alerts nudge the elastic controller: re-seed the
+        # advisor's hint clock from the alert's fire time each tick (the
+        # advisor's own update() clears hints it did not compute)
+        for role, direction, fired_mono in self._alerts.elastic_hints():
+            key = (role, direction)
+            self._scale.hints[key] = 1
+            self._scale.hint_since.setdefault(key, fired_mono or now)
+
+    def _alerts_payload(self) -> dict:
+        """The ``/alerts`` endpoint body: alert state + rules + fleet
+        health + store stats (ds_top renders all of it in one fetch)."""
+        d = self._alerts.to_dict() if self._alerts is not None else {}
+        d["fleet"] = self.fleet_health()
+        if self._watch is not None:
+            d["store"] = self._watch.stats()
+        return d
+
+    def _series_payload(self, q: dict) -> dict:
+        """The ``/series`` endpoint body: history points for sparklines.
+        Query params: ``name`` (required), ``window_s``, ``q``
+        (percentile 0-1 → percentile_series), ``src``."""
+        if self._watch is None:
+            return {"points": []}
+        name = q.get("name", "")
+        window = float(q.get("window_s", 60.0))
+        src = q.get("src") or None
+        last = self._watch.last_t()
+        t0 = (last - window) if last is not None else None
+        if q.get("q"):
+            pts = self._watch.percentile_series(
+                name, float(q["q"]), window_s=float(q.get("pwin", 10.0)),
+                t0=t0, src=src)
+        else:
+            pts = self._watch.range(name, t0=t0, src=src)
+        return {"name": name, "src": src,
+                "points": [[round(t, 3), v] for t, v in pts]}
+
     def fleet_health(self) -> dict:
         """The fleet-health rollup: per-slot state/role/clock/straggler
         scores plus fleet-trace counters. Cheap, JSON-serializable —
@@ -1900,7 +2057,9 @@ class Router:
         for r in self.fleet.replicas:
             e = {"state": r.state, "role": role_of(r), "epoch": r.epoch,
                  "live": (r.load or {}).get("live"),
-                 "weight_version": r.wv}
+                 "weight_version": r.wv,
+                 "tier_entries": len(r.tier_digest) if r.tier_digest
+                 else 0}
             if self._ftrace is not None:
                 e["rtt_s"] = r.rtt_s
                 e["clock_offset_s"] = r.clock_offset_s
@@ -1915,7 +2074,8 @@ class Router:
                 "deploy": self.deploy_status(),
                 "deploys": dict(self.deploys),
                 "version_skews": self.version_skews,
-                "fleet_trace": self._ftrace is not None}
+                "fleet_trace": self._ftrace is not None,
+                "watchtower": self._watch is not None}
 
     def export_fleet_chrome(self, path: str,
                             tids: list[str] | None = None) -> str:

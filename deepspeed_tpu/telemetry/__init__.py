@@ -34,6 +34,8 @@ from .reqtrace import (LIFECYCLE_EVENTS, TENANT_CARDINALITY_CAP,
                        TENANT_OVERFLOW_LABEL, ReqTracer)
 from .spans import NULL_SPAN, SpanTracer
 from .exposition import TelemetryHTTPServer
+from .timeseries import StoreSampler, TimeSeriesStore
+from .alerts import AlertManager, AlertRule, default_fleet_rules
 
 #: metric-name prefix of every router-side series (serving/router.py) —
 #: the registry-zeroing scopes the bench and the router harness use to
@@ -50,6 +52,8 @@ __all__ = [
     "FlightRecorder", "TelemetryHTTPServer", "MFUTracker", "ReqTracer",
     "ClockSync", "FleetTraceAssembler", "StragglerScorer",
     "postmortem_report",
+    "TimeSeriesStore", "StoreSampler", "AlertManager", "AlertRule",
+    "default_fleet_rules",
     "mfu", "goodput", "device_peak_flops", "sanitize_metric_name",
     "sanitize_label_value", "LIFECYCLE_EVENTS", "TENANT_CARDINALITY_CAP",
     "TENANT_OVERFLOW_LABEL",
@@ -84,6 +88,11 @@ class Telemetry:
                                   recorder=self.recorder)
         self.server: TelemetryHTTPServer | None = None
         self._health_extra: dict = {}
+        # watchtower hooks (telemetry/alerts.py + timeseries.py): set via
+        # attach_watchtower by whoever owns the store (the router); served
+        # at /alerts and /series once the HTTP endpoint is up
+        self._alerts_fn = None
+        self._series_fn = None
 
     # -- recording shorthands -------------------------------------------
     def span(self, name: str, **args):
@@ -185,7 +194,9 @@ class Telemetry:
             server = TelemetryHTTPServer(self.registry,
                                          health_fn=self._health,
                                          peer_glob=self.peer_snapshot_glob,
-                                         trace_fn=self._chrome_dict)
+                                         trace_fn=self._chrome_dict,
+                                         alerts_fn=self._alerts_fn,
+                                         series_fn=self._series_fn)
             if getattr(self, "_peer_staleness", None) is not None:
                 server.peer_staleness_s = self._peer_staleness
             server.start(port)      # raises on a busy port — don't keep a
@@ -196,6 +207,16 @@ class Telemetry:
                 f"{self.server.port}; ignoring request for port {port} "
                 f"(one endpoint per process)")
         return self.server.port
+
+    def attach_watchtower(self, alerts_fn=None, series_fn=None) -> None:
+        """Wire the fleet watchtower's ``/alerts`` + ``/series`` providers
+        onto the exposition endpoint (live server updated in place; a
+        later ``start_http`` picks them up too). Pass None to detach."""
+        self._alerts_fn = alerts_fn
+        self._series_fn = series_fn
+        if self.server is not None:
+            self.server.alerts_fn = alerts_fn
+            self.server.series_fn = series_fn
 
     def stop_http(self) -> None:
         if self.server is not None:

@@ -57,15 +57,22 @@ class TelemetryHTTPServer:
     ``/trace`` — the live process timeline (host spans + request
     lifecycles) fetched over HTTP instead of a file, so a fleet
     postmortem can pull a process's view without filesystem access.
+    ``alerts_fn`` (optional) returns the watchtower alert state dict
+    served at ``/alerts``; ``series_fn`` (optional) takes the parsed
+    query dict and returns history points served at ``/series`` — both
+    wired by the router when the fleet watchtower is on (``bin/ds_top``
+    is the consumer).
     """
 
     def __init__(self, registry, health_fn=None, host: str = "127.0.0.1",
                  peer_glob: str | None = None,
                  peer_staleness_s: float | None = 300.0,
-                 trace_fn=None):
+                 trace_fn=None, alerts_fn=None, series_fn=None):
         self.registry = registry
         self.health_fn = health_fn
         self.trace_fn = trace_fn
+        self.alerts_fn = alerts_fn
+        self.series_fn = series_fn
         self.host = host
         self.peer_glob = peer_glob
         self.peer_staleness_s = peer_staleness_s
@@ -172,6 +179,16 @@ class TelemetryHTTPServer:
                     elif parts.path == "/trace" \
                             and server.trace_fn is not None:
                         body = json.dumps(server.trace_fn()).encode()
+                        ctype = "application/json"
+                    elif parts.path == "/alerts" \
+                            and server.alerts_fn is not None:
+                        body = json.dumps(server.alerts_fn()).encode()
+                        ctype = "application/json"
+                    elif parts.path == "/series" \
+                            and server.series_fn is not None:
+                        q = {k: v[0] for k, v in
+                             parse_qs(parts.query).items()}
+                        body = json.dumps(server.series_fn(q)).encode()
                         ctype = "application/json"
                     elif parts.path == "/healthz":
                         health = {"status": "ok",

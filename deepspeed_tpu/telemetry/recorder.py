@@ -18,6 +18,67 @@ import time
 
 from ..utils.logging import logger
 
+#: dump-directory retention defaults (count + bytes, oldest-out) — a
+#: breach/alert storm must age out its own history, not fill the disk
+DEFAULT_DUMP_MAX_FILES = 64
+DEFAULT_DUMP_MAX_BYTES = 256 << 20
+
+
+def prune_dump_dir(path: str, max_files: int = DEFAULT_DUMP_MAX_FILES,
+                   max_bytes: int = DEFAULT_DUMP_MAX_BYTES,
+                   prefix: str | None = None, registry=None) -> int:
+    """Oldest-out retention for a dump directory. Returns files removed.
+
+    Only files whose basename starts with ``prefix`` are considered (and
+    eligible for deletion) — dump directories are often shared (tmp trees,
+    ``fleet_trace_dir`` also holds journal segments), and an unscoped
+    sweep would eat neighbors. Newest files always survive; removal stops
+    as soon as both the count and byte caps hold. Increments
+    ``telemetry_dumps_pruned_total`` on ``registry`` when files go.
+    Never raises — retention is best-effort housekeeping.
+    """
+    try:
+        names = os.listdir(path)
+    except OSError:
+        return 0
+    entries: list[tuple[float, int, str]] = []
+    for n in names:
+        if prefix is not None and not n.startswith(prefix):
+            continue
+        p = os.path.join(path, n)
+        try:
+            st = os.stat(p)
+        except OSError:
+            continue
+        if not os.path.isfile(p):
+            continue
+        entries.append((st.st_mtime, st.st_size, p))
+    entries.sort()          # oldest first
+    count = len(entries)
+    total = sum(sz for (_m, sz, _p) in entries)
+    removed = 0
+    for _mtime, sz, p in entries[:-1]:   # never remove the newest
+        if count <= max_files and total <= max_bytes:
+            break
+        try:
+            os.remove(p)
+            removed += 1
+        except OSError:
+            pass
+        count -= 1
+        total -= sz
+    if removed:
+        logger.warning(f"flight recorder: pruned {removed} old dump(s) "
+                       f"from {path} (caps: {max_files} files / "
+                       f"{max_bytes >> 20} MiB)")
+        if registry is not None:
+            registry.counter(
+                "telemetry_dumps_pruned_total",
+                help="dump files removed by dump-directory retention "
+                     "(count+bytes caps, oldest-out)",
+            ).inc(removed)
+    return removed
+
 
 class FlightRecorder:
     """Bounded deque of discrete events + access to the span ring and
@@ -35,6 +96,11 @@ class FlightRecorder:
         self.path = path or os.environ.get("DS_TPU_FLIGHT_RECORDER")
         self._events: collections.deque = collections.deque(maxlen=capacity)
         self.dumps = 0
+        #: retention caps applied to the default dump path's directory
+        #: after each numbered dump (prune_dump_dir, scoped to this dump
+        #: family's basename); set either to None to disable pruning
+        self.max_dump_files: int | None = DEFAULT_DUMP_MAX_FILES
+        self.max_dump_bytes: int | None = DEFAULT_DUMP_MAX_BYTES
 
     def note(self, kind: str, **data) -> None:
         """Record a discrete event (bad step, rewind, ckpt commit, ...).
@@ -107,6 +173,14 @@ class FlightRecorder:
                 logger.error(f"flight recorder: '{reason}' dump → {final} "
                              f"({len(rec['events'])} events, "
                              f"{len(rec['spans'])} spans)")
+                if path is None and self.max_dump_files is not None \
+                        and self.max_dump_bytes is not None:
+                    # numbered default-path dumps accumulate; age them out
+                    # (scoped to this dump family — the dir may be shared)
+                    prune_dump_dir(d, max_files=self.max_dump_files,
+                                   max_bytes=self.max_dump_bytes,
+                                   prefix=os.path.basename(target),
+                                   registry=self.registry)
             except OSError as e:
                 logger.error(f"flight recorder write failed: {e}")
         else:
