@@ -1613,6 +1613,8 @@ def _router_scenario(name, trace, fleet_kw, router_kw, kill_at=None,
             "rebalances": router.rebalances,
             "rebalanced_done": sum(1 for v in done.values()
                                    if v.get("rebalanced")),
+            # anticipatory movement: proactive pushes (serving/push.py)
+            "push": router._push.stats(),
             # gang prefill: fleet-sharded prompt prefills (PR 16)
             "gang_plans": router.gang_plans,
             "gang_merges": router.gang_merges,
@@ -1811,6 +1813,59 @@ def disagg_main():
     }), flush=True)
 
 
+def _tier_rate_sweep(root: str) -> dict:
+    """``BENCH_KV_TIER_RATE_SWEEP=1``: validate the startup micro-probe
+    (kvtier.measure_tier_rates — a few MB, a few ms) against SUSTAINED
+    transfers (same probe code path, ``BENCH_KV_TIER_SWEEP_BYTES``
+    blob, default 32 MB). ``plan_kv_source`` prices promote-vs-pull-vs-
+    recompute off these byte rates, so the sweep flags the two ways the
+    pricing goes wrong: ``probe_drift`` (the micro-probe itself >2x off
+    the sustained rate — burst cache effects) and ``guess_mispriced``
+    (the CPU-guessed ``GUESS_*`` fallbacks a probe-less router runs on
+    >2x off this host's real rates)."""
+    from deepspeed_tpu.inference.kvtier import (GUESS_NVME_BYTES_S,
+                                                GUESS_RAM_BYTES_S,
+                                                measure_tier_rates)
+
+    sweep_dir = f"{root}/rate_sweep"
+    size = int(os.environ.get("BENCH_KV_TIER_SWEEP_BYTES",
+                              str(32 << 20)))
+    probe = measure_tier_rates(nvme_dir=sweep_dir)
+    sustained = measure_tier_rates(nvme_dir=sweep_dir, size_bytes=size)
+
+    def _x(a: float, b: float) -> float:
+        """Symmetric misprice factor: max/min, so 2.0 means 'off by 2x
+        in EITHER direction'."""
+        a, b = max(float(a), 1e-9), max(float(b), 1e-9)
+        return round(max(a, b) / min(a, b), 2)
+
+    drift = {"ram_x": _x(probe["ram_bytes_s"], sustained["ram_bytes_s"]),
+             "nvme_x": _x(probe["nvme_bytes_s"],
+                          sustained["nvme_bytes_s"])}
+    guess = {"ram_x": _x(GUESS_RAM_BYTES_S, sustained["ram_bytes_s"]),
+             "nvme_x": _x(GUESS_NVME_BYTES_S,
+                          sustained["nvme_bytes_s"])}
+    return {
+        "probe": {k: round(v, 1) if isinstance(v, float) else v
+                  for k, v in probe.items()},
+        "sustained": {k: round(v, 1) if isinstance(v, float) else v
+                      for k, v in sustained.items()},
+        "sustained_bytes": size,
+        "probe_vs_sustained_x": drift,
+        "guess_vs_sustained_x": guess,
+        "probe_drift": sorted(k[:-2] for k, v in drift.items()
+                              if v > 2.0),
+        "guess_mispriced": sorted(k[:-2] for k, v in guess.items()
+                                  if v > 2.0),
+        "note": "rates in bytes/s; plan_kv_source runs on the probe "
+                "when kv_rate_probe=True, on GUESS_* otherwise — a "
+                "non-empty guess_mispriced list means the probe-less "
+                "cost model would err >2x on this host, a non-empty "
+                "probe_drift list means the micro-probe's burst "
+                "reading does not hold up under sustained transfers",
+    }
+
+
 def kv_tier_main():
     """``BENCH_MODE=kv_tier``: the KV tier (inference/kvtier.py) cold vs
     warm vs disabled on toy replicas whose radix trims after EVERY
@@ -1906,6 +1961,10 @@ def kv_tier_main():
             out.append((seed >> 33) % vocab)
         return out
 
+    rate_sweep = None
+    if os.environ.get("BENCH_KV_TIER_RATE_SWEEP") == "1":
+        rate_sweep = _tier_rate_sweep(root)
+
     chaos = {"requests": 0, "oracle_identical": 0, "double_commits": 0}
     rep = replica_cfg(True, "chaos")
     router = Router(RouterConfig(
@@ -1957,6 +2016,7 @@ def kv_tier_main():
             "tier_promotes": promotes,
             "tier_demoted_pages": demotes,
             "chaos": chaos,
+            "rate_sweep": rate_sweep,
             "note": "cache_pages=0 makes every follow-up a placement "
                     "miss in HBM; tier_warm promotes the demoted chain "
                     "(tier_hit_rate = promotes/requests), "
@@ -1965,6 +2025,151 @@ def kv_tier_main():
                     "tier_crash_mid_demote and requires every stream "
                     "bit-identical to the LCG oracle with 0 "
                     "double-commits",
+        },
+    }), flush=True)
+
+
+def kv_push_main():
+    """``BENCH_MODE=kv_push``: anticipatory KV movement (serving/push.py)
+    vs the reactive baseline on the SAME seeded hot-chain trace. A warm
+    wave of identical requests seeds one hot prefix chain on replica 0
+    (sticky heat >= kv_push_min_heat); an idle window then lets the
+    PushPlanner ship the chain to digest-cold replica 1 BEFORE any
+    request needs it; the measured burst overflows replica 0's capacity
+    so spillover lands on replica 1 — push-warm it prefix-hits
+    immediately, reactive it pays a demand pull (or the recompute)
+    serialized in front of TTFT. Both runs share the seeded trace, so
+    vs_baseline prices exactly what anticipation bought. A final chaos
+    leg arms ``replica_crash_during_kv_export`` on the push SOURCE (the
+    sender dies mid-push) and requires every stream bit-identical to
+    the LCG oracle with 0 double-commits — pushes are pure opportunism,
+    losing one must never corrupt demand work."""
+    from deepspeed_tpu.serving import FleetConfig, Router, RouterConfig
+    from deepspeed_tpu.serving.replica import _mix
+
+    import shutil
+
+    n_req = int(os.environ.get("BENCH_KV_PUSH_REQUESTS", "8"))
+    prefix = int(os.environ.get("BENCH_ROUTER_PREFIX", "128"))
+    gen = int(os.environ.get("BENCH_ROUTER_GEN", "8"))
+    vocab = 1024
+    bs = 16
+    root = "/tmp/ds_bench_kv_push"
+    shutil.rmtree(root, ignore_errors=True)
+    # the hot chain: one deterministic page-aligned prompt every run
+    hot = [(i * 7 + 3) % vocab for i in range(prefix)]
+
+    def oracle(prompt, n):
+        seed = 0
+        for t in prompt:
+            seed = _mix(seed, int(t))
+        out = []
+        for i in range(n):
+            seed = _mix(seed, i)
+            out.append((seed >> 33) % vocab)
+        return out
+
+    def _run(tag: str, push_on: bool, per_slot: dict | None = None):
+        rep = {"backend": "toy", "block_size": bs, "max_live": 2,
+               "vocab": vocab, "hb_interval_s": 0.03,
+               "tokens_per_step": 4, "decode_delay_s": 0.002,
+               # prefill costs simulated device time: what a pushed
+               # chain's prefix hit (or an overlapped pull) skips
+               "prefill_chunk": bs, "prefill_delay_s": 0.02,
+               "shm_bytes": 1 << 20}
+        router = Router(RouterConfig(
+            fleet=FleetConfig(n_replicas=2, replica=rep,
+                              hb_timeout_s=2.0, backoff_base_s=0.05,
+                              log_dir=f"{root}/{tag}/logs",
+                              per_slot=per_slot or {}),
+            request_timeout_s=30.0, max_retries=3, rebalance=False,
+            kv_pull=True, kv_pull_min_pages=1, kv_rate_probe=False,
+            kv_push=push_on, kv_overlap=push_on,
+            kv_push_min_interval_s=0.05))
+        try:
+            router.start(min_ready=2)
+            # warm wave: identical prompts run SEQUENTIALLY — each
+            # digest-matches replica 0 (no spillover, no demand pull,
+            # so the chaos leg's armed export crash can only fire on
+            # the push) while the shared chain accrues sticky heat
+            for i in range(3):
+                router.submit(list(hot), max_new_tokens=4,
+                              trace_id=f"warm-{i}")
+                router.run(deadline_s=30.0)
+            # idle window: the planner only launches while the fleet
+            # is idle — poll until the push settles (landed, declined
+            # or failed), bounded; the reactive run just drains
+            deadline = time.monotonic() + 4.0
+            while time.monotonic() < deadline:
+                router.poll()
+                st = router._push.stats()
+                settled = (st["acks"] + st["misses"] + st["declines"]
+                           > 0 and st["in_flight"] == 0)
+                if not push_on or settled:
+                    break
+                time.sleep(0.01)
+            for _ in range(20):
+                router.poll()        # let the target's digest land
+            tids = []
+            t0 = time.monotonic()
+            for i in range(n_req):
+                prompt = list(hot) + [(900 + i) % vocab]
+                tids.append((router.submit(prompt, max_new_tokens=gen,
+                                           trace_id=f"m{i}"), prompt))
+                router.poll()
+            res = router.run(deadline_s=120.0)
+            wall = time.monotonic() - t0
+            meas = {t: v for t, v in res.items()
+                    if not t.startswith("warm-")}
+            done = {t: v for t, v in meas.items()
+                    if v["status"] == "done"}
+            ttfts = sorted(v["ttft_s"] for v in done.values()
+                           if v["ttft_s"] is not None)
+            return {
+                "requests": len(meas), "completed": len(done),
+                "oracle_identical": sum(
+                    1 for tid, p in tids
+                    if res[tid]["status"] == "done"
+                    and res[tid]["tokens"] == oracle(p, gen)),
+                "p50_ttft_s": round(ttfts[len(ttfts) // 2], 4)
+                if ttfts else None,
+                "p95_ttft_s": round(ttfts[int(len(ttfts) * 0.95)], 4)
+                if ttfts else None,
+                "wall_s": round(wall, 3),
+                "double_commits": router.double_commits,
+                "kv_pulls": router.kv_pulls,
+                "kv_pull_fallbacks": router.kv_pull_fallbacks,
+                "pulled_done": sum(1 for v in done.values()
+                                   if v.get("pulled_pages", 0) > 0),
+                "push": router._push.stats(),
+                "replica_restarts": router.fleet.restarts_total,
+            }
+        finally:
+            router.close()
+
+    on = _run("on", True)
+    off = _run("off", False)
+    chaos = _run("chaos", True, per_slot={
+        "0": {"faults": {"replica_crash_during_kv_export": 1}}})
+    print(json.dumps({
+        "metric": f"anticipatory KV push+overlap vs reactive pull, "
+                  f"{n_req} reqs sharing a {prefix}-token hot chain "
+                  f"(2 toy replicas, per-replica capacity 2)",
+        "value": on["p50_ttft_s"],
+        "unit": "p50 TTFT s (pushes+overlap)",
+        "vs_baseline": round((off["p50_ttft_s"] or 0.0)
+                             / max(on["p50_ttft_s"] or 1e-9, 1e-9), 3),
+        "detail": {
+            "push_overlap": on,
+            "reactive": off,
+            "chaos": chaos,
+            "note": "same seeded hot-chain trace all three runs; "
+                    "push_overlap ships the chain to the cold replica "
+                    "during the idle window (spillover prefix-hits, "
+                    "kv_pulls ~0), reactive pays the demand pull / "
+                    "recompute in front of TTFT; the chaos leg "
+                    "crashes the push SOURCE mid-export and requires "
+                    "oracle-identical streams with 0 double-commits",
         },
     }), flush=True)
 
@@ -2579,6 +2784,10 @@ def main():
     if os.environ.get("BENCH_MODE") == "kv_tier":
         # KV tiering: tier-warm promotes vs recompute-only (host-only)
         return kv_tier_main()
+    if os.environ.get("BENCH_MODE") == "kv_push":
+        # anticipatory KV movement: proactive pushes + overlap vs the
+        # reactive pull baseline (host-only)
+        return kv_push_main()
     if os.environ.get("BENCH_MODE") == "elastic":
         # drain/spawn/re-role under a diurnal trace vs static (host-only)
         return elastic_main()

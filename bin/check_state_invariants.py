@@ -101,17 +101,27 @@ WEIGHT_VERSION_ALLOWED = {
 #: the trie's mutator pinning prevents); ``extract`` pairs with the
 #: refcounted adopt + scatter path (a stray extract whose bundle never
 #: adopts would inflate promote stats and skip the version-skew gate's
-#: counters); ``set_weight_version``/``close`` mutate tier membership.
+#: counters); ``extract_begin``/``extract_finish`` are the promote-
+#: ahead two-phase form of ``extract`` and carry the same hazard (a
+#: begin whose finish never runs must leave the tier byte-identical —
+#: only the pinned wrappers uphold that, so a stray begin/finish
+#: elsewhere could split the promote across incompatible state);
+#: ``set_weight_version``/``close`` mutate tier membership.
 #: The implementation file itself (kvtier.py) is exempt like ragged.py
 #: is for the StateManager rules.
-KV_TIER_MUTATORS = {"absorb", "extract", "set_weight_version", "close"}
+KV_TIER_MUTATORS = {"absorb", "extract", "extract_begin",
+                    "extract_finish", "set_weight_version", "close"}
 KV_TIER_FILE = "deepspeed_tpu/inference/kvtier.py"
 KV_TIER_ALLOWED = {
     ("engine_v2.py", "_demote_evicted"),
     ("engine_v2.py", "_tier_promote"),
+    ("engine_v2.py", "tier_promote_begin"),
+    ("engine_v2.py", "tier_promote_finish"),
     ("engine_v2.py", "swap_weights"),
     ("replica.py", "_demote_evicted"),
     ("replica.py", "_tier_promote"),
+    ("replica.py", "tier_promote_begin"),
+    ("replica.py", "tier_promote_finish"),
     ("replica.py", "kv_export"),
     ("replica.py", "swap_weights"),
     ("replica.py", "_flush_radix"),

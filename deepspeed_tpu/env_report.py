@@ -187,6 +187,24 @@ def feature_report() -> list[tuple[str, bool, str]]:
         feats.append(("inference: KV tiering (HBM → host RAM → NVMe)",
                       False, str(e)))
 
+    # anticipatory KV movement (serving/push.py + router/replica
+    # overlap): proactive pushes, promote-ahead, transfer/compute
+    # overlap — pure host logic, availability is an import check
+    try:
+        from .serving import push as _push  # noqa: F401
+        feats.append((
+            "serving: anticipatory KV movement (push/overlap)", True,
+            "RouterConfig(kv_push=True, kv_overlap=True) — idle-window "
+            "heat-scored pushes of hot chains to digest-cold replicas "
+            "over declinable kv_push offers (demand joins in-flight "
+            "transfers), promote_hint starts the two-phase tier "
+            "extract concurrent with admission, and overlap promises "
+            "prefill the suffix during the transfer with commit-or-"
+            "rollback settlement; BENCH_MODE=kv_push"))
+    except Exception as e:  # pragma: no cover — import breakage only
+        feats.append(("serving: anticipatory KV movement (push/overlap)",
+                      False, str(e)))
+
     # gang prefill (serving/router.py + parallel/sequence.py): one long
     # prompt's prefill sharded across the fleet — pure host logic
     try:

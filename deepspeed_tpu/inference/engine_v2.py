@@ -3017,10 +3017,13 @@ class InferenceEngineV2:
     # KV tiering (inference/kvtier.py): HBM → host RAM → NVMe under the
     # radix. _demote_evicted is the PrefixCache eviction sink (installed
     # at construction when cfg.kv_tier); _tier_promote runs at admission
+    # — via the two-phase tier_promote_begin/tier_promote_finish form,
+    # so the serving layer can start the extract ahead of admission —
     # and adopts the tier's chain through the SAME refcounted
-    # adopt_prefix + page-scatter path cross-replica pulls use —
+    # adopt_prefix + page-scatter path cross-replica pulls use.
     # bin/check_state_invariants.py pins the tier's absorb/extract
-    # mutators to exactly these two wrappers.
+    # (and extract_begin/extract_finish) mutators to exactly these
+    # wrappers.
     # ------------------------------------------------------------------
     def _demote_evicted(self, chains) -> None:
         """Serialize each reclaimed chain through the kind="prefix"
@@ -3060,20 +3063,20 @@ class InferenceEngineV2:
             if self._rt.enabled:
                 self._rt.event(-1, "kv_tier", dir="demote", pages=demoted)
 
-    def _tier_promote(self, tokens) -> int:
-        """Admission-path promote: when the tier holds a DEEPER chain
-        than the HBM trie for this prompt, rebuild it as a prefix bundle
-        and adopt it (``import_prefix`` → ``StateManager.adopt_prefix``
-        + the page scatter) so the admit that follows hits it through
-        the normal match path. Returns pages promoted; 0 — with
-        recompute covering the prompt — on ANY miss, corruption,
-        version skew, or pool-capacity refusal."""
+    def tier_promote_begin(self, tokens):
+        """Promote-ahead, phase one: plan the admission-path tier
+        extract WITHOUT touching tier state (``KVTier.extract_begin``
+        is a pure membership walk — no reads, no ring moves, no stat
+        counts), so the NVMe read + crc verify in
+        :meth:`tier_promote_finish` can start before or concurrently
+        with admission. Returns an opaque handle, or None when the
+        tier holds nothing deeper than the HBM trie."""
         tier = self._kv_tier
         bs = self.config.block_size
         cap = min(len(tokens) - 1, self.state.max_blocks_per_seq * bs)
         n_full = cap // bs
         if tier is None or n_full < 1:
-            return 0
+            return None
         aligned = [int(t) for t in tokens[:n_full * bs]]
         from .prefix_cache import chain_hashes
 
@@ -3081,9 +3084,26 @@ class InferenceEngineV2:
         have = self._prefix_cache.cached_depth(aligned)
         deep = tier.probe(chain)
         if deep <= have:
-            return 0                 # HBM already covers the tier's chain
+            return None              # HBM already covers the tier's chain
+        h = tier.extract_begin(aligned[:deep * bs], bs)
+        if h is not None:
+            h["have"] = have
+        return h
+
+    def tier_promote_finish(self, handle) -> int:
+        """Promote-ahead, phase two: the payload reads + crc verify the
+        plan named, then the refcounted adopt (``import_prefix`` →
+        ``StateManager.adopt_prefix`` + the page scatter) so the admit
+        that follows hits the chain through the normal match path.
+        Returns pages promoted; 0 — with recompute covering the prompt
+        — on ANY miss, corruption, version skew, or pool-capacity
+        refusal."""
+        tier = self._kv_tier
+        if tier is None or handle is None:
+            return 0
+        bs = self.config.block_size
         t0 = time.perf_counter()
-        bundle = tier.extract(aligned[:deep * bs], bs)
+        bundle = tier.extract_finish(handle)
         if bundle is None:
             return 0
         try:
@@ -3102,16 +3122,25 @@ class InferenceEngineV2:
             # end-to-end, the LIVE latency record re-sizes the break-even
             # (an explicit config value is never second-guessed)
             tier.refine_min_pages(block_size=bs)
+        gained = max((len(handle["tok"]) // bs
+                      - int(handle.get("have", 0))) * bs, 0)
         self.stats["kv_tier_promotes"] += 1
-        self.stats["kv_tier_promoted_tokens"] += (deep - have) * bs
+        self.stats["kv_tier_promoted_tokens"] += gained
         if self._rt.enabled:
             self._rt.event(-1, "kv_tier", dir="promote", pages=pages,
-                           tokens=(deep - have) * bs)
+                           tokens=gained)
         # the serving_kv_tier_* counter family is emitted in ONE place
         # (the replica loop's delta sync) so engine-backed and toy
         # replicas can never double-count; standalone engine users read
         # stats / kv_tier_stats() directly
         return pages
+
+    def _tier_promote(self, tokens) -> int:
+        """Admission-path promote, one-shot composition of the
+        two-phase form above: when the tier holds a DEEPER chain than
+        the HBM trie for this prompt, extract and adopt it so the
+        admit that follows hits it."""
+        return self.tier_promote_finish(self.tier_promote_begin(tokens))
 
     def kv_tier_stats(self) -> dict | None:
         """Lifetime tier counters (residency bytes/pages per sub-tier,

@@ -610,12 +610,69 @@ class KVTier:
         a miss shorter than ``min_pages`` or ANY inconsistency — the
         caller recomputes, always safe. The caller adopts the bundle via
         the refcounted pull surface (StateManager.adopt_prefix + the
-        engine scatter), never by touching blocks itself."""
+        engine scatter), never by touching blocks itself.
+
+        The synchronous form composes the two-phase promote-ahead API:
+        :meth:`extract_begin` (mutation-free plan) + :meth:`extract_finish`
+        (the payload reads below)."""
         bs = int(block_size)
         n_full = len(tokens) // bs
         if n_full == 0:
             return None
         aligned = [int(t) for t in tokens[:n_full * bs]]
+        return self._extract_payload(aligned, bs, trace_id)
+
+    def extract_begin(self, tokens, block_size: int,
+                      trace_id: str = "") -> dict | None:
+        """Phase one of the two-phase promote (promote-AHEAD pipelining,
+        serving-side): a MUTATION-FREE membership walk that plans the
+        extract and returns an opaque handle for :meth:`extract_finish`,
+        or None when the resident run is shorter than ``min_pages``.
+        Nothing is read, moved, or counted here — ring recency, spill
+        index, and every stat are untouched — so a crash (or an
+        abandoned handle) between begin and finish leaves the tier
+        byte-identical to never having begun: recompute covers, the
+        audit stays clean. The replica calls begin at admission (the
+        router's ``promote_hint``) so the NVMe reads + crc verification
+        in finish overlap the put's own admission work instead of
+        serializing after it."""
+        bs = int(block_size)
+        n_full = len(tokens) // bs
+        if n_full == 0:
+            return None
+        aligned = [int(t) for t in tokens[:n_full * bs]]
+        n = 0
+        for h in chain_hashes(aligned, bs):
+            ent = self.ring.peek(h)
+            if ent is not None:
+                if version_skew(ent[0].get("wv"), self._wv):
+                    break
+            elif self.spill is not None and h in self.spill:
+                if version_skew(self.spill._idx[h][2].get("wv"),
+                                self._wv):
+                    break
+            else:
+                break
+            n += 1
+        if n < max(self.cfg.min_pages, 1):
+            return None
+        return {"tok": aligned, "bs": bs, "tid": trace_id, "planned": n}
+
+    def extract_finish(self, handle: dict | None) -> PageBundle | None:
+        """Phase two: the payload reads, crc verification, NVMe→RAM
+        moves, recency touches and bundle build — everything
+        :meth:`extract` does after its alignment step. Residency may
+        have shrunk since :meth:`extract_begin` (eviction, swap, torn
+        records); every inconsistency is the same counted fallback as
+        the synchronous path and returns None — the caller recomputes,
+        always safe."""
+        if handle is None:
+            return None
+        return self._extract_payload(handle["tok"], handle["bs"],
+                                     handle["tid"])
+
+    def _extract_payload(self, aligned: list[int], bs: int,
+                         trace_id: str) -> PageBundle | None:
         chain = chain_hashes(aligned, bs)
         pages: list[bytes] = []
         scales: list = []

@@ -169,36 +169,52 @@ def plan_kv_source(chain_pages: int, hit_pages: int, peer_pages: int,
                    tier_pages: int, page_bytes: int, block_size: int,
                    prefill_tok_s: float, pull_bytes_s: float,
                    tier_bytes_s: float, overhead_s: float = 0.0,
-                   min_pages: int = 1) -> str:
-    """The three-way KV-sourcing decision for a placed request:
-    ``"pull"`` (ship the chain from the deepest same-version peer's HBM
-    radix), ``"tier"`` (let the placed replica promote from its own
-    host-RAM/NVMe KV tier — inference/kvtier.py), or ``"recompute"``.
+                   min_pages: int = 1, *, push_pages: int = 0,
+                   overlap: bool = False) -> str:
+    """The KV-sourcing decision for a placed request: ``"pull"`` (ship
+    the chain from the deepest same-version peer's HBM radix),
+    ``"tier"`` (let the placed replica promote from its own host-RAM/
+    NVMe KV tier — inference/kvtier.py), ``"push"`` (a proactive push
+    of the chain is ALREADY in flight toward the placed replica —
+    serving/push.py — so the put just joins it instead of starting new
+    movement), or ``"recompute"``.
 
     Each option's cost = transfer time for the pages it covers beyond
     the placed replica's HBM hit (``hit_pages``) + prefill time for the
-    tokens nothing covers. The tier rate should be the CONSERVATIVE
-    (NVMe) rate — the router cannot see which sub-tier holds the chain,
-    and recompute/tier are both safe while a pull burns fleet messages.
-    Options that do not beat the placed replica's hit by ``min_pages``
-    drop out; exact ties prefer recompute over tier over pull (cheaper
-    machinery first). Recompute stays the always-safe FALLBACK
-    regardless of what this returns — the decision only picks what to
-    TRY first."""
+    tokens nothing covers. With ``overlap`` the replica prefills the
+    suffix WHILE the transfer lands (transfer/compute overlap), so the
+    two legs cost ``max(xfer, prefill)`` instead of their sum — the
+    transfer hides behind compute whenever the suffix is long enough.
+    The tier rate should be the CONSERVATIVE (NVMe) rate — the router
+    cannot see which sub-tier holds the chain, and recompute/tier are
+    both safe while a pull burns fleet messages. Options that do not
+    beat the placed replica's hit by ``min_pages`` drop out; exact ties
+    prefer recompute over tier over push over pull (cheaper machinery
+    first — a push join rides movement already paid for, a pull starts
+    new movement). Recompute stays the always-safe FALLBACK regardless
+    of what this returns — the decision only picks what to TRY first."""
     bs = max(block_size, 1)
-    chain_pages = max(chain_pages, hit_pages, peer_pages, tier_pages)
+    chain_pages = max(chain_pages, hit_pages, peer_pages, tier_pages,
+                      push_pages)
 
     def total(covered: int, rate: float) -> float:
         xfer = transfer_time(covered - hit_pages, page_bytes, rate,
                              overhead_s)
-        return xfer + (chain_pages - covered) * bs \
+        prefill = (chain_pages - covered) * bs \
             / max(prefill_tok_s, 1e-9)
+        if overlap and covered > hit_pages:
+            return max(xfer, prefill)
+        return xfer + prefill
 
     best, best_t = "recompute", total(hit_pages, 1.0)
     if tier_pages - hit_pages >= min_pages:
         t = total(tier_pages, tier_bytes_s)
         if t < best_t:
             best, best_t = "tier", t
+    if push_pages - hit_pages >= min_pages:
+        t = total(push_pages, pull_bytes_s)
+        if t < best_t:
+            best, best_t = "push", t
     if peer_pages - hit_pages >= min_pages:
         t = total(peer_pages, pull_bytes_s)
         if t < best_t:

@@ -145,6 +145,13 @@ class ToyBackend:
         #: instead of recomputing. None = no tier.
         self.kv_tier = None
         self.tier_promotes = 0
+        #: anticipatory-movement counters (serving/push.py PR): tier
+        #: promotes begun ahead of admission on the router's
+        #: promote_hint, and overlap promises confirmed / rolled back
+        #: into recompute
+        self.promote_ahead = 0
+        self.overlap_commits = 0
+        self.overlap_rollbacks = 0
         if cfg.get("kv_tier"):
             from ..inference.kvtier import KVTier
             self.kv_tier = KVTier(_slot_tier_cfg(cfg), inj=inj)
@@ -173,27 +180,39 @@ class ToyBackend:
             if bundle is not None:
                 tier.absorb(bundle)
 
-    def _tier_promote(self, prompt) -> int:
-        """Admission-path promote: when the tier's chain outruns the
-        radix's, extract it (crc-verified), run the toy payload oracle,
-        and adopt it into the radix so the match below hits it. Any
-        failure — torn record, crc, version skew — returns 0 and the
-        prompt recomputes (always safe)."""
-        from ..inference.migration import MigrationError, toy_verify
-
+    def tier_promote_begin(self, prompt):
+        """Promote-ahead, phase one: plan the admission-path tier
+        extract WITHOUT touching tier state — a pure membership walk
+        (``KVTier.extract_begin``), so a crash between the phases
+        leaves the tier byte-identical. Returns an opaque handle for
+        :meth:`tier_promote_finish`, or None when the tier holds
+        nothing deeper than the radix."""
         tier = self.kv_tier
         bs = self.block_size
         n_full = (len(prompt) - 1) // bs
         if tier is None or n_full < 1:
-            return 0
+            return None
         aligned = [int(t) for t in prompt[:n_full * bs]]
-        chain = chain_hashes(aligned, bs)
         have = self.radix.cached_depth(aligned)
-        deep = tier.probe(chain)
+        deep = tier.probe(chain_hashes(aligned, bs))
         if deep <= have:
+            return None
+        return self.kv_tier.extract_begin(aligned[:deep * bs], bs)
+
+    def tier_promote_finish(self, handle, ahead: bool = False) -> int:
+        """Promote-ahead, phase two: the NVMe/RAM reads + crc verify
+        the plan named, then the toy payload oracle and the radix
+        adopt, so the admission match that follows hits the chain. Any
+        failure — torn record, crc, version skew — returns 0 and the
+        prompt recomputes (always safe). ``ahead`` marks a promote the
+        router's ``promote_hint`` started before admission."""
+        from ..inference.migration import MigrationError, toy_verify
+
+        tier = self.kv_tier
+        if tier is None or handle is None:
             return 0
         t0 = time.perf_counter()
-        bundle = tier.extract(aligned[:deep * bs], bs)
+        bundle = self.kv_tier.extract_finish(handle)
         if bundle is None:
             return 0
         try:
@@ -201,7 +220,7 @@ class ToyBackend:
             nodes, _ = self.radix.adopt(
                 bundle.tokens,
                 [self._fresh_block() for _ in range(bundle.n_full)],
-                bundle.n_full * bs)
+                bundle.n_full * self.block_size)
         except (MigrationError, RuntimeError):
             tier._fallback("adopt")
             return 0
@@ -209,13 +228,31 @@ class ToyBackend:
         tier.note_promote_latency(time.perf_counter() - t0,
                                   pages=bundle.n_full)
         self.tier_promotes += 1
+        if ahead:
+            self.promote_ahead += 1
         # deliberately NO cache_pages trim here: the caller (put) is
         # about to match-and-pin exactly these pages — trimming first
         # would evict the promote before it serves (and re-demote it).
         # The ordinary release-path trim reclaims them later.
         return bundle.n_full
 
-    def put(self, rec: RequestRecord) -> str | None:
+    def _tier_promote(self, prompt) -> int:
+        """Admission-path promote, one-shot composition of the
+        two-phase form: when the tier's chain outruns the radix's,
+        extract it (crc-verified) and adopt it so the match below hits
+        it."""
+        return self.tier_promote_finish(self.tier_promote_begin(prompt))
+
+    def put(self, rec: RequestRecord,
+            promised_tokens: int = 0) -> str | None:
+        """Admit a request. ``promised_tokens`` > 0 engages
+        transfer/compute overlap: that many prompt tokens are promised
+        by an in-flight KV transfer, so prefill starts at the promised
+        boundary (only the suffix computes while pages are on the
+        wire) and decode holds until :meth:`settle_promise` confirms
+        the pages landed — or rolls the provisional skip back into
+        prefill (recompute). The stream is seed-derived from the prompt
+        alone, so it is bit-identical either way."""
         if rec.trace_id in self.seqs:
             return "duplicate"
         if len(self.seqs) >= self.max_live:
@@ -226,15 +263,54 @@ class ToyBackend:
         self.radix.acquire(nodes)
         hit = len(nodes) * self.block_size
         self.prefix_hit_tokens += hit
+        promised = min(int(promised_tokens),
+                       ((len(rec.prompt) - 1) // self.block_size)
+                       * self.block_size)
+        skip = max(promised - hit, 0)
         seed = 0
         for t in rec.prompt:
             seed = _mix(seed, int(t))
         self.seqs[rec.trace_id] = {
             "rec": rec, "nodes": nodes, "generated": [],
-            "prefill_left": len(rec.prompt) - hit, "seed": seed,
+            "prefill_left": len(rec.prompt) - hit - skip, "seed": seed,
+            "provisional_skip": skip,
             "wv": self.weight_version["id"]}
         self.order.append(rec.trace_id)
         return None
+
+    def settle_promise(self, rid: str, ok: bool) -> str | None:
+        """The transfer behind an overlap promise settled. ``ok`` =
+        its pages were adopted into the radix: re-match to pin
+        whatever chain is now resident, and convert any uncovered
+        remainder of the promise back into prefill (recompute —
+        always safe, and the seed-derived stream is unchanged).
+        Returns "commit" (promise fully covered), "short" (landed but
+        under-delivered), "recompute" (nothing landed), or None (no
+        promise outstanding — the admit was refused or the sequence
+        is gone)."""
+        seq = self.seqs.get(rid)
+        if seq is None or not seq.get("provisional_skip"):
+            return None
+        skip = int(seq.pop("provisional_skip"))
+        covered = len(seq["nodes"]) * self.block_size
+        boundary = covered + skip
+        if ok:
+            rec = seq["rec"]
+            nodes = self.radix.match(rec.prompt,
+                                     max_tokens=len(rec.prompt) - 1)
+            if len(nodes) > len(seq["nodes"]):
+                self.radix.acquire(nodes)
+                self.radix.release(seq["nodes"])
+                self.prefix_hit_tokens += \
+                    (len(nodes) - len(seq["nodes"])) * self.block_size
+                seq["nodes"] = nodes
+                covered = len(nodes) * self.block_size
+        if covered >= boundary:
+            self.overlap_commits += 1
+            return "commit"
+        seq["prefill_left"] += boundary - covered
+        self.overlap_rollbacks += 1
+        return "short" if ok else "recompute"
 
     # -- gang prefill (fleet-sharded prompt prefill) ---------------------
     def gang_put(self, gid: str, tokens: list[int], own: int,
@@ -365,6 +441,12 @@ class ToyBackend:
                     time.sleep(self.prefill_delay_s)
                 seq["prefill_left"] -= min(self.prefill_chunk,
                                            seq["prefill_left"])
+                continue
+            if seq.get("provisional_skip"):
+                # transfer/compute overlap: the suffix beyond the
+                # promised boundary is computed, but sampling needs the
+                # promised pages (or their recompute) first — hold at
+                # the boundary until the promise settles
                 continue
             n = min(self.tokens_per_step,
                     rec.max_new_tokens - len(seq["generated"]))
@@ -671,7 +753,7 @@ class ToyBackend:
         # arriving) hold capacity but schedule nothing — mirror the
         # engine's load_summary shape
         active = [self.seqs[r] for r in self.order]
-        pend = sum(s["prefill_left"]
+        pend = sum(s["prefill_left"] + s.get("provisional_skip", 0)
                    + (s["rec"].max_new_tokens - len(s["generated"]))
                    for s in active)
         return {"live": len(self.seqs), "queued": len(active),
@@ -852,7 +934,14 @@ class EngineBackend:
     def has_work(self) -> bool:
         return bool(self._uids) or bool(self.eng._inflight)
 
-    def put(self, rec: RequestRecord) -> str | None:
+    def put(self, rec: RequestRecord,
+            promised_tokens: int = 0) -> str | None:
+        # ``promised_tokens`` (transfer/compute overlap) is accepted
+        # for loop parity with the toy backend but not acted on: the
+        # engine admits at the COMPUTED boundary, so a promise here
+        # degrades to the reactive shape (full prefill — always
+        # correct, just no overlap win) until the ragged scheduler
+        # grows a provisional-start form
         if rec.trace_id in self._uids:
             return "duplicate"
         if not self.eng.can_schedule(len(rec.prompt), rec.max_new_tokens):
@@ -872,6 +961,21 @@ class EngineBackend:
         self._uids[rec.trace_id] = uid
         self._sent[rec.trace_id] = 0
         self._tenants[rec.trace_id] = rec.tenant
+        return None
+
+    def tier_promote_begin(self, prompt):
+        """Promote-ahead plan (engine_v2's two-phase tier extract):
+        mutation-free, so it can run at put receipt — the reads happen
+        in :meth:`tier_promote_finish` before/concurrently with
+        admission."""
+        return self.eng.tier_promote_begin([int(t) for t in prompt])
+
+    def tier_promote_finish(self, handle, ahead: bool = False) -> int:
+        return self.eng.tier_promote_finish(handle)
+
+    def settle_promise(self, rid: str, ok: bool) -> str | None:
+        # the engine backend never admits with a promise (see put), so
+        # there is nothing to confirm or roll back
         return None
 
     def cancel(self, rid: str) -> None:
@@ -1449,6 +1553,11 @@ class DaemonState:
             if entry.get("gang"):
                 # a gang dies with its router: fail the segment out
                 self.backend.gang_upstream(rid, ok=False)
+            elif entry.get("overlap"):
+                # the promise can never land (the relaying router is
+                # gone): recompute the provisional skip
+                self.backend.settle_promise(
+                    entry.get("join_rid", rid), ok=False)
             elif entry.get("put") is not None:
                 self.admit_offline(entry["put"])
         for rid in set(self.attempts) | set(self.term_buf):
@@ -1465,6 +1574,9 @@ class DaemonState:
             entry = self.pulls.pop(rid)
             if entry.get("gang"):
                 self.backend.gang_upstream(rid, ok=False)
+            elif entry.get("overlap"):
+                self.backend.settle_promise(
+                    entry.get("join_rid", rid), ok=False)
             elif entry.get("put") is not None:
                 self.admit_offline(entry["put"])
         for rid, kind, toks, off in self.backend.step(self.inj):
@@ -1501,6 +1613,13 @@ class DaemonState:
         self.stream_log.pop(rid, None)
         self.term_buf.pop(rid, None)
         self.pulls.pop(rid, None)
+        for e in self.pulls.values():
+            if e.get("put") is not None \
+                    and str(e["put"].get("id", "")) == rid:
+                # a flushed request joined to a still-running push:
+                # detach the held put — the push settles as plain
+                # cache warming
+                e["put"] = None
         self.pull_exports.pop(rid, None)
         self.mig_shm.pop(rid, None)
         self.mig_relay_need.discard(rid)
@@ -1773,8 +1892,12 @@ def serve(cfg: dict, chan: LineChannel,
             out.append(c)
         return out, used
 
-    def _admit_put(msg: dict) -> None:
-        """Admit a (possibly pull-deferred) put into the backend."""
+    def _admit_put(msg: dict, promised: int = 0) -> None:
+        """Admit a (possibly pull-deferred) put into the backend.
+        ``promised`` > 0 engages transfer/compute overlap: that many
+        prompt tokens are promised by an in-flight transfer, so the
+        backend prefills only the suffix beyond them and holds decode
+        until the promise settles."""
         rid = str(msg["id"])
         if draining:
             _stream({"t": "failed", "id": rid,
@@ -1785,7 +1908,7 @@ def serve(cfg: dict, chan: LineChannel,
         # scratch — the attempt nonce already invalidates the old
         # stream's messages
         backend.cancel(rid)
-        reason = backend.put(RequestRecord.from_wire(msg))
+        reason = backend.put(RequestRecord.from_wire(msg), promised)
         if reason:
             _trace_ev(rid, "reject", reason=reason)
             _trace_ship(rid)
@@ -1813,12 +1936,41 @@ def serve(cfg: dict, chan: LineChannel,
                  "pages": pages, "bytes": nbytes})
         if entry.get("gang"):
             backend.gang_upstream(rid, ok=pages > 0)
-        elif entry.get("prewarm"):
-            # elastic pre-warm: the adopted chain IS the result — the
-            # kv_ack page count above tells the router how warm we got
-            attempts.pop(rid, None)
-        else:
+        elif entry.get("overlap"):
+            # transfer/compute overlap: the put was admitted at the
+            # promised boundary when it arrived — settle the promise
+            # instead of admitting. A failed or short transfer rolls
+            # the provisional skip back into prefill (recompute; the
+            # seed-derived stream is bit-identical either way).
+            res = backend.settle_promise(entry.get("join_rid", rid),
+                                         ok=pages > 0)
+            if telem is not None and res is not None:
+                if res == "commit":
+                    telem.registry.counter(
+                        "serving_replica_overlap_commits_total",
+                        help="overlap promises confirmed — the "
+                             "transferred pages landed while the "
+                             "suffix prefilled").inc()
+                else:
+                    telem.registry.counter(
+                        "serving_replica_overlap_fallbacks_total",
+                        labels={"reason": res},
+                        help="overlap promises rolled back into "
+                             "prefill recompute, by reason (short = "
+                             "the transfer under-delivered, recompute "
+                             "= it failed outright)").inc()
+            if entry.get("prewarm"):
+                attempts.pop(rid, None)   # the push id's nonce
+        elif entry.get("put") is not None:
+            # a held demand put: its own pull, or a join onto a push
             _admit_put(entry["put"])
+            if entry.get("prewarm"):
+                attempts.pop(rid, None)   # the push id's nonce
+        else:
+            # elastic pre-warm / unjoined push: the adopted chain IS
+            # the result — the kv_ack page count above tells the
+            # router how warm we got
+            attempts.pop(rid, None)
 
     while True:
         if preempt_h is not None and preempt_deadline is None:
@@ -1861,27 +2013,82 @@ def serve(cfg: dict, chan: LineChannel,
                     inj.crash_now("replica_crash_on_put",
                                   f"admit of {rid}")
                 if msg.get("pull") and not draining:
-                    # a wanted-chain hint rode the record: hold admission
-                    # while the peer's pages are in flight (bounded by
-                    # the pull deadline — recompute is always safe)
-                    pulls[rid] = {
-                        "put": msg, "asm": None, "shm": None,
-                        "relay": False,
-                        "deadline": time.monotonic() + float(
-                            msg["pull"].get("deadline_s", 5.0))}
-                    # promote-AHEAD: the network wait is free time to
-                    # stage this prompt's NVMe-resident tier records up
-                    # into host RAM, so whichever way the pull settles
-                    # (adopt dedup or recompute fallback), the
-                    # admission-time tier promote reads at RAM rate
-                    tier = getattr(backend, "kv_tier", None)
-                    if tier is not None:
-                        bs = backend.block_size
-                        ptoks = [int(x) for x in msg.get("prompt", ())]
-                        n_full = len(ptoks) // bs
-                        if n_full:
-                            tier.prefetch(
-                                chain_hashes(ptoks[:n_full * bs], bs))
+                    p = msg["pull"]
+                    jid = p.get("join")
+                    overlap = bool(p.get("overlap"))
+                    promised = int(p.get("pages", 0)) \
+                        * backend.block_size
+                    jent = pulls.get(str(jid)) if jid is not None \
+                        else None
+                    if jid is not None and (jent is None
+                                            or not jent.get("push")):
+                        # the push this put meant to join already
+                        # settled (or died): admit now — its pages are
+                        # either resident (the match hits them) or the
+                        # prompt recomputes
+                        _admit_put(msg)
+                    elif jent is not None:
+                        # JOIN an in-flight push: from here its relay
+                        # is demand movement for this request — the
+                        # settle admits (or, under overlap, confirms
+                        # the already-admitted promise)
+                        if overlap:
+                            jent["overlap"] = True
+                            jent["join_rid"] = rid
+                            _admit_put(msg, promised=promised)
+                        else:
+                            jent["put"] = msg
+                    else:
+                        # a wanted-chain hint rode the record: hold
+                        # admission while the peer's pages are in
+                        # flight (bounded by the pull deadline —
+                        # recompute is always safe) … unless overlap
+                        # is on, where admission starts NOW at the
+                        # promised boundary and the retained entry
+                        # settles the promise
+                        entry = pulls[rid] = {
+                            "put": msg, "asm": None, "shm": None,
+                            "relay": False,
+                            "deadline": time.monotonic() + float(
+                                p.get("deadline_s", 5.0))}
+                        if overlap:
+                            entry["put"] = None
+                            entry["overlap"] = True
+                            _admit_put(msg, promised=promised)
+                        # promote-AHEAD: the network wait is free time
+                        # to stage this prompt's NVMe-resident tier
+                        # records up into host RAM, so whichever way
+                        # the pull settles (adopt dedup or recompute
+                        # fallback), the admission-time tier promote
+                        # reads at RAM rate
+                        tier = getattr(backend, "kv_tier", None)
+                        if tier is not None:
+                            bs = backend.block_size
+                            ptoks = [int(x)
+                                     for x in msg.get("prompt", ())]
+                            n_full = len(ptoks) // bs
+                            if n_full:
+                                tier.prefetch(
+                                    chain_hashes(ptoks[:n_full * bs],
+                                                 bs))
+                elif msg.get("promote_hint") and not draining:
+                    # promote-AHEAD at placement time: the router's
+                    # sticky/digest match says the tier likely holds
+                    # this chain — start the extract (NVMe read + crc
+                    # verify) before admission instead of inside it.
+                    # The two-phase split keeps the begin mutation-free
+                    # (crash-safe) and the counted fallback-to-
+                    # recompute story intact.
+                    ph = backend.tier_promote_begin(
+                        [int(x) for x in msg.get("prompt", ())])
+                    if backend.tier_promote_finish(ph, ahead=True) \
+                            and telem is not None:
+                        telem.registry.counter(
+                            "serving_replica_promote_ahead_total",
+                            help="tier promotes started ahead of "
+                                 "admission on the router's "
+                                 "promote_hint").inc()
+                    _admit_put(msg)
                 else:
                     _admit_put(msg)
             elif t == "flush":
@@ -2247,6 +2454,33 @@ def serve(cfg: dict, chan: LineChannel,
                         "shm": None, "relay": False,
                         "deadline": time.monotonic() + float(
                             msg.get("deadline_s", 5.0))}
+            elif t == "kv_push":
+                # anticipatory push OFFER (serving/push.py): the router
+                # wants to land a hot chain here ahead of demand. This
+                # replica arbitrates its own idleness — pushes are
+                # strictly lower priority than live work, so draining
+                # or busy replicas DECLINE and the planner moves on; an
+                # accepted offer registers a prewarm-shaped pull entry
+                # the kv_bundle/kv_chunk/kv_eof relay then fills (the
+                # deadline settles a dead transfer into kv_ack pages=0)
+                rid = str(msg["id"])
+                if draining:
+                    _stream({"t": "kv_push_no", "id": rid,
+                             "reason": "draining"})
+                elif rid in pulls:
+                    _stream({"t": "kv_push_no", "id": rid,
+                             "reason": "duplicate"})
+                elif backend.has_work() or len(pulls) >= 4:
+                    _stream({"t": "kv_push_no", "id": rid,
+                             "reason": "busy"})
+                else:
+                    attempts[rid] = 0
+                    pulls[rid] = {
+                        "put": None, "prewarm": True, "push": True,
+                        "asm": None, "shm": None, "relay": False,
+                        "deadline": time.monotonic() + float(
+                            msg.get("deadline_s", 5.0))}
+                    _stream({"t": "kv_push_ok", "id": rid})
             elif t == "trace_req":
                 # breach sampling: the router wants this request's LIVE
                 # timeline segment now (fin=False — the rest ships at

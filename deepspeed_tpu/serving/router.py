@@ -62,6 +62,7 @@ from .journal import Journal, OPEN, reduce_router_records
 from .disagg import (DECODE_CAPABLE, MigrationState, PREFILL_CAPABLE,
                      RebalancePolicy, ScaleAdvisor, role_of)
 from .fleet import DRAINING, Fleet, FleetConfig, QUARANTINED, READY
+from .push import PushPlanner
 from .placement import (StickyMap, best_digest_peer, chain_hashes,
                         gang_segments, load_score, match_pages,
                         pick_replica, plan_gang_prefill, plan_kv_source)
@@ -277,6 +278,35 @@ class RouterConfig:
     #: allow prefill<->decode re-role when one role wants up and the
     #: other down simultaneously (cheaper than retire + spawn)
     elastic_re_role: bool = True
+    #: anticipatory KV movement (serving/push.py): proactively ship hot
+    #: prefix chains to digest-cold decode-capable replicas while the
+    #: fleet is idle, so the next placement miss finds the pages
+    #: already resident. Strictly lower-priority than demand pulls.
+    kv_push: bool = False
+    #: concurrent proactive pushes in flight (fleet-wide)
+    kv_push_max_inflight: int = 2
+    #: min seconds between push launch rounds (rebalance-style
+    #: rate limit — pushes must never become churn)
+    kv_push_min_interval_s: float = 0.25
+    #: the idle budget: pushes engage only while the queue-wait
+    #: estimator reads at or under this (None estimate = cold = idle)
+    kv_push_idle_wait_s: float = 0.05
+    #: hottest distinct chains considered per launch round
+    kv_push_chains: int = 4
+    #: per-push budget offer-to-ack; past it the push fails "deadline"
+    kv_push_deadline_s: float = 5.0
+    #: per-(chain, target) cooldown — a chain just offered somewhere is
+    #: not re-offered there every tick (hysteresis against thrash)
+    kv_push_hysteresis_s: float = 5.0
+    #: minimum heat (sticky hits + live sharers) before a chain is
+    #: worth speculating bandwidth on
+    kv_push_min_heat: int = 2
+    #: transfer/compute overlap: a put whose pages are in flight
+    #: (pull or push join) admits IMMEDIATELY and prefills the suffix
+    #: beyond the promised boundary while the transfer lands, rolling
+    #: back to recompute if it fails — instead of holding admission
+    #: until the pages arrive
+    kv_overlap: bool = False
     #: deterministic router-side chaos (runtime/resilience.py
     #: FaultInjector, always HARD — a real no-unwind os._exit):
     #: router_crash_after_admit / router_crash_after_place /
@@ -508,6 +538,11 @@ class Router:
         self._elastic = ElasticController(
             self, recovered=self._recovered_elastic) \
             if self.cfg.elastic else None
+        #: anticipatory-push planner (serving/push.py) — always
+        #: constructed (state is a few dicts); tick() gates on
+        #: ``cfg.kv_push``, and demand placement prices its in-flight
+        #: pushes either way
+        self._push = PushPlanner(self)
 
     # -- crash safety: journal + recovery (serving/journal.py) -----------
     def _open_journal(self) -> None:
@@ -961,6 +996,7 @@ class Router:
                 # (ClockSync keys by (slot, epoch) and bounds retention)
             self._fail_pulls_from(r.slot, r.epoch)
             self._fail_gangs_from(r.slot, r.epoch)
+            self._push.note_slot_died(r)
             if self._elastic is not None:
                 self._elastic.note_slot_died(r)
             # retired slots normally drained clean (no-op replay);
@@ -1030,6 +1066,11 @@ class Router:
         # (disagg.RebalancePolicy) so it can never flap
         if self.cfg.rebalance:
             self._maybe_rebalance(now)
+        # anticipatory pushes ride the leftover idle capacity AFTER
+        # dispatch and rebalance saw the tick — the planner's own gates
+        # (no demand pulls in flight, queue-wait under the idle budget,
+        # rate limit + per-chain cooldown) keep it strictly background
+        self._push.tick(now)
         # elastic fleet-shape actuators last: they read the freshly
         # updated hints and the post-dispatch assignment counts
         if self._elastic is not None:
@@ -1195,16 +1236,21 @@ class Router:
         elif t in ("kv_bundle", "kv_chunk", "kv_eof", "kv_none",
                    "kv_need", "kv_ack"):
             # gang hop transfers ride the same kv_* vocabulary under a
-            # "g:"-prefixed id, elastic pre-warm pushes under "w:" —
-            # route each to its own state machine
+            # "g:"-prefixed id, elastic pre-warm pushes under "w:",
+            # anticipatory pushes under "p:" — route each to its own
+            # state machine
             rid = str(msg.get("id", ""))
             if rid.startswith("g:"):
                 self._on_gang_pull(h, msg)
             elif rid.startswith("w:"):
                 if self._elastic is not None:
                     self._elastic.on_kv(h, msg)
+            elif rid.startswith("p:"):
+                self._push.on_kv(h, msg)
             else:
                 self._on_pull(h, msg)
+        elif t in ("kv_push_ok", "kv_push_no"):
+            self._push.on_offer_reply(h, msg)
         elif t in ("gang_seg_ok", "gang_seg_fail"):
             self._on_gang_seg(h, msg)
         elif t == "preempt":
@@ -2147,23 +2193,44 @@ class Router:
                 self._assigned_n.get(rep.slot, 0) + 1
             self._sticky.note(req.chain, rep.slot)
             pull_peer, peer_pages = (None, 0)
+            join_pid, join_pages, promote_pages = None, 0, 0
             if self.cfg.kv_pull and req.chain \
                     and tid not in self._pulls:
-                pull_peer, peer_pages = self._maybe_pull(req, rep,
-                                                         hit_pages)
+                (pull_peer, peer_pages, join_pid, join_pages,
+                 promote_pages) = self._maybe_pull(req, rep, hit_pages)
             wire = req.rec.to_wire()
             wire["a"] = req.attempt
             if pull_peer is not None:
                 # wanted-chain hint: the replica holds admission until
                 # the pulled pages land (or its own deadline fires and
-                # it recomputes — the always-safe fallback)
+                # it recomputes — the always-safe fallback); with
+                # overlap it instead admits NOW and prefills the suffix
+                # past the promised boundary while the pages land
                 wire["pull"] = {"pages": peer_pages,
                                 "deadline_s": self.cfg.kv_pull_timeout_s}
+                if self.cfg.kv_overlap:
+                    wire["pull"]["overlap"] = True
+            elif join_pid is not None:
+                # JOIN the proactive push already streaming this chain
+                # toward the replica (serving/push.py) — the pages are
+                # in flight, so no new movement starts
+                wire["pull"] = {"pages": join_pages,
+                                "deadline_s": self.cfg.kv_push_deadline_s,
+                                "join": join_pid}
+                if self.cfg.kv_overlap:
+                    wire["pull"]["overlap"] = True
+                self._push.note_join(join_pid, tid)
+            if promote_pages:
+                # promote-ahead: the replica starts the tier extract
+                # (NVMe read + crc verify) concurrently with admission
+                # instead of after the admit match
+                wire["promote_hint"] = promote_pages
             self._fev(tid, "placed", slot=rep.slot, attempt=req.attempt,
                       hit_pages=hit_pages, chain_pages=len(req.chain),
                       role_fallback=role_fallback,
                       pull_slot=pull_peer.slot
-                      if pull_peer is not None else None)
+                      if pull_peer is not None else None,
+                      join=join_pid, promote=promote_pages or None)
             # WAL discipline: the placement is journaled BEFORE the put
             # goes out — a crash in between leaves a journaled
             # assignment nobody holds, which resync simply never claims
@@ -2217,7 +2284,22 @@ class Router:
     # admission-path promote (kvtier.py) serve the chain.
 
     def _maybe_pull(self, req: _Req, rep, hit_pages: int):
+        """The KV-sourcing plan for a just-placed request:
+        ``(peer, peer_pages, join_pid, join_pages, promote_pages)``.
+        At most ONE anticipatory leg is set — a pull source, a
+        proactive push in flight the put can JOIN (serving/push.py), or
+        a tier-promote hint (``promote_pages`` > 0 rides the wire as
+        ``promote_hint`` so the replica starts the extract concurrently
+        with admission). ``plan_kv_source`` is the single decision
+        point for all of it."""
         rep_wv = getattr(rep, "wv", None)
+        # the placed replica's OWN KV tier (kvtier.py) may hold the
+        # chain — promoting it locally beats shipping pages across the
+        # fleet; and a proactive push already in flight toward this
+        # replica is movement already paid for
+        tier_pages = match_pages(req.chain, getattr(rep, "tier_digest",
+                                                    None))
+        push_pid, push_pages = self._push.inflight(req.chain, rep.slot)
         peer, pages = best_digest_peer(req.chain, self.fleet.ready(),
                                        exclude_slot=rep.slot,
                                        weight_version=rep_wv)
@@ -2238,19 +2320,15 @@ class Router:
                                          rep_wv):
                     self._count_version_skew("kv_pull")
                     self._fail_pull_count_only("version_skew")
-            return None, 0
+            peer, pages = None, 0
+            if max(tier_pages, push_pages) - hit_pages \
+                    < self.cfg.kv_pull_min_pages:
+                return None, 0, None, 0, 0
         bs = rep.block_size or self._fleet_block_size() or 1
-        shm_ok = bool(peer.shm) and not rep.address and not peer.address
+        shm_ok = peer is not None and bool(peer.shm) \
+            and not rep.address and not peer.address
         rate = self.cfg.kv_pull_shm_bytes_s if shm_ok \
             else self.cfg.kv_pull_relay_bytes_s
-        # three-way (placement.plan_kv_source): the placed replica's
-        # OWN KV tier (kvtier.py) may hold the chain — promoting it
-        # locally beats shipping pages across the fleet. The replica
-        # promotes on admission autonomously, so "tier" here just means
-        # DON'T start a pull (priced at the conservative NVMe rate —
-        # the router cannot see which sub-tier holds the chain).
-        tier_pages = match_pages(req.chain, getattr(rep, "tier_digest",
-                                                    None))
         plan = plan_kv_source(
             len(req.chain), hit_pages, pages, tier_pages,
             self._page_bytes, bs, self.cfg.kv_pull_prefill_tok_s,
@@ -2260,7 +2338,8 @@ class Router:
             # recompute/tier are both safe while a pull burns messages
             min(self._kv_rates["ram"], self._kv_rates["nvme"]),
             self.cfg.kv_pull_overhead_s,
-            min_pages=self.cfg.kv_pull_min_pages)
+            min_pages=self.cfg.kv_pull_min_pages,
+            push_pages=push_pages, overlap=self.cfg.kv_overlap)
         if plan == "tier":
             self.kv_tier_locals += 1
             self._fev(req.rec.trace_id, "tier_local", pages=tier_pages)
@@ -2270,10 +2349,12 @@ class Router:
                     help="placements where the cost model chose a local "
                          "KV-tier promote over a cross-replica "
                          "pull").inc()
-            return None, 0
-        if plan != "pull":
-            return None, 0
-        return peer, pages
+            return None, 0, None, 0, tier_pages
+        if plan == "push" and push_pid is not None:
+            return None, 0, push_pid, push_pages, 0
+        if plan != "pull" or peer is None:
+            return None, 0, None, 0, 0
+        return peer, pages, None, 0, 0
 
     def _start_pull(self, req: _Req, rep, peer, pages: int,
                     now: float) -> None:
@@ -3175,6 +3256,7 @@ def main(argv: list[str]) -> int:
             "preemptions": router.fleet.preemptions_total,
             "elastic": router._elastic.stats()
             if router._elastic is not None else None,
+            "push": router._push.stats(),
             "journal": router.journal_stats(),
         }
     finally:

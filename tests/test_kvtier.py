@@ -879,3 +879,39 @@ def test_refine_min_pages_histogram_driven_value_wins():
     assert tier.refine_min_pages(block_size=16, cap=64) == n
     assert tier.min_pages_refinements == 2
     tier.close(flush=False)
+
+
+def test_two_phase_extract_matches_one_shot_and_abandon_is_free(tmp_path):
+    """PR-20 promote-ahead contract: ``extract_begin`` is a pure plan
+    (walk + residency check, zero mutation — an abandoned handle owes
+    nothing), ``extract_finish`` rebuilds the same bundle the one-shot
+    ``extract`` would, and a handle whose pages were evicted between
+    the phases finishes to None (callers recompute, never serve a
+    torn promote)."""
+    tokens = list(range(3 * BS))
+    t = KVTier(KVTierConfig(ram_bytes=1 << 20, nvme_dir=str(tmp_path)))
+    assert t.absorb(_bundle(tokens)) == 3
+    before = t.stats()
+    h = t.extract_begin(tokens + [7, 8], BS)
+    assert h is not None and h["planned"] == 3
+    # phase one moved nothing: abandoning here (owner crash before
+    # finish) leaves the tier byte-identical
+    assert t.stats() == before
+    b2 = t.extract_finish(t.extract_begin(tokens + [7, 8], BS))
+    assert b2 is not None and b2.n_full == 3
+    toy_verify(b2)
+    one = t.extract(tokens + [7, 8], BS)
+    assert one.pages == b2.pages and one.chain == b2.chain
+    # sizing leg: a RAM-only tier holding exactly one chain
+    ram = t.stats()["ram_bytes"]
+    t.close()
+    t2 = KVTier(KVTierConfig(ram_bytes=ram, nvme_dir=None))
+    assert t2.absorb(_bundle(tokens)) == 3
+    h2 = t2.extract_begin(tokens, BS)
+    assert h2 is not None and h2["planned"] == 3
+    # residency shrinks between the phases: a new chain of the same
+    # size evicts the planned pages wholesale
+    t2.absorb(_bundle(range(500, 500 + 3 * BS)))
+    assert t2.extract_finish(h2) is None     # stale plan -> recompute
+    assert t2.extract_finish(None) is None   # begin already refused
+    t2.close(flush=False)

@@ -271,9 +271,17 @@ def _gen_ops(rng, n_ops):
             # (demoted by earlier evict ops), toy-verify the payloads,
             # and adopt it into either pool through the refcounted
             # adopt_prefix — full audit after, pool-full degrades clean
-            ops.append(("tier_promote", int(rng.integers(0, 2)),
-                        int(rng.integers(len(_TEMPLATES))),
-                        int(rng.integers(1, 11))))
+            if rng.random() < 0.5:
+                ops.append(("tier_promote", int(rng.integers(0, 2)),
+                            int(rng.integers(len(_TEMPLATES))),
+                            int(rng.integers(1, 11))))
+            else:
+                # two-phase variant (PR-20 promote-ahead): begin plans,
+                # finish adopts — or the owner crashes between phases
+                ops.append(("tier_promote2", int(rng.integers(0, 2)),
+                            int(rng.integers(len(_TEMPLATES))),
+                            int(rng.integers(1, 11)),
+                            int(rng.integers(0, 2))))
             continue
         if rng.random() < 0.30:
             op = ("b", op)            # same op against the importer pool
@@ -497,6 +505,45 @@ def _run_trace(ops):
         except RuntimeError:
             pass                        # pool full: recompute fallback
 
+    def tier_promote2(op):
+        """Two-phase promote (PR-20 promote-ahead pipelining):
+        ``extract_begin`` plans against current residency without
+        mutating anything — a crash before ``extract_finish`` must
+        leave the tier byte-identical (recompute owes it nothing) —
+        and a finished handle adopts exactly like the one-shot op."""
+        from deepspeed_tpu.inference.migration import toy_verify
+        from deepspeed_tpu.inference.prefix_cache import chain_hashes
+
+        _, pick, tmpl, pages, crash = op
+        st = pools[pick % 2]["st"]
+        tokens = list(_TEMPLATES[tmpl][:pages * 4])
+        aligned = tokens[:(len(tokens) // 4) * 4]
+        if not aligned:
+            return
+        deep = tier.probe(chain_hashes(aligned, 4))
+        if deep == 0:
+            return
+        before = tier.stats()
+        handle = tier.extract_begin(aligned[:deep * 4], 4)
+        if crash or handle is None:
+            # owner died between the phases: the pure plan left no
+            # trace — residency and counters byte-identical
+            after = tier.stats()
+            for k in ("ram_pages", "nvme_pages", "promotes",
+                      "promoted_pages", "demoted_pages"):
+                assert after[k] == before[k], \
+                    f"extract_begin mutated {k}: {before[k]} -> {after[k]}"
+            return
+        bundle = tier.extract_finish(handle)
+        if bundle is None:
+            return                      # residency shrank: recompute
+        toy_verify(bundle)              # payload integrity through the tier
+        try:
+            st.adopt_prefix(bundle.tokens, bundle.n_computed)
+            st.audit()
+        except RuntimeError:
+            pass                        # pool full: recompute fallback
+
     for i, op in enumerate(ops):
         try:
             if op[0] == "b":
@@ -505,6 +552,8 @@ def _run_trace(ops):
                 peer_pull(op)
             elif op[0] == "tier_promote":
                 tier_promote(op)
+            elif op[0] == "tier_promote2":
+                tier_promote2(op)
             elif op[0] in ("migrate", "migrate_abort"):
                 migrate(op)
             else:

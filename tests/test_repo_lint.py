@@ -758,3 +758,95 @@ def test_attn_registry_detector_requires_selection_reads(tmp_path):
     out = state_lint.check_attn_registry(str(tmp_path))
     assert len(out) == 1, "\n".join(out)
     assert "no longer consults the attention registry" in out[0]
+
+
+def test_protocol_lint_pins_push_vocabulary_both_directions():
+    """The anticipatory-push vocabulary (PR 20) is wired end to end:
+    the push planner constructs the declinable kv_push offer and the
+    replica dispatches it; the replica constructs kv_push_ok/kv_push_no
+    and the router dispatches those.  Same rationale as the gang and
+    elastic pins above — a pair deleted from BOTH sides vanishes from
+    both maps and would pass the generic closure check."""
+    sent: dict = {}
+    handled: dict = {}
+    serving = os.path.join(ROOT, "deepspeed_tpu", "serving")
+    for dirpath, _, files in os.walk(serving):
+        for f in sorted(files):
+            if f.endswith(".py"):
+                s, h, errs = protocol_lint.scan_file(
+                    os.path.join(dirpath, f))
+                assert errs == []
+                sent.update(s)
+                handled.update(h)
+    for tag in ("kv_push", "kv_push_ok", "kv_push_no"):
+        assert tag in sent, f"{tag} no longer constructed"
+        assert tag in handled, f"{tag} no longer dispatched"
+    assert "push.py" in sent["kv_push"]
+    assert "replica.py" in handled["kv_push"]
+    assert "replica.py" in sent["kv_push_ok"]
+    assert "router.py" in handled["kv_push_ok"]
+    assert "replica.py" in sent["kv_push_no"]
+    assert "router.py" in handled["kv_push_no"]
+    # promote_hint is a put FIELD, not a "t" tag: pin both ends in
+    # source so the overlap promise can't silently lose its producer
+    # or its consumer
+    with open(os.path.join(serving, "router.py")) as fh:
+        assert "promote_hint" in fh.read()
+    with open(os.path.join(serving, "replica.py")) as fh:
+        assert "promote_hint" in fh.read()
+
+
+def test_deadline_lint_covers_push_planner(tmp_path):
+    """serving/push.py ticks inside the router poll loop: an unbounded
+    wait while scoring candidates or launching an offer would stall
+    every heartbeat, so the deadline lint must sweep it like the rest
+    of serving/ — no carve-out for new control-plane files."""
+    serving = tmp_path / "deepspeed_tpu" / "serving"
+    serving.mkdir(parents=True)
+    (serving / "push.py").write_text(
+        "def launch(proc, lock):\n"
+        "    lock.acquire()\n"                     # flagged: unbounded
+        "    proc.join(timeout=2.0)\n")            # bounded: ok
+    out = deadline_lint.check_repo(str(tmp_path))
+    assert len(out) == 1 and ":2:" in out[0]
+    real = os.path.join(ROOT, "deepspeed_tpu", "serving", "push.py")
+    assert os.path.exists(real)
+    assert deadline_lint.check_repo(ROOT) == []
+
+
+def test_state_invariant_detector_pins_two_phase_extract(tmp_path):
+    """The two-phase promote mutators (extract_begin/extract_finish,
+    PR 20) are pinned to the tier_promote_begin/tier_promote_finish
+    wrappers exactly like the one-shot extract — a router or planner
+    calling them directly would bypass the verify/adopt/release
+    sequence that keeps a torn promote from being served."""
+    bad = tmp_path / "deepspeed_tpu" / "serving" / "router.py"
+    bad.parent.mkdir(parents=True)
+    bad.write_text(
+        "def hijack(rep):\n"
+        "    rep.kv_tier.extract_begin([], 16)\n"      # flagged
+        "    rep._kv_tier.extract_finish(None)\n"      # alias: flagged
+        "    rep.kv_tier.probe([])\n")                 # read: ok
+    out = state_lint.check_file(str(bad))
+    assert len(out) == 2, "\n".join(out)
+    assert ":2:" in out[0] and "kv_tier.extract_begin()" in out[0]
+    assert ":3:" in out[1] and "kv_tier.extract_finish()" in out[1]
+    # the allowlisted wrappers keep their access (engine and replica)
+    for fname in ("engine_v2.py", "replica.py"):
+        sub = "inference" if fname == "engine_v2.py" else "serving"
+        ok = tmp_path / "deepspeed_tpu" / sub / fname
+        ok.parent.mkdir(parents=True, exist_ok=True)
+        ok.write_text(
+            "class B:\n"
+            "    def tier_promote_begin(self, toks):\n"
+            "        return self._kv_tier.extract_begin(toks, 16)\n"
+            "    def tier_promote_finish(self, h, ahead=False):\n"
+            "        return self._kv_tier.extract_finish(h)\n")
+        assert state_lint.check_file(str(ok)) == [], fname
+    # kvtier.py itself (the implementation) is exempt
+    impl = tmp_path / "deepspeed_tpu" / "inference" / "kvtier.py"
+    impl.write_text(
+        "class KVTier:\n"
+        "    def helper(self):\n"
+        "        self.kv_tier.extract_begin(None, 16)\n")
+    assert state_lint.check_file(str(impl)) == []
